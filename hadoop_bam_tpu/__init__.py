@@ -1,0 +1,22 @@
+"""hadoop_bam_tpu — a TPU-native framework with the capabilities of Hadoop-BAM.
+
+Distributed, record-parallel reading/writing/sorting of bioinformatics file
+formats (BAM/SAM/CRAM, VCF/BCF, FASTQ/FASTA/QSEQ), re-designed TPU-first:
+
+- host-side Python owns file-format intelligence (headers, indices,
+  record-aligned split planning, interval-bounded traversal, part merging),
+- a C++ host library owns the irregular hot host path (batched BGZF inflate,
+  BAM record scanning),
+- JAX/XLA/Pallas own the dense phases: batched record-field decode into
+  structure-of-arrays tensors, 64-bit coordinate keying, per-chip sort, and a
+  cross-chip all-to-all range-partitioned shuffle over a `jax.sharding.Mesh`
+  (the MapReduce-shuffle equivalent; key semantics preserved from
+  reference BAMRecordReader.java:81-121).
+
+The reference architecture being matched is huangzhibo/Hadoop-BAM (pure Java on
+Hadoop MapReduce); see SURVEY.md at the repo root for the capability map.
+"""
+
+__version__ = "0.1.0"
+
+from .conf import Configuration  # noqa: F401

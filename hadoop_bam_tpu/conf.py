@@ -1,0 +1,103 @@
+"""Configuration: the Hadoop `Configuration` equivalent.
+
+The reference's entire flag system is Hadoop string properties in the
+``hadoopbam.*`` / ``hbam.*`` namespaces (SURVEY.md §5 key inventory; e.g.
+reference BAMInputFormat.java:89-111, AnySAMInputFormat.java:60-62,
+FormatConstants.java:57-58).  This module reproduces that contract: a string
+key/value map with lenient boolean parsing (reference util/ConfHelper.java:41-69)
+plus typed helpers, so user code can drive the TPU backend with the same
+property names it used against Hadoop-BAM.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Optional
+
+
+# Complete property-name inventory, mirrored from the reference (SURVEY.md §5).
+BAM_BOUNDED_TRAVERSAL = "hadoopbam.bam.bounded-traversal"
+BAM_ENABLE_BAI_SPLITTER = "hadoopbam.bam.enable-bai-splitter"
+BAM_INTERVALS = "hadoopbam.bam.intervals"
+BAM_TRAVERSE_UNPLACED_UNMAPPED = "hadoopbam.bam.traverse-unplaced-unmapped"
+BAM_WRITE_SPLITTING_BAI = "hadoopbam.bam.write-splitting-bai"
+ANYSAM_TRUST_EXTS = "hadoopbam.anysam.trust-exts"
+ANYSAM_OUTPUT_FORMAT = "hadoopbam.anysam.output-format"
+ANYSAM_WRITE_HEADER = "hadoopbam.anysam.write-header"
+CRAM_REFERENCE_SOURCE_PATH = "hadoopbam.cram.reference-source-path"
+SAMHEADERREADER_VALIDATION_STRINGENCY = (
+    "hadoopbam.samheaderreader.validation-stringency"
+)
+VCFRECORDREADER_VALIDATION_STRINGENCY = (
+    "hadoopbam.vcfrecordreader.validation-stringency"
+)
+VCF_TRUST_EXTS = "hadoopbam.vcf.trust-exts"
+VCF_INTERVALS = "hadoopbam.vcf.intervals"
+VCF_OUTPUT_FORMAT = "hadoopbam.vcf.output-format"
+VCF_WRITE_HEADER = "hadoopbam.vcf.write-header"
+FASTQ_BASE_QUALITY_ENCODING = "hbam.fastq-input.base-quality-encoding"
+FASTQ_FILTER_FAILED_QC = "hbam.fastq-input.filter-failed-qc"
+QSEQ_BASE_QUALITY_ENCODING = "hbam.qseq-input.base-quality-encoding"
+QSEQ_FILTER_FAILED_QC = "hbam.qseq-input.filter-failed-qc"
+INPUT_BASE_QUALITY_ENCODING = "hbam.input.base-quality-encoding"
+INPUT_FILTER_FAILED_QC = "hbam.input.filter-failed-qc"
+FASTQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.fastq-output.base-quality-encoding"
+QSEQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.qseq-output.base-quality-encoding"
+# New in the TPU build (per driver BASELINE.json north star).
+BACKEND = "hadoopbam.backend"
+
+_TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
+_FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))
+
+
+class Configuration:
+    """A string-property map with the reference's lenient parsing semantics."""
+
+    def __init__(self, props: Optional[Mapping[str, str]] = None) -> None:
+        self._props: dict[str, str] = dict(props) if props else {}
+
+    def set(self, key: str, value) -> None:
+        self._props[key] = str(value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._props.get(key, default)
+
+    def unset(self, key: str) -> None:
+        self._props.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._props
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._props)
+
+    def set_boolean(self, key: str, value: bool) -> None:
+        self._props[key] = "true" if value else "false"
+
+    def get_boolean(self, key: str, default: bool = False) -> bool:
+        """Lenient boolean parse (reference util/ConfHelper.java:41-69):
+        accepts yes/no, true/false, t/f, y/n, 1/0, on/off, enabled/disabled,
+        case-insensitively; anything else falls back to the default."""
+        raw = self._props.get(key)
+        if raw is None:
+            return default
+        word = raw.strip().lower()
+        if word in _TRUE_WORDS:
+            return True
+        if word in _FALSE_WORDS:
+            return False
+        return default
+
+    def set_int(self, key: str, value: int) -> None:
+        self._props[key] = str(value)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        raw = self._props.get(key)
+        if raw is None:
+            return default
+        try:
+            return int(raw.strip())
+        except ValueError:
+            return default
+
+    def copy(self) -> "Configuration":
+        return Configuration(self._props)

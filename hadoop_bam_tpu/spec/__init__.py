@@ -1,0 +1,8 @@
+"""Pure-Python/NumPy golden-oracle implementations of the on-disk formats.
+
+This is stage 1 of the build plan (SURVEY.md §7): slow, obviously-correct
+reference implementations of BGZF framing, BAM record layout, index formats,
+and key functions.  The C++ host library and the Pallas device kernels are
+validated against these oracles; the oracles themselves are validated against
+htsjdk/samtools-written fixtures.
+"""

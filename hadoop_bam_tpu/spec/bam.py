@@ -1,0 +1,529 @@
+"""BAM file layout: header, reference dictionary, alignment record codec.
+
+Golden-oracle implementation of the BAM binary format (the role htsjdk's
+``BAMRecordCodec``/``BAMFileReader`` play below reference L0), plus the
+NumPy structure-of-arrays batch decode that defines the device tensor layout
+used by ops/ (SURVEY.md §7 stage 4).
+
+Key functions reproduce reference semantics exactly:
+- ``alignment_key`` == BAMRecordReader.getKey/getKey0
+  (BAMRecordReader.java:81-121): ``refIdx << 32 | pos0`` for mapped records,
+  ``Integer.MAX_VALUE << 32 | murmur3(raw record bytes)`` for unmapped ones —
+  including Java's sign extension of the 32-bit hash into the low word.
+- The "lazy" stance of LazyBAMRecordFactory (LazyBAMRecordFactory.java:53-111):
+  records decode without a header; names/cigars/seq/qual/tags stay as raw byte
+  slices until asked for (the ragged sideband of the SoA layout).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..utils.murmur3 import murmurhash3_bytes
+
+MAGIC = b"BAM\x01"
+
+# SEQ 4-bit code → base character ("=ACMGRSVTWYHKDBN", SAM spec table).
+SEQ_DECODE = "=ACMGRSVTWYHKDBN"
+_SEQ_ENCODE = {c: i for i, c in enumerate(SEQ_DECODE)}
+CIGAR_OPS = "MIDNSHP=X"
+_CIGAR_ENCODE = {c: i for i, c in enumerate(CIGAR_OPS)}
+
+FLAG_PAIRED = 0x1
+FLAG_PROPER_PAIR = 0x2
+FLAG_UNMAPPED = 0x4
+FLAG_MATE_UNMAPPED = 0x8
+FLAG_REVERSE = 0x10
+FLAG_MATE_REVERSE = 0x20
+FLAG_FIRST_OF_PAIR = 0x40
+FLAG_SECOND_OF_PAIR = 0x80
+FLAG_SECONDARY = 0x100
+FLAG_FAIL_QC = 0x200
+FLAG_DUPLICATE = 0x400
+FLAG_SUPPLEMENTARY = 0x800
+
+INT_MAX = 0x7FFFFFFF  # Java Integer.MAX_VALUE, the unmapped refIdx sentinel
+
+# Fixed 32-byte prefix of every alignment record, after the u32 block_size:
+# refID, pos, l_read_name, mapq, bin, n_cigar_op, flag, l_seq,
+# next_refID, next_pos, tlen.
+_FIXED = struct.Struct("<iiBBHHHIiii")
+
+
+class BamError(IOError):
+    pass
+
+
+@dataclass
+class BamHeader:
+    """Parsed BAM header: SAM text + binary reference dictionary."""
+
+    text: str
+    refs: List[Tuple[str, int]] = field(default_factory=list)
+
+    @property
+    def n_refs(self) -> int:
+        return len(self.refs)
+
+    def ref_name(self, refid: int) -> str:
+        return "*" if refid < 0 else self.refs[refid][0]
+
+    def ref_index(self, name: str) -> int:
+        if name == "*":
+            return -1
+        for i, (n, _) in enumerate(self.refs):
+            if n == name:
+                return i
+        raise KeyError(name)
+
+    def sort_order(self) -> str:
+        for line in self.text.split("\n"):
+            if line.startswith("@HD"):
+                for f in line.split("\t"):
+                    if f.startswith("SO:"):
+                        return f[3:]
+        return "unknown"
+
+    def with_sort_order(self, so: str) -> "BamHeader":
+        """Rewritten @HD SO: field (util/GetSortedBAMHeader.java:36-57
+        semantics: force the header's sort order before a sorted write)."""
+        lines = self.text.split("\n")
+        hd_seen = False
+        for i, line in enumerate(lines):
+            if line.startswith("@HD"):
+                hd_seen = True
+                fields = [
+                    f for f in line.split("\t") if not f.startswith("SO:")
+                ]
+                fields.append(f"SO:{so}")
+                lines[i] = "\t".join(fields)
+        if not hd_seen:
+            lines.insert(0, f"@HD\tVN:1.6\tSO:{so}")
+        return BamHeader("\n".join(lines), list(self.refs))
+
+    def encode(self) -> bytes:
+        """Binary header block: magic, l_text, text, n_ref, ref dict
+        (the bytes BAMRecordWriter.writeHeader emits,
+        BAMRecordWriter.java:152-167)."""
+        text = self.text.encode()
+        out = bytearray()
+        out += MAGIC
+        out += struct.pack("<i", len(text))
+        out += text
+        out += struct.pack("<i", len(self.refs))
+        for name, length in self.refs:
+            nb = name.encode() + b"\x00"
+            out += struct.pack("<i", len(nb))
+            out += nb
+            out += struct.pack("<i", length)
+        return bytes(out)
+
+    @staticmethod
+    def decode(buf: bytes, pos: int = 0) -> Tuple["BamHeader", int]:
+        """Parse the header block; returns (header, offset_after_header)."""
+        if buf[pos : pos + 4] != MAGIC:
+            raise BamError("missing BAM magic")
+        (l_text,) = struct.unpack_from("<i", buf, pos + 4)
+        p = pos + 8
+        text = buf[p : p + l_text].split(b"\x00", 1)[0].decode()
+        p += l_text
+        (n_ref,) = struct.unpack_from("<i", buf, p)
+        p += 4
+        refs: List[Tuple[str, int]] = []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack_from("<i", buf, p)
+            p += 4
+            name = buf[p : p + l_name - 1].decode()
+            p += l_name
+            (l_ref,) = struct.unpack_from("<i", buf, p)
+            p += 4
+            refs.append((name, l_ref))
+        return BamHeader(text, refs), p
+
+
+@dataclass
+class BamRecord:
+    """One alignment; fixed fields decoded, variable tails as raw bytes.
+
+    ``raw`` holds the record body (everything after block_size), so the
+    record can be re-encoded or hashed without any header — the
+    LazyBAMRecordFactory stance (LazyBAMRecordFactory.java:31-51).
+    """
+
+    refid: int
+    pos: int  # 0-based leftmost, -1 if unplaced
+    mapq: int
+    bin: int
+    flag: int
+    next_refid: int
+    next_pos: int
+    tlen: int
+    raw: bytes  # full record body (fixed prefix + tails), header-free
+
+    @property
+    def l_read_name(self) -> int:
+        return self.raw[8]
+
+    @property
+    def n_cigar_op(self) -> int:
+        return struct.unpack_from("<H", self.raw, 12)[0]
+
+    @property
+    def l_seq(self) -> int:
+        return struct.unpack_from("<I", self.raw, 16)[0]
+
+    @property
+    def read_name(self) -> str:
+        return self.raw[32 : 32 + self.l_read_name - 1].decode()
+
+    @property
+    def cigar_raw(self) -> np.ndarray:
+        off = 32 + self.l_read_name
+        return np.frombuffer(
+            self.raw, dtype="<u4", count=self.n_cigar_op, offset=off
+        )
+
+    @property
+    def cigar(self) -> List[Tuple[int, str]]:
+        return [
+            (int(c) >> 4, CIGAR_OPS[int(c) & 0xF]) for c in self.cigar_raw
+        ]
+
+    def cigar_string(self) -> str:
+        ops = self.cigar
+        return "*" if not ops else "".join(f"{n}{op}" for n, op in ops)
+
+    @property
+    def seq(self) -> str:
+        l_seq = self.l_seq
+        if l_seq == 0:
+            return "*"
+        off = 32 + self.l_read_name + 4 * self.n_cigar_op
+        packed = self.raw[off : off + (l_seq + 1) // 2]
+        out = []
+        for i in range(l_seq):
+            b = packed[i // 2]
+            out.append(SEQ_DECODE[(b >> 4) if i % 2 == 0 else (b & 0xF)])
+        return "".join(out)
+
+    @property
+    def qual(self) -> bytes:
+        l_seq = self.l_seq
+        off = 32 + self.l_read_name + 4 * self.n_cigar_op + (l_seq + 1) // 2
+        return self.raw[off : off + l_seq]
+
+    @property
+    def tags_raw(self) -> bytes:
+        l_seq = self.l_seq
+        off = 32 + self.l_read_name + 4 * self.n_cigar_op + (l_seq + 1) // 2 + l_seq
+        return self.raw[off:]
+
+    @property
+    def is_unmapped(self) -> bool:
+        return bool(self.flag & FLAG_UNMAPPED)
+
+    @property
+    def alignment_start(self) -> int:
+        """1-based leftmost coordinate (htsjdk getAlignmentStart), 0 if unplaced."""
+        return self.pos + 1
+
+    def reference_length(self) -> int:
+        """Span on the reference from the CIGAR (for BAI bin computation)."""
+        span = 0
+        for n, op in self.cigar:
+            if op in "MDN=X":
+                span += n
+        return span
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", len(self.raw)) + self.raw
+
+
+def decode_record(buf: bytes, pos: int = 0) -> Tuple[BamRecord, int]:
+    """Decode one record at ``pos``; returns (record, offset_after)."""
+    if pos + 4 > len(buf):
+        raise BamError("truncated record: no block_size")
+    (block_size,) = struct.unpack_from("<I", buf, pos)
+    body = buf[pos + 4 : pos + 4 + block_size]
+    if len(body) != block_size:
+        raise BamError("truncated record body")
+    (refid, p, _lname, mapq, bin_, _ncig, flag, _lseq, nrefid, npos, tlen) = (
+        _FIXED.unpack_from(body, 0)
+    )
+    rec = BamRecord(refid, p, mapq, bin_, flag, nrefid, npos, tlen, bytes(body))
+    return rec, pos + 4 + block_size
+
+
+def iter_records(buf: bytes, pos: int = 0, end: Optional[int] = None) -> Iterator[BamRecord]:
+    end = len(buf) if end is None else end
+    while pos < end:
+        rec, pos = decode_record(buf, pos)
+        yield rec
+
+
+def build_record(
+    name: str,
+    refid: int,
+    pos: int,
+    mapq: int,
+    flag: int,
+    cigar: Sequence[Tuple[int, str]],
+    seq: str,
+    qual: Union[bytes, str],
+    next_refid: int = -1,
+    next_pos: int = -1,
+    tlen: int = 0,
+    tags: bytes = b"",
+) -> BamRecord:
+    """Construct a record from logical fields (the encode path)."""
+    name_b = name.encode() + b"\x00"
+    if len(name_b) > 255:
+        raise BamError("read name too long")
+    cigar_b = b"".join(
+        struct.pack("<I", (n << 4) | _CIGAR_ENCODE[op]) for n, op in cigar
+    )
+    if seq == "*":
+        l_seq = 0
+        seq_b = b""
+    else:
+        l_seq = len(seq)
+        nibbles = [_SEQ_ENCODE.get(c.upper(), 15) for c in seq]
+        if l_seq % 2:
+            nibbles.append(0)
+        seq_b = bytes(
+            (nibbles[i] << 4) | nibbles[i + 1] for i in range(0, len(nibbles), 2)
+        )
+    if isinstance(qual, str):
+        qual_b = (
+            b"\xff" * l_seq if qual == "*" else bytes(ord(c) - 33 for c in qual)
+        )
+    else:
+        qual_b = qual if qual else b"\xff" * l_seq
+    bin_ = reg2bin(pos, pos + max(1, _ref_span(cigar))) if pos >= 0 else 4680
+    body = (
+        _FIXED.pack(
+            refid,
+            pos,
+            len(name_b),
+            mapq,
+            bin_,
+            len(cigar),
+            flag,
+            l_seq,
+            next_refid,
+            next_pos,
+            tlen,
+        )
+        + name_b
+        + cigar_b
+        + seq_b
+        + qual_b
+        + tags
+    )
+    return BamRecord(
+        refid, pos, mapq, bin_, flag, next_refid, next_pos, tlen, body
+    )
+
+
+def _ref_span(cigar: Sequence[Tuple[int, str]]) -> int:
+    return sum(n for n, op in cigar if op in "MDN=X")
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """UCSC binning scheme (SAM spec §5.3)."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Sort keys (reference BAMRecordReader.java:81-121, exact semantics)
+# ---------------------------------------------------------------------------
+
+
+def key0(refidx: int, pos0: int) -> int:
+    """``(long)refIdx << 32 | alignmentStart0`` with Java int→long sign
+    extension of both operands (BAMRecordReader.java:119-121)."""
+    lo = pos0 & 0xFFFFFFFFFFFFFFFF if pos0 < 0 else pos0
+    v = ((refidx << 32) | lo) & 0xFFFFFFFFFFFFFFFF
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def alignment_key(rec: BamRecord) -> int:
+    """The shuffle/sort key.  Mapped: ``refIdx<<32 | pos0``.  Unmapped (or
+    negative refIdx/start): ``INT_MAX<<32 | (int)murmur3(...)`` so they sort
+    last but spread over partitions (BAMRecordReader.java:81-117).  The hash
+    input is the record's *variable* section only — htsjdk's
+    ``getVariableBinaryRepresentation()`` is the bytes after the 32-byte fixed
+    prefix (BAMRecordReader.java:100-102)."""
+    if not (rec.is_unmapped or rec.refid < 0 or rec.alignment_start < 0):
+        return key0(rec.refid, rec.pos)
+    h = murmurhash3_bytes(rec.raw[32:], 0)
+    h32 = h & 0xFFFFFFFF
+    h32_signed = h32 - (1 << 32) if h32 >= 1 << 31 else h32
+    return key0(INT_MAX, h32_signed)
+
+
+# ---------------------------------------------------------------------------
+# Structure-of-arrays batch decode: the device tensor layout
+# ---------------------------------------------------------------------------
+
+# Column order of the fixed-field SoA matrix produced by soa_decode.
+SOA_FIELDS = (
+    "refid",
+    "pos",
+    "flag",
+    "mapq",
+    "bin",
+    "n_cigar_op",
+    "l_read_name",
+    "l_seq",
+    "next_refid",
+    "next_pos",
+    "tlen",
+    "rec_off",  # byte offset of the record body in the ragged sideband
+    "rec_len",  # body length
+)
+SOA_NCOLS = len(SOA_FIELDS)
+
+
+def record_offsets(buf: np.ndarray, pos: int = 0, end: Optional[int] = None) -> np.ndarray:
+    """Offsets of each record's block_size word: the record-boundary chain.
+
+    This is the serial prefix walk the device kernels re-derive with a scan
+    (SURVEY.md §7 stage 4); kept here as the oracle.
+    """
+    end = len(buf) if end is None else end
+    offs = []
+    while pos + 4 <= end:
+        block_size = (
+            int(buf[pos])
+            | (int(buf[pos + 1]) << 8)
+            | (int(buf[pos + 2]) << 16)
+            | (int(buf[pos + 3]) << 24)
+        )
+        offs.append(pos)
+        pos += 4 + block_size
+    if pos != end:
+        raise BamError(f"record chain misaligned: ended at {pos} != {end}")
+    return np.asarray(offs, dtype=np.int64)
+
+
+def soa_decode(data: bytes, offsets: np.ndarray) -> dict:
+    """Vectorized fixed-field gather → SoA dict of int32/int64 arrays.
+
+    ``data`` is the uncompressed BAM record stream, ``offsets`` the
+    block_size-word offsets.  Variable-length tails stay in ``data`` (the
+    ragged sideband), addressed by ``rec_off``/``rec_len``.
+    """
+    a = np.frombuffer(data, dtype=np.uint8)
+    offs = offsets.astype(np.int64)
+
+    def u32(at: np.ndarray) -> np.ndarray:
+        return (
+            a[at].astype(np.uint32)
+            | (a[at + 1].astype(np.uint32) << 8)
+            | (a[at + 2].astype(np.uint32) << 16)
+            | (a[at + 3].astype(np.uint32) << 24)
+        )
+
+    def i32(at: np.ndarray) -> np.ndarray:
+        return u32(at).astype(np.int32)
+
+    def u16(at: np.ndarray) -> np.ndarray:
+        return (
+            a[at].astype(np.uint16) | (a[at + 1].astype(np.uint16) << 8)
+        ).astype(np.int32)
+
+    body = offs + 4
+    rec_len = u32(offs).astype(np.int64)
+    return {
+        "refid": i32(body + 0),
+        "pos": i32(body + 4),
+        "l_read_name": a[body + 8].astype(np.int32),
+        "mapq": a[body + 9].astype(np.int32),
+        "bin": u16(body + 10),
+        "n_cigar_op": u16(body + 12),
+        "flag": u16(body + 14),
+        "l_seq": i32(body + 16),
+        "next_refid": i32(body + 20),
+        "next_pos": i32(body + 24),
+        "tlen": i32(body + 28),
+        "rec_off": body,
+        "rec_len": rec_len,
+    }
+
+
+def soa_keys(soa: dict, data: bytes) -> np.ndarray:
+    """int64 sort keys for a decoded SoA batch (oracle path).
+
+    Mapped rows use the closed-form key; unmapped rows hash their raw bytes
+    (host loop — the batched C++/device variants must match this)."""
+    refid = soa["refid"].astype(np.int64)
+    pos = soa["pos"].astype(np.int64)
+    flag = soa["flag"]
+    # No masking of pos: Java ORs the sign-extended 32-bit int into the long
+    # (BAMRecordReader.java:119-121), so pos0 == -1 floods the high word.
+    keys = (refid << np.int64(32)) | pos
+    unmapped = (
+        ((flag & FLAG_UNMAPPED) != 0) | (refid < 0) | (pos + 1 < 0)
+    )
+    if np.any(unmapped):
+        idx = np.nonzero(unmapped)[0]
+        for i in idx:
+            off = int(soa["rec_off"][i])
+            ln = int(soa["rec_len"][i])
+            h = murmurhash3_bytes(data[off + 32 : off + ln], 0)
+            h32 = h & 0xFFFFFFFF
+            h32s = h32 - (1 << 32) if h32 >= 1 << 31 else h32
+            keys[i] = key0(INT_MAX, h32s)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# Whole-file helpers
+# ---------------------------------------------------------------------------
+
+
+def read_bam(path_or_bytes: Union[str, bytes]) -> Tuple[BamHeader, List[BamRecord]]:
+    from . import bgzf
+
+    if isinstance(path_or_bytes, str):
+        with open(path_or_bytes, "rb") as f:
+            raw = f.read()
+    else:
+        raw = path_or_bytes
+    data = bgzf.decompress_all(raw)
+    header, p = BamHeader.decode(data)
+    return header, list(iter_records(data, p))
+
+
+def write_bam(
+    stream: BinaryIO,
+    header: BamHeader,
+    records: Iterator[BamRecord],
+    level: int = 6,
+    append_terminator: bool = True,
+    write_header: bool = True,
+) -> None:
+    from . import bgzf
+
+    w = bgzf.BgzfWriter(stream, level=level, append_terminator=append_terminator)
+    if write_header:
+        w.write(header.encode())
+    for rec in records:
+        w.write(rec.encode())
+    w.close()

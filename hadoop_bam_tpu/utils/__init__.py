@@ -1,0 +1,2 @@
+from .murmur3 import murmurhash3_bytes, murmurhash3_chars  # noqa: F401
+from .intervals import Interval, parse_intervals  # noqa: F401

@@ -1,0 +1,56 @@
+"""Genomic interval parsing: the `chr:start-stop[,...]` property format.
+
+Reference semantics: util/IntervalUtil.java:27-53 — a comma-separated list of
+``contig:start-stop`` (1-based, inclusive) intervals stored in a single
+configuration property (e.g. ``hadoopbam.bam.intervals``,
+BAMInputFormat.java:89-111).  The last ``:`` splits contig from the range so
+contig names may themselves contain ``:``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+class FormatError(ValueError):
+    """Reference FormatException.java equivalent."""
+
+
+@dataclass(frozen=True, order=True)
+class Interval:
+    contig: str
+    start: int  # 1-based inclusive
+    end: int  # 1-based inclusive
+
+    def __str__(self) -> str:
+        return f"{self.contig}:{self.start}-{self.end}"
+
+    def overlaps(self, contig: str, start: int, end: int) -> bool:
+        return contig == self.contig and start <= self.end and end >= self.start
+
+
+def parse_interval(text: str) -> Interval:
+    colon = text.rfind(":")
+    if colon <= 0 or colon == len(text) - 1:
+        raise FormatError(f"no contig:start-stop in interval '{text}'")
+    contig = text[:colon]
+    rng = text[colon + 1 :]
+    dash = rng.find("-")
+    if dash <= 0 or dash == len(rng) - 1:
+        raise FormatError(f"no start-stop in interval '{text}'")
+    try:
+        start = int(rng[:dash])
+        end = int(rng[dash + 1 :])
+    except ValueError as e:
+        raise FormatError(f"non-integer bound in interval '{text}'") from e
+    if start < 1 or end < start:
+        raise FormatError(f"invalid range in interval '{text}'")
+    return Interval(contig, start, end)
+
+
+def parse_intervals(prop: Optional[str]) -> Optional[List[Interval]]:
+    """Parse the comma-separated property value; None/empty → None."""
+    if not prop:
+        return None
+    return [parse_interval(part) for part in prop.split(",")]

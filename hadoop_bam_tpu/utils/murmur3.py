@@ -1,0 +1,149 @@
+"""First-64-bits-of-MurmurHash3_x64_128, with the reference's exact semantics.
+
+The reference (util/MurmurHash3.java:32-171) implements MurmurHash3_x64_128 and
+returns ``h1`` only.  It also deviates from canonical murmur3 in one line of
+the mixing loop (``h2 = h2 << 31 | h1 >>> 33`` — the right-shift reads *h1*
+where canonical murmur reads *h2*).  Because these hashes become sort keys for
+unmapped reads (BAMRecordReader.java:97-110) and unknown VCF contigs
+(VCFRecordReader.java:200-204), we reproduce the reference's bit-for-bit
+behavior, quirk included, so record orderings match across frameworks.
+
+Two variants, as in the reference:
+- ``murmurhash3_bytes``: hashes raw bytes (used for undecoded BAM records);
+- ``murmurhash3_chars``: hashes UTF-16 code units of a string directly
+  (NOT equivalent to hashing the UTF-8 bytes; MurmurHash3.java:105-108).
+
+Both return a Java-``long``-style signed 64-bit int.
+"""
+
+from __future__ import annotations
+
+_M = (1 << 64) - 1
+_C1 = 0x87C37B91114253D5
+_C2 = 0x4CF5AD432745937F
+
+
+def _signed64(x: int) -> int:
+    x &= _M
+    return x - (1 << 64) if x >= 1 << 63 else x
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M
+
+
+def _fmix(k: int) -> int:
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & _M
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & _M
+    k ^= k >> 33
+    return k
+
+
+def _mix(h1: int, h2: int, k1: int, k2: int) -> tuple[int, int]:
+    k1 = (k1 * _C1) & _M
+    k1 = _rotl(k1, 31)
+    k1 = (k1 * _C2) & _M
+    h1 ^= k1
+    h1 = _rotl(h1, 27)
+    h1 = (h1 + h2) & _M
+    h1 = (h1 * 5 + 0x52DCE729) & _M
+    k2 = (k2 * _C2) & _M
+    k2 = _rotl(k2, 33)
+    k2 = (k2 * _C1) & _M
+    h2 ^= k2
+    # Reference quirk: the right-shift operand is h1, not h2
+    # (MurmurHash3.java:60 / :146).  Kept for key parity.
+    h2 = ((h2 << 31) | (h1 >> 33)) & _M
+    h2 = (h2 + h1) & _M
+    h2 = (h2 * 5 + 0x38495AB5) & _M
+    return h1, h2
+
+
+def _finish(h1: int, h2: int, length: int) -> int:
+    h1 ^= length
+    h2 ^= length
+    h1 = (h1 + h2) & _M
+    h2 = (h2 + h1) & _M
+    h1 = _fmix(h1)
+    h2 = _fmix(h2)
+    h1 = (h1 + h2) & _M
+    return _signed64(h1)
+
+
+def murmurhash3_bytes(key: bytes, seed: int = 0) -> int:
+    """Hash raw bytes (reference MurmurHash3.java:32-103)."""
+    seed &= _M
+    h1 = h2 = seed
+    length = len(key)
+    nblocks = length // 16
+    for i in range(nblocks):
+        off = i * 16
+        k1 = int.from_bytes(key[off : off + 8], "little")
+        k2 = int.from_bytes(key[off + 8 : off + 16], "little")
+        h1, h2 = _mix(h1, h2, k1, k2)
+
+    tail = key[nblocks * 16 :]
+    k1 = k2 = 0
+    n = length & 15
+    if n > 8:
+        k2 = int.from_bytes(tail[8:n], "little")
+        k2 = (k2 * _C2) & _M
+        k2 = _rotl(k2, 33)
+        k2 = (k2 * _C1) & _M
+        h2 ^= k2
+    if n > 0:
+        k1 = int.from_bytes(tail[: min(n, 8)], "little")
+        k1 = (k1 * _C1) & _M
+        k1 = _rotl(k1, 31)
+        k1 = (k1 * _C2) & _M
+        h1 ^= k1
+    return _finish(h1, h2, length)
+
+
+def murmurhash3_chars(chars: str, seed: int = 0) -> int:
+    """Hash UTF-16 code units directly (reference MurmurHash3.java:105-171).
+
+    Astral characters become surrogate pairs, exactly as Java's char-indexed
+    loop sees them."""
+    enc = chars.encode("utf-16-le", "surrogatepass")
+    units = [int.from_bytes(enc[i : i + 2], "little") for i in range(0, len(enc), 2)]
+    seed &= _M
+    h1 = h2 = seed
+    length = len(units)
+    nblocks = length // 8
+    for i in range(nblocks):
+        i0 = i * 8
+        k1 = (
+            units[i0]
+            | units[i0 + 1] << 16
+            | units[i0 + 2] << 32
+            | units[i0 + 3] << 48
+        )
+        k2 = (
+            units[i0 + 4]
+            | units[i0 + 5] << 16
+            | units[i0 + 6] << 32
+            | units[i0 + 7] << 48
+        )
+        h1, h2 = _mix(h1, h2, k1, k2)
+
+    tail = units[nblocks * 8 :]
+    k1 = k2 = 0
+    n = length & 7
+    if n > 4:
+        for j in range(4, n):
+            k2 |= tail[j] << (16 * (j - 4))
+        k2 = (k2 * _C2) & _M
+        k2 = _rotl(k2, 33)
+        k2 = (k2 * _C1) & _M
+        h2 ^= k2
+    if n > 0:
+        for j in range(min(n, 4)):
+            k1 |= tail[j] << (16 * j)
+        k1 = (k1 * _C1) & _M
+        k1 = _rotl(k1, 31)
+        k1 = (k1 * _C2) & _M
+        h1 ^= k1
+    return _finish(h1, h2, length)

@@ -1,0 +1,66 @@
+"""Part-file conventions: globbing, _SUCCESS markers, concat helpers.
+
+Mirrors the reference's util/NIOFileUtil.java: the ``part-[mr]-NNNNN`` output
+glob (:24), sorted part listing (:70-92), and delete-recursive helpers, plus
+the `_SUCCESS` completeness check used by the mergers
+(util/SAMFileMerger.java:50-54).  The part file is also the restart unit for
+elastic re-execution (SURVEY.md §5 checkpoint notes).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import List, Union
+
+PathLike = Union[str, os.PathLike]
+
+PARTS_GLOB = "part-[mr]-*"  # reference util/NIOFileUtil.java:24
+_PART_RE = re.compile(r"^part-[mr]-\d{5}.*$")
+SUCCESS_MARKER = "_SUCCESS"
+
+
+def as_path(p: PathLike) -> Path:
+    return Path(p)
+
+
+def list_parts(directory: PathLike) -> List[Path]:
+    """Sorted list of part files in a job output directory."""
+    d = as_path(directory)
+    parts = sorted(x for x in d.iterdir() if _PART_RE.match(x.name))
+    return parts
+
+
+def check_success(directory: PathLike) -> None:
+    """Raise if the job did not complete (no _SUCCESS marker) —
+    reference util/SAMFileMerger.java:50-54 semantics."""
+    d = as_path(directory)
+    if not (d / SUCCESS_MARKER).exists():
+        raise FileNotFoundError(
+            f"no {SUCCESS_MARKER} marker in {d}: job output incomplete"
+        )
+
+
+def write_success(directory: PathLike) -> None:
+    (as_path(directory) / SUCCESS_MARKER).touch()
+
+
+def delete_recursive(directory: PathLike) -> None:
+    shutil.rmtree(as_path(directory), ignore_errors=True)
+
+
+def concat_files(sources: List[PathLike], out_stream) -> int:
+    """Append each source file's bytes to an open binary stream; returns total
+    bytes copied (merge data plane, util/NIOFileUtil.java:94-106 equivalent)."""
+    total = 0
+    for src in sources:
+        with open(src, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                out_stream.write(chunk)
+                total += len(chunk)
+    return total

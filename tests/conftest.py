@@ -1,0 +1,35 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests run
+against ``--xla_force_host_platform_device_count=8`` on CPU, mirroring how the
+driver dry-runs the multi-chip path (``__graft_entry__.dryrun_multichip``).
+This must happen before any JAX backend initialization, and must override the
+axon TPU plugin the container environment registers at interpreter start.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+REFERENCE_RESOURCES = pathlib.Path("/root/reference/src/test/resources")
+
+
+@pytest.fixture(scope="session")
+def reference_resources() -> pathlib.Path:
+    """Directory of htsjdk/samtools-written fixtures used as external oracles
+    (read-only; tests needing them skip when absent)."""
+    if not REFERENCE_RESOURCES.is_dir():
+        pytest.skip("reference test resources not available")
+    return REFERENCE_RESOURCES

@@ -1,0 +1,180 @@
+import io
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.spec import bam, bgzf
+from hadoop_bam_tpu.utils.murmur3 import murmurhash3_bytes
+
+
+def synth_header() -> bam.BamHeader:
+    return bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n@SQ\tSN:chr21\tLN:46709983\n@SQ\tSN:chr22\tLN:50818468",
+        [("chr21", 46709983), ("chr22", 50818468)],
+    )
+
+
+def synth_records(n=50):
+    recs = []
+    for i in range(n):
+        recs.append(
+            bam.build_record(
+                name=f"read{i:05d}",
+                refid=i % 2,
+                pos=1000 * (n - i),
+                mapq=60,
+                flag=bam.FLAG_PAIRED,
+                cigar=[(100, "M")],
+                seq="ACGT" * 25,
+                qual=bytes([30] * 100),
+                next_refid=i % 2,
+                next_pos=1000 * (n - i) + 150,
+                tlen=250,
+            )
+        )
+    # Two unplaced unmapped records, as in the reference's synthetic fixtures
+    # (BAMTestUtil.java:16-65 recipe).
+    for i in range(2):
+        recs.append(
+            bam.build_record(
+                name=f"unmapped{i}",
+                refid=-1,
+                pos=-1,
+                mapq=0,
+                flag=bam.FLAG_UNMAPPED,
+                cigar=[],
+                seq="ACGTACGT",
+                qual=bytes([20] * 8),
+            )
+        )
+    return recs
+
+
+def test_header_encode_decode_roundtrip():
+    hdr = synth_header()
+    blob = hdr.encode()
+    hdr2, off = bam.BamHeader.decode(blob)
+    assert off == len(blob)
+    assert hdr2.text == hdr.text
+    assert hdr2.refs == hdr.refs
+
+
+def test_sort_order_rewrite():
+    hdr = synth_header()
+    h2 = hdr.with_sort_order("coordinate")
+    assert h2.sort_order() == "coordinate"
+    assert "SO:unsorted" not in h2.text
+    # No @HD at all → one is inserted (GetSortedBAMHeader semantics).
+    h3 = bam.BamHeader("@SQ\tSN:c\tLN:10", [("c", 10)]).with_sort_order("coordinate")
+    assert h3.sort_order() == "coordinate"
+
+
+def test_record_roundtrip_fields():
+    recs = synth_records(10)
+    blob = b"".join(r.encode() for r in recs)
+    out = list(bam.iter_records(blob))
+    assert len(out) == len(recs)
+    for a, b in zip(recs, out):
+        assert a.raw == b.raw
+        assert b.read_name == a.read_name
+        assert b.cigar_string() == a.cigar_string()
+        assert b.seq == a.seq
+        assert b.qual == a.qual
+
+
+def test_seq_odd_length_and_star():
+    r = bam.build_record("r", 0, 5, 0, 0, [(5, "M")], "ACGTN", bytes([1] * 5))
+    assert r.seq == "ACGTN"
+    r2 = bam.build_record("r2", -1, -1, 0, 4, [], "*", "*")
+    assert r2.seq == "*"
+    assert r2.l_seq == 0
+
+
+def test_keys_match_reference_semantics():
+    # Mapped: refIdx<<32 | pos0 (BAMRecordReader.java:119-121).
+    assert bam.key0(3, 1000) == (3 << 32) | 1000
+    # Java sign extension quirk: negative pos0 floods the high word.
+    assert bam.key0(bam.INT_MAX, -5) == -5
+    r = bam.build_record("q", 1, 99, 60, 0, [(4, "M")], "ACGT", bytes([9] * 4))
+    assert bam.alignment_key(r) == (1 << 32) | 99
+    # Unmapped: INT_MAX<<32 | (int)murmur3(variable section only — htsjdk's
+    # getVariableBinaryRepresentation is the bytes after the fixed prefix).
+    u = bam.build_record("u", -1, -1, 0, bam.FLAG_UNMAPPED, [], "AC", bytes([9] * 2))
+    h32 = murmurhash3_bytes(u.raw[32:], 0) & 0xFFFFFFFF
+    h32s = h32 - (1 << 32) if h32 >= 1 << 31 else h32
+    assert bam.alignment_key(u) == bam.key0(bam.INT_MAX, h32s)
+    # Unmapped-with-position still goes to the murmur branch: getKey's mapped
+    # condition requires the unmapped flag to be clear
+    # (BAMRecordReader.java:85-86).
+    up = bam.build_record("up", 0, 500, 0, bam.FLAG_UNMAPPED, [], "AC", bytes([9] * 2))
+    hu = murmurhash3_bytes(up.raw[32:], 0) & 0xFFFFFFFF
+    hus = hu - (1 << 32) if hu >= 1 << 31 else hu
+    assert bam.alignment_key(up) == bam.key0(bam.INT_MAX, hus)
+    # Mapped record with pos == -1: Java's sign extension floods the high
+    # word, so the whole key collapses to -1.  soa_keys must agree.
+    m = bam.build_record("m", 2, -1, 60, 0, [], "AC", bytes([9] * 2))
+    assert bam.alignment_key(m) == -1
+    blob = m.encode()
+    soa1 = bam.soa_decode(blob, np.array([0]))
+    assert bam.soa_keys(soa1, blob)[0] == -1
+
+
+def test_soa_decode_matches_object_decode():
+    recs = synth_records(30)
+    blob = b"".join(r.encode() for r in recs)
+    offs = bam.record_offsets(np.frombuffer(blob, dtype=np.uint8))
+    assert len(offs) == len(recs)
+    soa = bam.soa_decode(blob, offs)
+    for i, r in enumerate(recs):
+        assert soa["refid"][i] == r.refid
+        assert soa["pos"][i] == r.pos
+        assert soa["flag"][i] == r.flag
+        assert soa["mapq"][i] == r.mapq
+        assert soa["l_seq"][i] == r.l_seq
+        assert soa["n_cigar_op"][i] == r.n_cigar_op
+        assert soa["next_refid"][i] == r.next_refid
+        assert soa["tlen"][i] == r.tlen
+    keys = bam.soa_keys(soa, blob)
+    keys_obj = np.array([bam.alignment_key(r) for r in recs], dtype=np.int64)
+    assert np.array_equal(keys, keys_obj)
+
+
+def test_write_read_bam_file_roundtrip(tmp_path):
+    hdr, recs = synth_header(), synth_records(20)
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    hdr2, recs2 = bam.read_bam(buf.getvalue())
+    assert hdr2.text == hdr.text and hdr2.refs == hdr.refs
+    assert [r.raw for r in recs2] == [r.raw for r in recs]
+
+
+def test_reg2bin():
+    # Spec examples: whole-genome bin 0; small windows land in leaf bins.
+    assert bam.reg2bin(0, 1) == 4681
+    assert bam.reg2bin(0, 1 << 14) == 4681
+    assert bam.reg2bin(0, (1 << 14) + 1) == 585
+    assert bam.reg2bin(1 << 26, (1 << 26) + 1) == 4681 + (1 << 12)
+
+
+class TestReferenceFixture:
+    def test_decode_reference_bam(self, reference_resources):
+        hdr, recs = bam.read_bam(str(reference_resources / "test.bam"))
+        assert hdr.n_refs == 84
+        assert hdr.refs[0] == ("1", 249250621)
+        assert len(recs) == 2277
+        # Re-encoding every record must reproduce the exact byte stream.
+        raw = (reference_resources / "test.bam").read_bytes()
+        data = bgzf.decompress_all(raw)
+        _, p = bam.BamHeader.decode(data)
+        assert b"".join(r.encode() for r in recs) == data[p:]
+
+    def test_soa_keys_on_reference_bam(self, reference_resources):
+        raw = (reference_resources / "test.bam").read_bytes()
+        data = bgzf.decompress_all(raw)
+        _, p = bam.BamHeader.decode(data)
+        offs = bam.record_offsets(np.frombuffer(data, dtype=np.uint8), p)
+        soa = bam.soa_decode(data, offs)
+        keys = bam.soa_keys(soa, data)
+        recs = list(bam.iter_records(data, p))
+        keys_obj = np.array([bam.alignment_key(r) for r in recs], dtype=np.int64)
+        assert np.array_equal(keys, keys_obj)

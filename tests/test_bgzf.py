@@ -1,0 +1,100 @@
+import io
+import os
+
+import pytest
+
+from hadoop_bam_tpu.spec import bgzf
+
+
+def make_bgzf(payload: bytes, terminator: bool = True, level: int = 6) -> bytes:
+    buf = io.BytesIO()
+    with bgzf.BgzfWriter(buf, level=level, append_terminator=terminator) as w:
+        w.write(payload)
+    return buf.getvalue()
+
+
+def test_roundtrip_small():
+    data = b"hello bgzf world" * 100
+    blob = make_bgzf(data)
+    assert bgzf.decompress_all(blob) == data
+
+
+def test_roundtrip_multiblock():
+    data = os.urandom(300_000)  # forces >4 blocks and the stored-block path
+    blob = make_bgzf(data, level=1)
+    blocks = bgzf.scan_blocks(blob)
+    assert len(blocks) >= 5
+    assert bgzf.decompress_all(blob) == data
+
+
+def test_terminator_semantics():
+    # Headerless-part mode omits the terminator so parts concatenate
+    # (reference BGZFCompressionOutputStream.java:9-15,43-46).
+    data_a, data_b = b"A" * 70000, b"B" * 1000
+    part_a = make_bgzf(data_a, terminator=False)
+    part_b = make_bgzf(data_b, terminator=False)
+    merged = part_a + part_b + bgzf.TERMINATOR
+    assert bgzf.decompress_all(merged) == data_a + data_b
+    assert merged.endswith(bgzf.TERMINATOR)
+    assert len(bgzf.TERMINATOR) == 28
+
+
+def test_find_next_block_mid_buffer():
+    data = b"x" * 50000
+    blob = make_bgzf(data, terminator=False)
+    blocks = bgzf.scan_blocks(blob)
+    # Scanning from 1 byte past a block start must find the next block,
+    # like the guesser does (BaseSplitGuesser.java:31-108).
+    for b in blocks:
+        found = bgzf.find_next_block(blob, b.coffset)
+        assert found is not None and found[0] == b.coffset
+    if len(blocks) > 1:
+        found = bgzf.find_next_block(blob, blocks[0].coffset + 1)
+        assert found is not None and found[0] == blocks[1].coffset
+
+
+def test_voffsets():
+    v = bgzf.make_voffset(123456, 789)
+    assert bgzf.split_voffset(v) == (123456, 789)
+
+
+def test_reader_seek_and_read():
+    data = bytes(range(256)) * 1000
+    blob = make_bgzf(data)
+    blocks = bgzf.scan_blocks(blob)
+    r = bgzf.BgzfReader(blob)
+    assert r.read_fully(10) == data[:10]
+    # Seek into the second block.
+    v = bgzf.make_voffset(blocks[1].coffset, 5)
+    r.seek_voffset(v)
+    start = blocks[0].usize + 5
+    assert r.read_fully(20) == data[start : start + 20]
+
+
+def test_crc_verification():
+    blob = bytearray(make_bgzf(b"payload" * 100, terminator=False))
+    blocks = bgzf.scan_blocks(bytes(blob))
+    # Corrupt one byte of compressed data.
+    blob[blocks[0].coffset + 20] ^= 0xFF
+    with pytest.raises(Exception):
+        bgzf.decompress_all(bytes(blob))
+
+
+def test_is_bgzf_sniff():
+    assert bgzf.is_bgzf(make_bgzf(b"x"))
+    import gzip
+
+    assert not bgzf.is_bgzf(gzip.compress(b"x"))
+    assert not bgzf.is_bgzf(b"plain text")
+
+
+def test_reference_fixture_chain(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    blocks = bgzf.scan_blocks(raw)
+    assert len(blocks) > 1
+    data = bgzf.decompress_all(raw)
+    assert data[:4] == b"BAM\x01"
+    # bgz VCF fixture ends with the canonical terminator.
+    vcf_bgz = (reference_resources / "HiSeq.10000.vcf.bgz").read_bytes()
+    assert vcf_bgz.endswith(bgzf.TERMINATOR)
+    assert bgzf.decompress_all(vcf_bgz).startswith(b"##fileformat=VCF")

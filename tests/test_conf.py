@@ -1,0 +1,32 @@
+from hadoop_bam_tpu import conf
+from hadoop_bam_tpu.conf import Configuration
+
+
+def test_lenient_booleans():
+    c = Configuration()
+    # reference util/ConfHelper.java:41-69 word lists, case-insensitive
+    for word in ["yes", "TRUE", "t", "Y", "1", "On", "ENABLED"]:
+        c.set("k", word)
+        assert c.get_boolean("k") is True, word
+    for word in ["no", "False", "f", "n", "0", "OFF", "disabled"]:
+        c.set("k", word)
+        assert c.get_boolean("k", True) is False, word
+    c.set("k", "bogus")
+    assert c.get_boolean("k", True) is True
+    assert c.get_boolean("k", False) is False
+    assert c.get_boolean("missing", True) is True
+
+
+def test_property_roundtrip_and_namespace():
+    c = Configuration()
+    c.set(conf.BAM_INTERVALS, "chr1:1-100")
+    assert c.get(conf.BAM_INTERVALS) == "chr1:1-100"
+    assert conf.BAM_INTERVALS == "hadoopbam.bam.intervals"
+    assert conf.ANYSAM_TRUST_EXTS == "hadoopbam.anysam.trust-exts"
+    assert conf.BACKEND == "hadoopbam.backend"
+    c.set_int("n", 42)
+    assert c.get_int("n") == 42
+    assert c.get_int("missing", 7) == 7
+    c2 = c.copy()
+    c2.set(conf.BAM_INTERVALS, "chr2:5-6")
+    assert c.get(conf.BAM_INTERVALS) == "chr1:1-100"

@@ -1,0 +1,44 @@
+import pytest
+
+from hadoop_bam_tpu.utils.intervals import (
+    FormatError,
+    Interval,
+    parse_interval,
+    parse_intervals,
+)
+
+
+def test_parse_single():
+    iv = parse_interval("chr1:100-200")
+    assert iv == Interval("chr1", 100, 200)
+
+
+def test_contig_with_colon():
+    # The *last* colon splits contig from range (util/IntervalUtil.java:33-36).
+    iv = parse_interval("HLA-DRB1*15:01:01:02:5-100")
+    assert iv.contig == "HLA-DRB1*15:01:01:02"
+    assert (iv.start, iv.end) == (5, 100)
+
+
+def test_parse_list_property():
+    ivs = parse_intervals("chr1:1-10,chr2:20-30")
+    assert ivs == [Interval("chr1", 1, 10), Interval("chr2", 20, 30)]
+    assert parse_intervals(None) is None
+    assert parse_intervals("") is None
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["chr1", "chr1:", "chr1:5", "chr1:5-", "chr1:-5", "chr1:a-b", "chr1:9-3", ":1-2"],
+)
+def test_malformed(bad):
+    with pytest.raises(FormatError):
+        parse_interval(bad)
+
+
+def test_overlaps():
+    iv = Interval("chr1", 100, 200)
+    assert iv.overlaps("chr1", 200, 300)
+    assert iv.overlaps("chr1", 50, 100)
+    assert not iv.overlaps("chr1", 201, 300)
+    assert not iv.overlaps("chr2", 100, 200)

@@ -1,0 +1,60 @@
+"""MurmurHash3 parity tests.
+
+For inputs shorter than 16 bytes the reference's implementation coincides with
+canonical MurmurHash3_x64_128 (its one quirk — ``h2 = h2<<31 | h1>>>33``,
+MurmurHash3.java:60 — sits in the 16-byte block loop), so short inputs are
+checked against published canonical ``mmh3.hash64`` vectors.  Longer inputs are
+frozen as golden values of this implementation (no JVM in the image to replay
+the Java original), plus structural property checks.
+"""
+
+from hadoop_bam_tpu.utils.murmur3 import murmurhash3_bytes, murmurhash3_chars
+
+
+def test_canonical_vectors_short_inputs():
+    # Canonical MurmurHash3_x64_128 h1 (== mmh3.hash64(x)[0]) for inputs with
+    # no 16-byte block, where the reference quirk cannot trigger.
+    assert murmurhash3_bytes(b"", 0) == 0
+    assert murmurhash3_bytes(b"foo", 0) == -2129773440516405919
+    assert murmurhash3_bytes(b"hello", 0) == -3758069500696749310
+
+
+GOLDEN_LONG = {
+    # ≥16-byte inputs exercise the block loop (reference-quirk semantics);
+    # frozen from this implementation as a regression guard.
+    b"0123456789abcdef": 2198957474731831137,
+    b"0123456789abcdef0": -4279852227908874962,
+    b"The quick brown fox jumps over the lazy dog": 3437816484488198366,
+}
+
+
+def test_golden_long_inputs():
+    for key, want in GOLDEN_LONG.items():
+        assert murmurhash3_bytes(key, 0) == want
+
+
+def test_determinism_and_seed_sensitivity():
+    data = b"ACGTACGTACGTACGTACGT"
+    assert murmurhash3_bytes(data, 0) == murmurhash3_bytes(data, 0)
+    assert murmurhash3_bytes(data, 0) != murmurhash3_bytes(data, 1)
+    assert murmurhash3_bytes(data, 0) != murmurhash3_bytes(data[:-1], 0)
+
+
+def test_signed_64bit_range():
+    for payload in [b"x", b"hello world", b"0123456789abcdef" * 5]:
+        h = murmurhash3_bytes(payload)
+        assert -(1 << 63) <= h < (1 << 63)
+
+
+def test_chars_variant():
+    # The reference hashes UTF-16 code units directly, documented as NOT
+    # equivalent to hashing the string's bytes (MurmurHash3.java:105-108).
+    s = "read/1"
+    assert murmurhash3_chars(s) != murmurhash3_bytes(s.encode())
+    # Frozen golden values (used for unknown-contig VCF keys).
+    assert murmurhash3_chars("read/1", 0) == -359035123846397584
+    assert murmurhash3_chars("chr21", 0) == -7184874498311573024
+    # Astral chars hash as surrogate pairs, like Java's char-indexed loop.
+    h = murmurhash3_chars("contig\U0001F600", 0)
+    assert isinstance(h, int)
+    assert h == murmurhash3_chars("contig😀".encode("utf-16", "surrogatepass").decode("utf-16", "surrogatepass"), 0)
