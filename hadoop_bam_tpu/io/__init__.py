@@ -1,0 +1,12 @@
+"""Host-side I/O: split planning, record readers/writers, mergers.
+
+This is the TPU build's equivalent of reference layers L3-L5 and L7: the
+file-format intelligence stays on the host (cheap, irregular); the readers
+produce batched structure-of-arrays tensors for the device pipeline instead
+of per-record iterators.
+"""
+
+from .splits import FileVirtualSplit  # noqa: F401
+from .guesser import BamSplitGuesser  # noqa: F401
+from .bam import BamInputFormat, BamOutputWriter  # noqa: F401
+from .merger import merge_bam_parts  # noqa: F401

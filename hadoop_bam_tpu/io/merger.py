@@ -1,0 +1,71 @@
+"""Post-job merge: headerless parts → one valid BAM (+merged splitting-bai).
+
+Reference util/SAMFileMerger.java:46-148 semantics: require the `_SUCCESS`
+marker, glob ``part-[mr]-*`` in order, write the header block
+(SAMOutputPreparer equivalent), concatenate the part bytes untouched (they
+carry no header and no terminator), append the BGZF terminator, and merge the
+per-part `.splitting-bai`s by shifting each part's virtual offsets by the
+byte length of everything before it (:104-148).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..spec import bam, bgzf, indices
+from ..utils import nio
+
+
+def prepare_bam_header_block(header: bam.BamHeader, level: int = 6) -> bytes:
+    """The leading BGZF stream holding magic+header+refs
+    (util/SAMOutputPreparer.java:95-127)."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    w = bgzf.BgzfWriter(buf, level=level, append_terminator=False)
+    w.write(header.encode())
+    w.close()
+    return buf.getvalue()
+
+
+def merge_bam_parts(
+    part_dir: str,
+    out_path: str,
+    header: bam.BamHeader,
+    write_splitting_bai: bool = False,
+    check_success: bool = True,
+) -> None:
+    if check_success:
+        nio.check_success(part_dir)
+    parts = nio.list_parts(part_dir)
+    header_block = prepare_bam_header_block(header)
+    part_lengths: List[int] = []
+    with open(out_path, "wb") as out:
+        out.write(header_block)
+        for p in parts:
+            with open(p, "rb") as f:
+                data = f.read()
+            out.write(data)
+            part_lengths.append(len(data))
+        out.write(bgzf.TERMINATOR)
+    total = os.path.getsize(out_path)
+
+    if write_splitting_bai:
+        part_indices: List[indices.SplittingBai] = []
+        ok = True
+        for p in parts:
+            ip = str(p) + indices.SPLITTING_BAI_EXT
+            if not os.path.exists(ip):
+                ok = False
+                break
+            part_indices.append(indices.SplittingBai.load(ip))
+        if ok and part_indices:
+            with open(out_path + indices.SPLITTING_BAI_EXT, "wb") as f:
+                indices.merge_splitting_bais(
+                    part_indices,
+                    part_lengths,
+                    header_length=len(header_block),
+                    total_length=total,
+                    out=f,
+                )

@@ -1,0 +1,45 @@
+"""Split descriptors: virtual-offset ranges over BGZF files.
+
+The FileVirtualSplit equivalent (reference FileVirtualSplit.java): a split is
+``[vstart, vend)`` in virtual-offset space over one file, optionally carrying
+interval-filter chunk pointers (FileVirtualSplit.java:91-98) so the reader can
+do bounded traversal without re-querying the index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class FileVirtualSplit:
+    path: str
+    vstart: int  # virtual offset of first record
+    vend: int  # virtual offset one past the last record byte
+    interval_chunks: Optional[List[Tuple[int, int]]] = None
+
+    @property
+    def length_estimate(self) -> int:
+        """Approximate byte length via the high 48 bits
+        (FileVirtualSplit.java:73-78)."""
+        return (self.vend >> 16) - (self.vstart >> 16)
+
+    def __repr__(self) -> str:
+        iv = f", chunks={len(self.interval_chunks)}" if self.interval_chunks else ""
+        return (
+            f"FileVirtualSplit({self.path}, {self.vstart:#x}-{self.vend:#x}{iv})"
+        )
+
+
+@dataclass
+class ByteSplit:
+    """A plain byte-range split (text formats / uncompressed files)."""
+
+    path: str
+    start: int
+    length: int
+
+    @property
+    def end(self) -> int:
+        return self.start + self.length

@@ -1,0 +1,274 @@
+"""ctypes bindings for the native host library (build-on-first-import).
+
+The shared library compiles from ``bgzf_native.cpp`` with g++ -O3 -lz the
+first time it's needed (no pybind11 in the image; plain C ABI + ctypes).  All
+entry points have pure-Python fallbacks in spec/ — ``available()`` reports
+whether the fast path loaded, and callers may pass ``native=False`` to force
+the oracle path (used by tests to cross-validate the two).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "bgzf_native.cpp")
+_LIB_NAME = "_libhbam_native.so"
+_ABI = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed: Optional[str] = None
+
+MAX_BLOCK = 0x10000
+
+
+def _build(lib_path: str) -> None:
+    with tempfile.TemporaryDirectory(dir=_HERE) as td:
+        tmp = os.path.join(td, _LIB_NAME)
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            _SRC, "-o", tmp, "-lz",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, lib_path)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32, u8p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)
+    i64p, i32p = ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)
+    lib.hbam_abi_version.restype = ctypes.c_int
+    lib.hbam_scan_blocks.restype = i64
+    lib.hbam_scan_blocks.argtypes = [u8p, i64, i64, i64p, i32p, i32p, i64]
+    lib.hbam_find_next_block.restype = i64
+    lib.hbam_find_next_block.argtypes = [u8p, i64, i64, i64]
+    lib.hbam_inflate_blocks.restype = i64
+    lib.hbam_inflate_blocks.argtypes = [
+        u8p, i64p, i32p, i64, u8p, i64p, i32p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.hbam_deflate_blocks.restype = i64
+    lib.hbam_deflate_blocks.argtypes = [
+        u8p, i64p, i64, ctypes.c_int, u8p, i32p, ctypes.c_int,
+    ]
+    lib.hbam_record_chain.restype = i64
+    lib.hbam_record_chain.argtypes = [u8p, i64, i64, i64p, i64]
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed is not None:
+            return _lib
+        lib_path = os.path.join(_HERE, _LIB_NAME)
+        try:
+            if not os.path.exists(lib_path) or os.path.getmtime(
+                lib_path
+            ) < os.path.getmtime(_SRC):
+                _build(lib_path)
+            lib = _bind(ctypes.CDLL(lib_path))
+            if lib.hbam_abi_version() != _ABI:
+                _build(lib_path)
+                lib = _bind(ctypes.CDLL(lib_path))
+            _lib = lib
+        except Exception as e:  # missing toolchain → oracle fallback
+            _load_failed = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def load_error() -> Optional[str]:
+    _get()
+    return _load_failed
+
+
+def _as_u8(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return np.ascontiguousarray(data, dtype=np.uint8)
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def _ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(ctypes.POINTER(typ))
+
+
+def default_threads() -> int:
+    return max(1, (os.cpu_count() or 1))
+
+
+def scan_blocks(data) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(coffsets i64, csizes i32, usizes i32) of the back-to-back chain."""
+    a = _as_u8(data)
+    lib = _get()
+    if lib is None:
+        from ..spec import bgzf
+
+        blocks = bgzf.scan_blocks(bytes(a))
+        return (
+            np.array([b.coffset for b in blocks], dtype=np.int64),
+            np.array([b.csize for b in blocks], dtype=np.int32),
+            np.array([b.usize for b in blocks], dtype=np.int32),
+        )
+    cap = max(16, len(a) // 64 + 2)  # min BGZF block is ~30 bytes; generous
+    while True:
+        co = np.empty(cap, dtype=np.int64)
+        cs = np.empty(cap, dtype=np.int32)
+        us = np.empty(cap, dtype=np.int32)
+        n = lib.hbam_scan_blocks(
+            _ptr(a, ctypes.c_uint8), len(a), 0,
+            _ptr(co, ctypes.c_int64), _ptr(cs, ctypes.c_int32),
+            _ptr(us, ctypes.c_int32), cap,
+        )
+        if n == -2:
+            cap *= 2
+            continue
+        if n < 0:
+            from ..spec.bgzf import BgzfError
+
+            raise BgzfError("bad BGZF chain")
+        return co[:n].copy(), cs[:n].copy(), us[:n].copy()
+
+
+def find_next_block(data, start: int, end: Optional[int] = None) -> int:
+    """Next plausible block-header offset at/after start, or -1."""
+    a = _as_u8(data)
+    end = len(a) if end is None else end
+    lib = _get()
+    if lib is None:
+        from ..spec import bgzf
+
+        found = bgzf.find_next_block(bytes(a), start)
+        return -1 if found is None or found[0] >= end else found[0]
+    return lib.hbam_find_next_block(_ptr(a, ctypes.c_uint8), len(a), start, end)
+
+
+def inflate_blocks(
+    data,
+    coffsets: np.ndarray,
+    csizes: np.ndarray,
+    usizes: np.ndarray,
+    check_crc: bool = True,
+    threads: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched inflate → (payload bytes concatenated, block start offsets).
+
+    Returns ``(out, out_offsets)`` where block i's payload is
+    ``out[out_offsets[i]:out_offsets[i+1]]`` (out_offsets has n+1 entries).
+    """
+    a = _as_u8(data)
+    n = len(coffsets)
+    out_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(usizes.astype(np.int64), out=out_offsets[1:])
+    total = int(out_offsets[-1])
+    out = np.empty(total, dtype=np.uint8)
+    lib = _get()
+    if lib is None:
+        from ..spec import bgzf
+
+        raw = bytes(a)
+        for i in range(n):
+            payload, _ = bgzf.inflate_block(raw, int(coffsets[i]), check_crc)
+            out[int(out_offsets[i]) : int(out_offsets[i + 1])] = np.frombuffer(
+                payload, dtype=np.uint8
+            )
+        return out, out_offsets
+    co = np.ascontiguousarray(coffsets, dtype=np.int64)
+    cs = np.ascontiguousarray(csizes, dtype=np.int32)
+    sizes = np.zeros(n, dtype=np.int32)
+    err = lib.hbam_inflate_blocks(
+        _ptr(a, ctypes.c_uint8), _ptr(co, ctypes.c_int64),
+        _ptr(cs, ctypes.c_int32), n, _ptr(out, ctypes.c_uint8),
+        _ptr(out_offsets, ctypes.c_int64), _ptr(sizes, ctypes.c_int32),
+        1 if check_crc else 0, threads or default_threads(),
+    )
+    if err != 0:
+        from ..spec.bgzf import BgzfError
+
+        raise BgzfError(f"inflate failed in block {err - 1}")
+    return out, out_offsets
+
+
+def deflate_blocks(
+    payload,
+    level: int = 6,
+    threads: Optional[int] = None,
+    block_payload: int = 0xFF00,
+) -> bytes:
+    """Batched BGZF compression of a byte stream (no terminator appended)."""
+    a = _as_u8(payload)
+    n = max(1, (len(a) + block_payload - 1) // block_payload) if len(a) else 0
+    if n == 0:
+        return b""
+    in_offsets = np.arange(n + 1, dtype=np.int64) * block_payload
+    in_offsets[-1] = len(a)
+    lib = _get()
+    if lib is None:
+        from ..spec import bgzf
+
+        raw = bytes(a)
+        return b"".join(
+            bgzf.compress_block(
+                raw[int(in_offsets[i]) : int(in_offsets[i + 1])], level
+            )
+            for i in range(n)
+        )
+    out = np.empty(n * MAX_BLOCK, dtype=np.uint8)
+    sizes = np.zeros(n, dtype=np.int32)
+    err = lib.hbam_deflate_blocks(
+        _ptr(a, ctypes.c_uint8), _ptr(in_offsets, ctypes.c_int64), n, level,
+        _ptr(out, ctypes.c_uint8), _ptr(sizes, ctypes.c_int32),
+        threads or default_threads(),
+    )
+    if err != 0:
+        from ..spec.bgzf import BgzfError
+
+        raise BgzfError(f"deflate failed in block {err - 1}")
+    parts = [
+        out[i * MAX_BLOCK : i * MAX_BLOCK + int(sizes[i])].tobytes()
+        for i in range(n)
+    ]
+    return b"".join(parts)
+
+
+def record_chain(data, start: int, end: Optional[int] = None) -> np.ndarray:
+    """BAM record-boundary offsets over an uncompressed stream."""
+    a = _as_u8(data)
+    end = len(a) if end is None else end
+    lib = _get()
+    if lib is None:
+        from ..spec import bam
+
+        return bam.record_offsets(a, start, end)
+    cap = max(16, (end - start) // 36 + 2)  # min record body is ~32+4 bytes
+    while True:
+        offs = np.empty(cap, dtype=np.int64)
+        n = lib.hbam_record_chain(
+            _ptr(a, ctypes.c_uint8), start, end, _ptr(offs, ctypes.c_int64), cap
+        )
+        if n == -2:
+            cap *= 2
+            continue
+        if n < 0:
+            from ..spec.bam import BamError
+
+            raise BamError(f"record chain misaligned in [{start},{end})")
+        return offs[:n].copy()
+
+
+def decompress_all(data, check_crc: bool = True, threads: Optional[int] = None) -> np.ndarray:
+    """Whole-file batched BGZF decompress → uint8 array."""
+    co, cs, us = scan_blocks(data)
+    out, _ = inflate_blocks(data, co, cs, us, check_crc=check_crc, threads=threads)
+    return out

@@ -1,0 +1,235 @@
+// Native host path: batched BGZF block codec + BAM record chain walking.
+//
+// The reference delegates its hot host work to htsjdk's native zlib
+// (BlockCompressedInputStream / BAMRecordCodec below reference L0).  This
+// library is the TPU build's equivalent: block-granular batched
+// inflate/deflate with an internal thread pool, BGZF header scanning with the
+// split-guesser's candidate rules (BaseSplitGuesser.java:31-108 semantics),
+// and the serial BAM record-boundary walk (the part that cannot be
+// vectorized until offsets are known; SURVEY.md §7 stage 4).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr int64_t kHeaderFixed = 12;  // gzip header incl. XLEN
+constexpr int64_t kFooter = 8;        // CRC32 + ISIZE
+constexpr int64_t kMaxBlock = 0x10000;
+
+inline uint16_t u16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0]) | (static_cast<uint16_t>(p[1]) << 8);
+}
+inline uint32_t u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// Parse a BGZF block header at data[pos]; returns total block size (bsize) or
+// -1.  Mirrors the subfield walk incl. the exact-XLEN-landing cancellation
+// (BaseSplitGuesser.java:80-90).
+int64_t parse_header(const uint8_t* data, int64_t len, int64_t pos) {
+  if (pos + kHeaderFixed > len) return -1;
+  const uint8_t* p = data + pos;
+  if (p[0] != 0x1f || p[1] != 0x8b || p[2] != 0x08 || p[3] != 0x04) return -1;
+  const int64_t xlen = u16(p + 10);
+  if (pos + kHeaderFixed + xlen > len) return -1;
+  int64_t sub = kHeaderFixed;
+  const int64_t end = kHeaderFixed + xlen;
+  while (sub + 4 <= end) {
+    const uint16_t slen = u16(p + sub + 2);
+    if (p[sub] == 'B' && p[sub + 1] == 'C' && slen == 2) {
+      if (sub + 6 > end) return -1;
+      const int64_t bsize = static_cast<int64_t>(u16(p + sub + 4)) + 1;
+      if (bsize < kHeaderFixed + xlen + kFooter || bsize > kMaxBlock)
+        return -1;
+      int64_t walk = sub + 6;
+      while (walk < end) {
+        if (walk + 4 > end) return -1;
+        walk += 4 + u16(p + walk + 2);
+      }
+      if (walk != end) return -1;
+      return bsize;
+    }
+    sub += 4 + slen;
+  }
+  return -1;
+}
+
+template <typename F>
+void run_parallel(int64_t n, int threads, F&& fn) {
+  if (threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      fn(i);
+    }
+  };
+  std::vector<std::thread> pool;
+  const int k = threads < n ? threads : static_cast<int>(n);
+  pool.reserve(k);
+  for (int t = 0; t < k; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Walk the back-to-back block chain from `start`.  Fills up to `max_blocks`
+// entries of (coffset, csize, usize); returns the count, or -1 on a malformed
+// chain, or -2 if max_blocks was insufficient.
+int64_t hbam_scan_blocks(const uint8_t* data, int64_t len, int64_t start,
+                         int64_t* coffsets, int32_t* csizes, int32_t* usizes,
+                         int64_t max_blocks) {
+  int64_t pos = start, n = 0;
+  while (pos < len) {
+    const int64_t bsize = parse_header(data, len, pos);
+    if (bsize < 0) return -1;
+    if (pos + bsize > len) return -1;
+    if (n >= max_blocks) return -2;
+    const uint32_t usize = u32(data + pos + bsize - 4);
+    if (usize > kMaxBlock) return -1;  // ISIZE beyond the BGZF bound
+    coffsets[n] = pos;
+    csizes[n] = static_cast<int32_t>(bsize);
+    usizes[n] = static_cast<int32_t>(usize);
+    ++n;
+    pos += bsize;
+  }
+  return n;
+}
+
+// Scan for the next plausible block header at or after `start` (guesser
+// fast path).  Returns the position, or -1 if none found before `end`.
+int64_t hbam_find_next_block(const uint8_t* data, int64_t len, int64_t start,
+                             int64_t end) {
+  if (end > len) end = len;
+  for (int64_t pos = start; pos < end; ++pos) {
+    if (data[pos] != 0x1f) continue;
+    const int64_t bsize = parse_header(data, len, pos);
+    if (bsize >= 0 && pos + bsize <= len) return pos;
+  }
+  return -1;
+}
+
+// Batched block inflate.  Each block i occupies data[coffsets[i] ..
+// coffsets[i]+csizes[i]) and inflates into out[out_offsets[i] ..).
+// out_sizes[i] receives the payload size.  Returns 0, or (1+i) for a failure
+// in block i (bad stream, ISIZE mismatch, or CRC error when check_crc).
+int64_t hbam_inflate_blocks(const uint8_t* data, const int64_t* coffsets,
+                            const int32_t* csizes, int64_t n, uint8_t* out,
+                            const int64_t* out_offsets, int32_t* out_sizes,
+                            int check_crc, int threads) {
+  std::atomic<int64_t> err(0);
+  run_parallel(n, threads, [&](int64_t i) {
+    if (err.load(std::memory_order_relaxed)) return;
+    const uint8_t* p = data + coffsets[i];
+    const int64_t bsize = csizes[i];
+    const int64_t xlen = u16(p + 10);
+    const int64_t clen = bsize - kHeaderFixed - xlen - kFooter;
+    if (clen < 0) { err = 1 + i; return; }
+    const uint32_t want_crc = u32(p + bsize - 8);
+    const uint32_t isize = u32(p + bsize - 4);
+    z_stream zs;
+    std::memset(&zs, 0, sizeof zs);
+    if (inflateInit2(&zs, -15) != Z_OK) { err = 1 + i; return; }
+    zs.next_in = const_cast<uint8_t*>(p + kHeaderFixed + xlen);
+    zs.avail_in = static_cast<uInt>(clen);
+    zs.next_out = out + out_offsets[i];
+    // Bound writes to this block's reserved slot: a lying ISIZE must fail
+    // the produced!=isize check below, not overflow into the next slot.
+    zs.avail_out = static_cast<uInt>(out_offsets[i + 1] - out_offsets[i]);
+    const int rc = inflate(&zs, Z_FINISH);
+    const uint64_t produced = zs.total_out;
+    inflateEnd(&zs);
+    if (rc != Z_STREAM_END || produced != isize) { err = 1 + i; return; }
+    if (check_crc) {
+      const uint32_t got =
+          crc32(0L, out + out_offsets[i], static_cast<uInt>(produced));
+      if (got != want_crc) { err = 1 + i; return; }
+    }
+    out_sizes[i] = static_cast<int32_t>(produced);
+  });
+  return err.load();
+}
+
+// Batched BGZF block deflate.  Payload i is in[in_offsets[i] ..
+// in_offsets[i+1]); the finished block lands at out + i*65536 with its size
+// in out_sizes[i] (caller compacts).  Returns 0 or 1+i on failure.
+int64_t hbam_deflate_blocks(const uint8_t* in, const int64_t* in_offsets,
+                            int64_t n, int level, uint8_t* out,
+                            int32_t* out_sizes, int threads) {
+  std::atomic<int64_t> err(0);
+  run_parallel(n, threads, [&](int64_t i) {
+    if (err.load(std::memory_order_relaxed)) return;
+    const uint8_t* payload = in + in_offsets[i];
+    const int64_t plen = in_offsets[i + 1] - in_offsets[i];
+    uint8_t* dst = out + i * kMaxBlock;
+    for (int lvl = level;; lvl = 0) {
+      z_stream zs;
+      std::memset(&zs, 0, sizeof zs);
+      if (deflateInit2(&zs, lvl, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) !=
+          Z_OK) { err = 1 + i; return; }
+      zs.next_in = const_cast<uint8_t*>(payload);
+      zs.avail_in = static_cast<uInt>(plen);
+      zs.next_out = dst + kHeaderFixed + 6;
+      zs.avail_out = static_cast<uInt>(kMaxBlock - kHeaderFixed - 6 - kFooter);
+      const int rc = deflate(&zs, Z_FINISH);
+      const int64_t clen = static_cast<int64_t>(zs.total_out);
+      deflateEnd(&zs);
+      if (rc == Z_STREAM_END) {
+        const int64_t bsize = kHeaderFixed + 6 + clen + kFooter;
+        // Header: magic, MTIME=0, XFL=0, OS=0xff, XLEN=6, BC subfield.
+        const uint8_t hdr[18] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0,
+                                 0,    0xff, 6,    0,    'B', 'C', 2, 0,
+                                 static_cast<uint8_t>((bsize - 1) & 0xff),
+                                 static_cast<uint8_t>(((bsize - 1) >> 8) & 0xff)};
+        std::memcpy(dst, hdr, sizeof hdr);
+        const uint32_t crc =
+            crc32(0L, payload, static_cast<uInt>(plen));
+        uint8_t* foot = dst + kHeaderFixed + 6 + clen;
+        foot[0] = crc & 0xff; foot[1] = (crc >> 8) & 0xff;
+        foot[2] = (crc >> 16) & 0xff; foot[3] = (crc >> 24) & 0xff;
+        foot[4] = plen & 0xff; foot[5] = (plen >> 8) & 0xff;
+        foot[6] = (plen >> 16) & 0xff; foot[7] = (plen >> 24) & 0xff;
+        out_sizes[i] = static_cast<int32_t>(bsize);
+        return;
+      }
+      if (lvl == 0) { err = 1 + i; return; }  // even stored didn't fit
+    }
+  });
+  return err.load();
+}
+
+// Walk the BAM record chain (block_size-prefixed records) from `start` to
+// `end` over an uncompressed byte stream.  Returns the record count, filling
+// offs (or -1 if misaligned, -2 if max insufficient).
+int64_t hbam_record_chain(const uint8_t* data, int64_t start, int64_t end,
+                          int64_t* offs, int64_t max_records) {
+  int64_t pos = start, n = 0;
+  while (pos + 4 <= end) {
+    const int64_t bs = u32(data + pos);
+    if (n >= max_records) return -2;
+    offs[n++] = pos;
+    pos += 4 + bs;
+  }
+  if (pos != end) return -1;
+  return n;
+}
+
+int hbam_abi_version() { return 1; }
+
+}  // extern "C"

@@ -349,6 +349,30 @@ def reg2bin(beg: int, end: int) -> int:
     return 0
 
 
+def read_header_stream(reader) -> "BamHeader":
+    """Parse the BAM header from a BgzfReader-like stream, leaving it
+    positioned at the first record (the shared header-skip walk used by the
+    guesser, the index builders, and the input format)."""
+    if reader.read_fully(4) != MAGIC:
+        raise BamError("missing BAM magic")
+    (l_text,) = struct.unpack("<i", reader.read_fully(4))
+    if l_text < 0:
+        raise BamError("negative l_text in BAM header")
+    text = reader.read_fully(l_text).split(b"\x00", 1)[0].decode()
+    (n_ref,) = struct.unpack("<i", reader.read_fully(4))
+    if n_ref < 0:
+        raise BamError("negative n_ref in BAM header")
+    refs: List[Tuple[str, int]] = []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack("<i", reader.read_fully(4))
+        if l_name < 1:
+            raise BamError("invalid reference name length")
+        name = reader.read_fully(l_name)[:-1].decode()
+        (l_ref,) = struct.unpack("<i", reader.read_fully(4))
+        refs.append((name, l_ref))
+    return BamHeader(text, refs)
+
+
 # ---------------------------------------------------------------------------
 # Sort keys (reference BAMRecordReader.java:81-121, exact semantics)
 # ---------------------------------------------------------------------------

@@ -194,6 +194,8 @@ def scan_blocks(data: bytes) -> List[BlockInfo]:
         if pos + bsize > len(data):
             raise BgzfError(f"truncated BGZF block at offset {pos}")
         usize = struct.unpack_from("<I", data, pos + bsize - 4)[0]
+        if usize > MAX_BLOCK_SIZE:
+            raise BgzfError(f"ISIZE {usize} beyond BGZF bound at offset {pos}")
         out.append(BlockInfo(pos, bsize, usize))
         pos += bsize
     return out

@@ -1,0 +1,489 @@
+"""Index formats: `.splitting-bai`, `.bai`, `.tbi` (tabix), `.bgzfi`.
+
+These are the persistent split-planning artifacts of the reference
+(SURVEY.md §2.1/§2.2); they double as resumable metadata — built once, reused
+every job (SplittingBAMIndexer.java:64-70).
+
+- SplittingBai: big-endian u64 virtual offsets of every g-th alignment,
+  terminated by ``fileSize << 16`` (SplittingBAMIndexer.java:229-243,286-287);
+  reader is floor/higher over the sorted set (SplittingBAMIndex.java:78-83).
+- Bai: the standard BAM index; exposes the linear index (the reference's
+  htsjdk/samtools/LinearBAMIndex.java shim) and interval→chunk-span queries
+  (the BAMFileReader.getFileSpan path used by filterByInterval,
+  BAMInputFormat.java:532-634).
+- Tabix: `.tbi` over BGZF text (VCF); interval→span queries used to filter
+  VCF splits (VCFInputFormat.java:387-471).
+- BgzfBlockIndex: `.bgzfi` — 48-bit big-endian offsets of every Nth gzip
+  block (util/BGZFBlockIndexer.java:109-127, util/BGZFBlockIndex.java:73-78).
+"""
+
+from __future__ import annotations
+
+import bisect
+import struct
+from dataclasses import dataclass, field
+from typing import BinaryIO, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import bgzf
+
+SPLITTING_BAI_EXT = ".splitting-bai"
+DEFAULT_GRANULARITY = 4096  # SplittingBAMIndexer.java:70
+BAI_MAGIC = b"BAI\x01"
+TBI_MAGIC = b"TBI\x01"
+MAX_BIN = 37450  # pseudo-bin holding file-level metadata
+BGZFI_EXT = ".bgzfi"
+
+
+# ---------------------------------------------------------------------------
+# .splitting-bai
+# ---------------------------------------------------------------------------
+
+
+class SplittingBai:
+    """Reader for the `.splitting-bai` format (sorted virtual offsets)."""
+
+    def __init__(self, voffsets: Sequence[int]):
+        if len(voffsets) < 1:
+            raise IOError(
+                "Invalid splitting BAM index: should contain at least the file size"
+            )
+        prev = -1
+        for v in voffsets:
+            if v < prev:
+                raise IOError(
+                    f"Invalid splitting BAM index; offsets not in order: "
+                    f"{prev:#x} > {v:#x}"
+                )
+            prev = v
+        self.voffsets: List[int] = list(voffsets)
+
+    @staticmethod
+    def load(source: Union[str, bytes, BinaryIO]) -> "SplittingBai":
+        if isinstance(source, str):
+            with open(source, "rb") as f:
+                raw = f.read()
+        elif isinstance(source, bytes):
+            raw = source
+        else:
+            raw = source.read()
+        if len(raw) % 8 != 0:
+            raise IOError("Invalid splitting BAM index: truncated")
+        n = len(raw) // 8
+        return SplittingBai(list(struct.unpack(f">{n}Q", raw)))
+
+    def save(self, stream: BinaryIO) -> None:
+        stream.write(struct.pack(f">{len(self.voffsets)}Q", *self.voffsets))
+
+    def prev_alignment(self, file_pos: int) -> Optional[int]:
+        """floor(filePos << 16) (SplittingBAMIndex.java:78-80)."""
+        target = file_pos << 16
+        i = bisect.bisect_right(self.voffsets, target)
+        return self.voffsets[i - 1] if i > 0 else None
+
+    def next_alignment(self, file_pos: int) -> Optional[int]:
+        """higher(filePos << 16) (SplittingBAMIndex.java:81-83)."""
+        target = file_pos << 16
+        i = bisect.bisect_right(self.voffsets, target)
+        return self.voffsets[i] if i < len(self.voffsets) else None
+
+    def bam_size(self) -> int:
+        return self.voffsets[-1] >> 16
+
+    def size(self) -> int:
+        return len(self.voffsets)
+
+
+class SplittingBaiBuilder:
+    """Incremental builder (SplittingBAMIndexer.java:186-202 semantics:
+    record the offset of alignment 0 and of every alignment whose
+    ``(count+1) % granularity == 0``; finish with ``fileSize << 16``)."""
+
+    def __init__(self, granularity: int = DEFAULT_GRANULARITY):
+        if granularity < 1:
+            raise ValueError("granularity must be >= 1")
+        self.granularity = granularity
+        self.count = 0
+        self.voffsets: List[int] = []
+
+    def process_alignment(self, virtual_offset: int) -> None:
+        if self.count == 0 or (self.count + 1) % self.granularity == 0:
+            self.voffsets.append(virtual_offset)
+        self.count += 1
+
+    def finish(self, input_size: int) -> SplittingBai:
+        self.voffsets.append(input_size << 16)
+        return SplittingBai(self.voffsets)
+
+
+def build_splitting_bai(
+    bam_path_or_bytes: Union[str, bytes],
+    granularity: int = DEFAULT_GRANULARITY,
+) -> SplittingBai:
+    """Offline construction from a raw BAM (SplittingBAMIndexer.index,
+    :248-290: skip the header blocks, then walk records tracking virtual
+    offsets)."""
+    from . import bam as bam_mod
+
+    if isinstance(bam_path_or_bytes, str):
+        with open(bam_path_or_bytes, "rb") as f:
+            raw = f.read()
+    else:
+        raw = bam_path_or_bytes
+    reader = bgzf.BgzfReader(raw)
+    bam_mod.read_header_stream(reader)
+    builder = SplittingBaiBuilder(granularity)
+    while not reader.at_eof:
+        voffset = reader.tell_voffset()
+        size_bytes = reader.read(4)
+        if len(size_bytes) < 4:
+            break
+        (block_size,) = struct.unpack("<I", size_bytes)
+        reader.read_fully(block_size)
+        builder.process_alignment(voffset)
+    return builder.finish(len(raw))
+
+
+def merge_splitting_bais(
+    indices: Sequence[SplittingBai],
+    part_lengths: Sequence[int],
+    header_length: int,
+    total_length: int,
+    out: BinaryIO,
+) -> None:
+    """Merge per-part indices by shifting virtual offsets by the accumulated
+    byte length of preceding parts (util/SAMFileMerger.java:104-148)."""
+    shift = header_length
+    merged: List[int] = []
+    for idx, plen in zip(indices, part_lengths):
+        for v in idx.voffsets[:-1]:  # drop each part's terminator
+            merged.append(((v >> 16) + shift) << 16 | (v & 0xFFFF))
+        shift += plen
+    merged.append(total_length << 16)
+    SplittingBai(merged).save(out)
+
+
+# ---------------------------------------------------------------------------
+# Binning scheme shared by BAI and tabix
+# ---------------------------------------------------------------------------
+
+
+def reg2bins(beg: int, end: int) -> List[int]:
+    """All bins overlapping [beg, end), 0-based half-open (SAM spec §5.3)."""
+    if beg >= end:
+        return [0]
+    end -= 1
+    bins = [0]
+    for shift, offset in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        bins.extend(range(offset + (beg >> shift), offset + (end >> shift) + 1))
+    return bins
+
+
+@dataclass
+class Chunk:
+    beg: int  # virtual offsets
+    end: int
+
+
+@dataclass
+class RefIndex:
+    bins: Dict[int, List[Chunk]] = field(default_factory=dict)
+    linear: List[int] = field(default_factory=list)  # 16KiB-window voffsets
+
+
+def _read_ref_index(buf: bytes, p: int) -> Tuple[RefIndex, int]:
+    (n_bin,) = struct.unpack_from("<i", buf, p)
+    p += 4
+    ref = RefIndex()
+    for _ in range(n_bin):
+        bin_, n_chunk = struct.unpack_from("<Ii", buf, p)
+        p += 8
+        chunks = []
+        for _ in range(n_chunk):
+            beg, end = struct.unpack_from("<QQ", buf, p)
+            p += 16
+            chunks.append(Chunk(beg, end))
+        ref.bins[bin_] = chunks
+    (n_intv,) = struct.unpack_from("<i", buf, p)
+    p += 4
+    ref.linear = list(struct.unpack_from(f"<{n_intv}Q", buf, p))
+    p += 8 * n_intv
+    return ref, p
+
+
+def _query_ref(ref: RefIndex, beg: int, end: int) -> List[Chunk]:
+    """Interval → merged chunk list, clipped by the linear index."""
+    min_off = 0
+    win = beg >> 14
+    if ref.linear:
+        min_off = ref.linear[min(win, len(ref.linear) - 1)] if win < len(
+            ref.linear
+        ) else ref.linear[-1]
+    chunks: List[Chunk] = []
+    for b in reg2bins(beg, end):
+        if b == MAX_BIN:
+            continue
+        for c in ref.bins.get(b, ()):
+            if c.end > min_off:
+                chunks.append(Chunk(max(c.beg, min_off), c.end))
+    chunks.sort(key=lambda c: (c.beg, c.end))
+    merged: List[Chunk] = []
+    for c in chunks:
+        if merged and c.beg <= merged[-1].end:
+            merged[-1].end = max(merged[-1].end, c.end)
+        else:
+            merged.append(Chunk(c.beg, c.end))
+    return merged
+
+
+class Bai:
+    """Standard `.bai` reader with linear-index access and span queries."""
+
+    def __init__(self, refs: List[RefIndex], n_no_coor: Optional[int] = None):
+        self.refs = refs
+        self.n_no_coor = n_no_coor
+
+    @staticmethod
+    def load(source: Union[str, bytes]) -> "Bai":
+        raw = (
+            open(source, "rb").read() if isinstance(source, str) else source
+        )
+        if raw[:4] != BAI_MAGIC:
+            raise IOError("missing BAI magic")
+        (n_ref,) = struct.unpack_from("<i", raw, 4)
+        p = 8
+        refs = []
+        for _ in range(n_ref):
+            ref, p = _read_ref_index(raw, p)
+            refs.append(ref)
+        n_no_coor = None
+        if p + 8 <= len(raw):
+            (n_no_coor,) = struct.unpack_from("<Q", raw, p)
+        return Bai(refs, n_no_coor)
+
+    def linear_index(self, refid: int) -> List[int]:
+        """The reference's LinearBAMIndex shim equivalent."""
+        return self.refs[refid].linear
+
+    def query(self, refid: int, beg: int, end: int) -> List[Chunk]:
+        """Chunk spans possibly containing records overlapping [beg, end)
+        (0-based).  The getFileSpan path of filterByInterval."""
+        if refid < 0 or refid >= len(self.refs):
+            return []
+        return _query_ref(self.refs[refid], beg, end)
+
+    def first_offset(self) -> Optional[int]:
+        """Smallest chunk start across the whole index."""
+        best: Optional[int] = None
+        for ref in self.refs:
+            for b, chunks in ref.bins.items():
+                if b == MAX_BIN:
+                    continue
+                for c in chunks:
+                    if best is None or c.beg < best:
+                        best = c.beg
+        return best
+
+    def unmapped_span_start(self) -> Optional[int]:
+        """Upper bound voffset of all mapped chunks — where the unmapped tail
+        begins (BAMInputFormat.java:576-584 semantics)."""
+        best: Optional[int] = None
+        for ref in self.refs:
+            for b, chunks in ref.bins.items():
+                if b == MAX_BIN:
+                    continue
+                for c in chunks:
+                    if best is None or c.end > best:
+                        best = c.end
+        return best
+
+
+class BaiBuilder:
+    """Construct a `.bai` from (record, virtual offset) pairs.
+
+    The reference relies on htsjdk to build `.bai`s; this builder exists so
+    the TPU framework is self-contained (and so the query path is testable
+    without external fixtures).  Linear index granularity is the standard
+    16KiB window; chunks within a bin are merged when adjacent in file order.
+    """
+
+    def __init__(self, n_refs: int):
+        self.refs = [RefIndex() for _ in range(n_refs)]
+        self.n_no_coor = 0
+
+    def add(self, refid: int, pos: int, end_pos: int, bin_: int,
+            vstart: int, vend: int) -> None:
+        """``end_pos`` is the 0-based exclusive alignment end; ``vstart`` /
+        ``vend`` bracket the record's bytes in the BGZF stream."""
+        if refid < 0 or pos < 0:
+            self.n_no_coor += 1
+            return
+        ref = self.refs[refid]
+        chunks = ref.bins.setdefault(bin_, [])
+        if chunks and chunks[-1].end == vstart:
+            chunks[-1].end = vend
+        else:
+            chunks.append(Chunk(vstart, vend))
+        win_lo = pos >> 14
+        win_hi = max(pos, end_pos - 1) >> 14
+        if len(ref.linear) <= win_hi:
+            ref.linear.extend([0] * (win_hi + 1 - len(ref.linear)))
+        for w in range(win_lo, win_hi + 1):
+            if ref.linear[w] == 0 or vstart < ref.linear[w]:
+                ref.linear[w] = vstart
+
+    def build(self) -> "Bai":
+        return Bai(self.refs, self.n_no_coor)
+
+    def save(self, stream: BinaryIO) -> None:
+        bai = self.build()
+        stream.write(BAI_MAGIC)
+        stream.write(struct.pack("<i", len(bai.refs)))
+        for ref in bai.refs:
+            stream.write(struct.pack("<i", len(ref.bins)))
+            for bin_ in sorted(ref.bins):
+                chunks = ref.bins[bin_]
+                stream.write(struct.pack("<Ii", bin_, len(chunks)))
+                for c in chunks:
+                    stream.write(struct.pack("<QQ", c.beg, c.end))
+            stream.write(struct.pack("<i", len(ref.linear)))
+            for v in ref.linear:
+                stream.write(struct.pack("<Q", v))
+        stream.write(struct.pack("<Q", self.n_no_coor))
+
+
+def build_bai(bam_path_or_bytes: Union[str, bytes]) -> "Bai":
+    """Build a `.bai` by walking a coordinate-sorted BAM."""
+    from . import bam as bam_mod
+
+    raw = (
+        open(bam_path_or_bytes, "rb").read()
+        if isinstance(bam_path_or_bytes, str)
+        else bam_path_or_bytes
+    )
+    reader = bgzf.BgzfReader(raw)
+    hdr = bam_mod.read_header_stream(reader)
+    builder = BaiBuilder(hdr.n_refs)
+    while not reader.at_eof:
+        vstart = reader.tell_voffset()
+        size_bytes = reader.read(4)
+        if len(size_bytes) < 4:
+            break
+        (block_size,) = struct.unpack("<I", size_bytes)
+        body = reader.read_fully(block_size)
+        vend = reader.tell_voffset()
+        rec, _ = bam_mod.decode_record(size_bytes + body, 0)
+        span = rec.reference_length()
+        builder.add(
+            rec.refid, rec.pos, rec.pos + max(1, span), rec.bin, vstart, vend
+        )
+    return builder.build()
+
+
+class Tabix:
+    """`.tbi` reader (BGZF-compressed) with interval span queries."""
+
+    def __init__(
+        self,
+        refs: List[RefIndex],
+        names: List[str],
+        fmt: int,
+        col_seq: int,
+        col_beg: int,
+        col_end: int,
+        meta_char: str,
+        skip: int,
+    ):
+        self.refs = refs
+        self.names = names
+        self.fmt = fmt
+        self.col_seq = col_seq
+        self.col_beg = col_beg
+        self.col_end = col_end
+        self.meta_char = meta_char
+        self.skip = skip
+        self._name_to_id = {n: i for i, n in enumerate(names)}
+
+    @staticmethod
+    def load(source: Union[str, bytes]) -> "Tabix":
+        raw = (
+            open(source, "rb").read() if isinstance(source, str) else source
+        )
+        buf = bgzf.decompress_all(raw) if bgzf.is_bgzf(raw) else raw
+        if buf[:4] != TBI_MAGIC:
+            raise IOError("missing TBI magic")
+        n_ref, fmt, col_seq, col_beg, col_end, meta, skip, l_nm = (
+            struct.unpack_from("<8i", buf, 4)
+        )
+        p = 36
+        names = buf[p : p + l_nm].rstrip(b"\x00").split(b"\x00")
+        names = [n.decode() for n in names]
+        p += l_nm
+        refs = []
+        for _ in range(n_ref):
+            ref, p = _read_ref_index(buf, p)
+            refs.append(ref)
+        return Tabix(refs, names, fmt, col_seq, col_beg, col_end, chr(meta), skip)
+
+    def ref_id(self, name: str) -> int:
+        return self._name_to_id.get(name, -1)
+
+    def query(self, contig: str, beg: int, end: int) -> List[Chunk]:
+        rid = self.ref_id(contig)
+        if rid < 0:
+            return []
+        return _query_ref(self.refs[rid], beg, end)
+
+
+# ---------------------------------------------------------------------------
+# .bgzfi
+# ---------------------------------------------------------------------------
+
+
+class BgzfBlockIndex:
+    """`.bgzfi`: 48-bit big-endian offsets of every Nth gzip block, plus the
+    file size as final entry (util/BGZFBlockIndexer.java:109-127)."""
+
+    def __init__(self, offsets: Sequence[int]):
+        self.offsets = sorted(offsets)
+
+    @staticmethod
+    def load(source: Union[str, bytes]) -> "BgzfBlockIndex":
+        raw = (
+            open(source, "rb").read() if isinstance(source, str) else source
+        )
+        if len(raw) % 6 != 0:
+            raise IOError("invalid .bgzfi: not a multiple of 6 bytes")
+        offs = [
+            int.from_bytes(raw[i : i + 6], "big") for i in range(0, len(raw), 6)
+        ]
+        return BgzfBlockIndex(offs)
+
+    def save(self, stream: BinaryIO) -> None:
+        for o in self.offsets:
+            stream.write(o.to_bytes(6, "big"))
+
+    @staticmethod
+    def build(
+        bgzf_bytes: bytes, granularity: int = 1024
+    ) -> "BgzfBlockIndex":
+        """Index every granularity-th block + the file size
+        (util/BGZFBlockIndexer.java:37-41 default g=1024)."""
+        offs = []
+        for i, b in enumerate(bgzf.scan_blocks(bgzf_bytes)):
+            if i % granularity == 0:
+                offs.append(b.coffset)
+        offs.append(len(bgzf_bytes))
+        return BgzfBlockIndex(offs)
+
+    def prev_block(self, pos: int) -> Optional[int]:
+        i = bisect.bisect_right(self.offsets, pos)
+        return self.offsets[i - 1] if i > 0 else None
+
+    def next_block(self, pos: int) -> Optional[int]:
+        i = bisect.bisect_right(self.offsets, pos)
+        return self.offsets[i] if i < len(self.offsets) else None
+
+    def size(self) -> int:
+        return len(self.offsets)

@@ -26,11 +26,19 @@ def as_path(p: PathLike) -> Path:
     return Path(p)
 
 
-def list_parts(directory: PathLike) -> List[Path]:
-    """Sorted list of part files in a job output directory."""
+def list_parts(
+    directory: PathLike, excludes_ext: str = ".splitting-bai"
+) -> List[Path]:
+    """Sorted list of part files, excluding companion index files
+    (reference NIOFileUtil.getFilesMatching's excludesExt,
+    util/NIOFileUtil.java:88-93)."""
     d = as_path(directory)
-    parts = sorted(x for x in d.iterdir() if _PART_RE.match(x.name))
-    return parts
+    return sorted(
+        x
+        for x in d.iterdir()
+        if _PART_RE.match(x.name)
+        and not (excludes_ext and x.name.endswith(excludes_ext))
+    )
 
 
 def check_success(directory: PathLike) -> None:

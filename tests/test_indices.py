@@ -1,0 +1,183 @@
+import io
+
+import pytest
+
+from hadoop_bam_tpu.spec import bam, bgzf, indices
+
+
+def test_splitting_bai_two_construction_paths_agree(reference_resources):
+    # Offline builder vs incremental builder must produce identical indices
+    # at several granularities (reference TestSplittingBAMIndexer.java:24-66).
+    raw = (reference_resources / "test.bam").read_bytes()
+    for g in (1, 2, 10, 4096):
+        offline = indices.build_splitting_bai(raw, granularity=g)
+        inc = indices.SplittingBaiBuilder(granularity=g)
+        reader = bgzf.BgzfReader(raw)
+        import struct
+
+        reader.read_fully(4)
+        (l_text,) = struct.unpack("<i", reader.read_fully(4))
+        reader.read_fully(l_text)
+        (n_ref,) = struct.unpack("<i", reader.read_fully(4))
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", reader.read_fully(4))
+            reader.read_fully(l_name + 4)
+        while not reader.at_eof:
+            v = reader.tell_voffset()
+            sz = reader.read(4)
+            if len(sz) < 4:
+                break
+            (bs,) = struct.unpack("<I", sz)
+            reader.read_fully(bs)
+            inc.process_alignment(v)
+        built = inc.finish(len(raw))
+        assert built.voffsets == offline.voffsets, f"granularity {g}"
+        assert built.bam_size() == len(raw)
+
+
+def test_splitting_bai_granularity_count(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    sb1 = indices.build_splitting_bai(raw, granularity=1)
+    # g=1 indexes every alignment (2277) + terminator.
+    assert sb1.size() == 2277 + 1
+    sb100 = indices.build_splitting_bai(raw, granularity=100)
+    # first + every (count+1)%100==0 → 1 + floor((2277-99)/100)+1 entries.
+    assert sb100.size() == 1 + len([i for i in range(2277) if (i + 1) % 100 == 0]) + 1
+
+
+def test_splitting_bai_navigation_and_errors():
+    sb = indices.SplittingBai([0x10000, 0x50000, 0x90000, 100 << 16])
+    assert sb.next_alignment(0) == 0x10000
+    assert sb.next_alignment(1) == 0x50000
+    # floor is inclusive: filePos 5 << 16 == 0x50000 exactly.
+    assert sb.prev_alignment(5) == 0x50000
+    assert sb.prev_alignment(4) == 0x10000
+    assert sb.prev_alignment(1) == 0x10000
+    assert sb.prev_alignment(0) is None
+    assert sb.bam_size() == 100
+    with pytest.raises(IOError):
+        indices.SplittingBai([2 << 16, 1 << 16])  # out of order
+    with pytest.raises(IOError):
+        indices.SplittingBai([])
+
+
+def test_splitting_bai_merge_shifts_offsets():
+    part_a = indices.SplittingBai([(0 << 16) | 5, (100 << 16), 200 << 16])
+    part_b = indices.SplittingBai([(0 << 16) | 7, 300 << 16])
+    out = io.BytesIO()
+    indices.merge_splitting_bais(
+        [part_a, part_b], [200, 300], header_length=50, total_length=578, out=out
+    )
+    merged = indices.SplittingBai.load(out.getvalue())
+    assert merged.voffsets == [
+        (50 << 16) | 5,
+        (150 << 16),
+        (250 << 16) | 7,
+        578 << 16,
+    ]
+
+
+def test_reg2bins_contains_reg2bin():
+    for beg, end in [(0, 1), (0, 1 << 14), (5_000_000, 5_100_000), (1 << 28, (1 << 28) + 5)]:
+        assert bam.reg2bin(beg, end) in indices.reg2bins(beg, end)
+
+
+def test_tabix_fixture_query(reference_resources):
+    t = indices.Tabix.load(str(reference_resources / "HiSeq.10000.vcf.bgz.tbi"))
+    assert t.names == ["chr1"]
+    assert t.meta_char == "#"
+    assert t.ref_id("chr1") == 0
+    assert t.ref_id("chrX") == -1
+    spans = t.query("chr1", 0, 300_000_000)
+    assert spans, "whole-contig query must return a span"
+    # The span start must point at the first chr1 data line.
+    raw = (reference_resources / "HiSeq.10000.vcf.bgz").read_bytes()
+    r = bgzf.BgzfReader(raw)
+    r.seek_voffset(spans[0].beg)
+    assert r.read(6).startswith(b"chr1\t")
+    assert t.query("chrX", 0, 1000) == []
+
+
+def _sorted_synthetic_bam() -> bytes:
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr21\tLN:46709983",
+        [("chr21", 46709983)],
+    )
+    recs = [
+        bam.build_record(
+            f"r{i:04d}", 0, 1000 * i, 60, 0, [(100, "M")], "A" * 100, bytes([30] * 100)
+        )
+        for i in range(500)
+    ]
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    return buf.getvalue()
+
+
+def test_bai_builder_query_matches_bruteforce():
+    blob = _sorted_synthetic_bam()
+    bai = indices.build_bai(blob)
+    # Query a window; decoding the returned spans must yield exactly the
+    # records overlapping it (plus possibly nearby ones, but none missing).
+    beg, end = 100_000, 130_000
+    spans = bai.query(0, beg, end)
+    assert spans
+    r = bgzf.BgzfReader(blob)
+    got = set()
+    for c in spans:
+        r.seek_voffset(c.beg)
+        while r.tell_voffset() < c.end and not r.at_eof:
+            import struct
+
+            sz = r.read(4)
+            if len(sz) < 4:
+                break
+            (bs,) = struct.unpack("<I", sz)
+            rec, _ = bam.decode_record(sz + r.read_fully(bs), 0)
+            got.add(rec.read_name)
+    hdr, recs = bam.read_bam(blob)
+    expect = {
+        rec.read_name
+        for rec in recs
+        if rec.pos < end and rec.pos + rec.reference_length() > beg
+    }
+    assert expect <= got, "index query missed overlapping records"
+
+
+def test_bai_save_load_roundtrip():
+    blob = _sorted_synthetic_bam()
+    raw_bai = io.BytesIO()
+    # build via builder and save
+    from hadoop_bam_tpu.spec.indices import build_bai
+
+    bai = build_bai(blob)
+    builder = indices.BaiBuilder(1)
+    builder.refs = bai.refs
+    builder.n_no_coor = bai.n_no_coor or 0
+    builder.save(raw_bai)
+    bai2 = indices.Bai.load(raw_bai.getvalue())
+    assert len(bai2.refs) == 1
+    assert bai2.query(0, 0, 10_000) and bai2.linear_index(0)
+    assert [c.beg for c in bai2.query(0, 0, 10_000)] == [
+        c.beg for c in bai.query(0, 0, 10_000)
+    ]
+
+
+def test_bgzfi_build_and_navigate():
+    payload = bytes(range(256)) * 2000
+    buf = io.BytesIO()
+    with bgzf.BgzfWriter(buf, append_terminator=False) as w:
+        w.write(payload)
+    blob = buf.getvalue()
+    blocks = bgzf.scan_blocks(blob)
+    idx = indices.BgzfBlockIndex.build(blob, granularity=2)
+    # every 2nd block + file size
+    assert idx.offsets[-1] == len(blob)
+    assert idx.offsets[0] == 0
+    assert idx.size() == (len(blocks) + 1) // 2 + 1
+    assert idx.next_block(0) == blocks[2].coffset
+    assert idx.prev_block(blocks[2].coffset + 1) == blocks[2].coffset
+    out = io.BytesIO()
+    idx.save(out)
+    idx2 = indices.BgzfBlockIndex.load(out.getvalue())
+    assert idx2.offsets == idx.offsets

@@ -1,0 +1,81 @@
+"""Cross-validation of the C++ host library against the pure-Python oracles."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import native
+from hadoop_bam_tpu.spec import bam, bgzf
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib unavailable: {native.load_error()}"
+)
+
+
+def _bgzf_bytes(payload: bytes, level=6) -> bytes:
+    buf = io.BytesIO()
+    with bgzf.BgzfWriter(buf, level=level, append_terminator=False) as w:
+        w.write(payload)
+    return buf.getvalue()
+
+
+def test_scan_blocks_matches_oracle():
+    payload = os.urandom(200_000)
+    blob = _bgzf_bytes(payload, level=1)
+    co, cs, us = native.scan_blocks(blob)
+    oracle = bgzf.scan_blocks(blob)
+    assert list(co) == [b.coffset for b in oracle]
+    assert list(cs) == [b.csize for b in oracle]
+    assert list(us) == [b.usize for b in oracle]
+
+
+def test_inflate_matches_oracle_and_crc():
+    payload = b"The quick brown fox. " * 20000
+    blob = _bgzf_bytes(payload)
+    co, cs, us = native.scan_blocks(blob)
+    out, offs = native.inflate_blocks(blob, co, cs, us)
+    assert out.tobytes() == payload
+    assert offs[-1] == len(payload)
+    # CRC corruption must be detected.
+    bad = bytearray(blob)
+    bad[int(co[0]) + 25] ^= 0xFF
+    with pytest.raises(bgzf.BgzfError):
+        native.inflate_blocks(bytes(bad), co, cs, us)
+
+
+def test_deflate_roundtrip_multithreaded():
+    payload = os.urandom(500_000)  # incompressible → stored-block path too
+    blob = native.deflate_blocks(payload, level=1, threads=4)
+    assert bgzf.decompress_all(blob) == payload
+    blob2 = native.deflate_blocks(b"", level=1)
+    assert blob2 == b""
+
+
+def test_record_chain_matches_oracle(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    data = native.decompress_all(raw)
+    _, p = bam.BamHeader.decode(data.tobytes())
+    chain = native.record_chain(data, p)
+    oracle = bam.record_offsets(data, p)
+    assert np.array_equal(chain, oracle)
+    # Misaligned start must raise.
+    with pytest.raises(bam.BamError):
+        native.record_chain(data, p + 1)
+
+
+def test_find_next_block_guessing():
+    payload = os.urandom(150_000)
+    blob = _bgzf_bytes(payload, level=1)
+    co, _, _ = native.scan_blocks(blob)
+    for offset in co:
+        assert native.find_next_block(blob, int(offset)) == offset
+    if len(co) > 1:
+        assert native.find_next_block(blob, int(co[0]) + 1) == co[1]
+    assert native.find_next_block(blob, int(co[-1]) + 1) == -1
+
+
+def test_whole_file_decompress(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    assert native.decompress_all(raw).tobytes() == bgzf.decompress_all(raw)

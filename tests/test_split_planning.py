@@ -1,0 +1,304 @@
+"""Split-boundary tests: the reference's core test asset re-targeted.
+
+Mirrors TestBAMInputFormat's strategy (forced small splits → exact per-split
+record partition) and TestBGZFSplitGuesser / TestBAMSplitGuesser oracles.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.conf import Configuration
+from hadoop_bam_tpu.io import BamInputFormat, BamOutputWriter
+from hadoop_bam_tpu.io.bam import read_header, splitting_bai_path
+from hadoop_bam_tpu.io.guesser import BamSplitGuesser, guess_bgzf_block_start
+from hadoop_bam_tpu.io.merger import merge_bam_parts
+from hadoop_bam_tpu.spec import bam, bgzf, indices
+from hadoop_bam_tpu.utils import nio
+
+REF_BAM = "/root/reference/src/test/resources/test.bam"
+
+
+def all_records_via_splits(fmt, path, split_size):
+    out = []
+    for s in fmt.get_splits([path], split_size=split_size):
+        b = fmt.read_split(s)
+        for i in range(b.n_records):
+            off = int(b.soa["rec_off"][i])
+            ln = int(b.soa["rec_len"][i])
+            out.append(bytes(b.data[off : off + ln]))
+    return out
+
+
+class TestProbabilisticSplits:
+    @pytest.mark.parametrize("split_size", [40_000, 65_536, 100_000, 500_000])
+    def test_exactly_once_in_order(self, reference_resources, split_size):
+        fmt = BamInputFormat()
+        _, recs = bam.read_bam(REF_BAM)
+        got = all_records_via_splits(fmt, REF_BAM, split_size)
+        assert got == [r.raw for r in recs]
+
+    def test_tiny_splits_merge_backward(self, reference_resources):
+        # Splits smaller than a BGZF block contain no verifiable record
+        # start and merge into their predecessor
+        # (BAMInputFormat.java:497-525); no records are lost.
+        fmt = BamInputFormat()
+        _, recs = bam.read_bam(REF_BAM)
+        got = all_records_via_splits(fmt, REF_BAM, 10_000)
+        assert got == [r.raw for r in recs]
+
+    def test_guesser_matches_header_skip_at_zero(self, reference_resources):
+        # guess(0, end) must equal the first-record virtual offset
+        # (TestBAMSplitGuesser.java:15-24 oracle).
+        data = open(REF_BAM, "rb").read()
+        hdr = read_header(REF_BAM)
+        g = BamSplitGuesser(data, hdr.n_refs)
+        first = g.guess_next_record_start(0, len(data))
+        # Oracle: decode header with the oracle reader.
+        r = bgzf.BgzfReader(data)
+        import struct
+
+        r.read_fully(4)
+        (l_text,) = struct.unpack("<i", r.read_fully(4))
+        r.read_fully(l_text)
+        (n_ref,) = struct.unpack("<i", r.read_fully(4))
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", r.read_fully(4))
+            r.read_fully(l_name + 4)
+        assert first == r.tell_voffset()
+        # And a mid-file guess must land exactly on a real record boundary.
+        mid = g.guess_next_record_start(50_000, 100_000)
+        data_u = bgzf.decompress_all(data)
+        _, p0 = bam.BamHeader.decode(data_u)
+        offsets = bam.record_offsets(np.frombuffer(data_u, np.uint8), p0)
+        # Convert the guessed voffset to a payload offset.
+        blocks = bgzf.scan_blocks(data)
+        cum = {b.coffset: 0 for b in blocks}
+        acc = 0
+        for b in blocks:
+            cum[b.coffset] = acc
+            acc += b.usize
+        assert (mid >> 16) in cum
+        payload_off = cum[mid >> 16] + (mid & 0xFFFF)
+        assert payload_off in set(offsets.tolist())
+
+
+class TestBgzfGuesser:
+    def test_every_boundary_found(self):
+        # TestBGZFSplitGuesser.java:40-70 equivalent: guessing from one byte
+        # past each block start finds the next block.
+        payload = os.urandom(400_000)
+        buf = io.BytesIO()
+        with bgzf.BgzfWriter(buf, level=1) as w:
+            w.write(payload)
+        blob = buf.getvalue()
+        blocks = bgzf.scan_blocks(blob)
+        for i, b in enumerate(blocks[:-1]):
+            got = guess_bgzf_block_start(blob, b.coffset + 1, len(blob))
+            assert got == blocks[i + 1].coffset
+        # Last block is the terminator.
+        assert blob[blocks[-1].coffset :] == bgzf.TERMINATOR
+
+
+def synth_bam_bytes(n=3000, header_pad: int = 0, with_unmapped=True):
+    text = "@HD\tVN:1.6\tSO:unsorted\n@SQ\tSN:chr21\tLN:46709983\n@SQ\tSN:chr22\tLN:50818468"
+    if header_pad:
+        text += "\n@CO\t" + "x" * header_pad
+    hdr = bam.BamHeader(text, [("chr21", 46709983), ("chr22", 50818468)])
+    recs = []
+    for i in range(n):
+        recs.append(
+            bam.build_record(
+                f"pair{i:06d}",
+                i % 2,
+                1000 * i % 46000000,
+                60,
+                bam.FLAG_PAIRED,
+                [(76, "M")],
+                "ACGT" * 19,
+                bytes([30] * 76),
+            )
+        )
+    if with_unmapped:
+        for i in range(4):
+            recs.append(
+                bam.build_record(
+                    f"unm{i}", -1, -1, 0, bam.FLAG_UNMAPPED, [], "ACGTACGT",
+                    bytes([20] * 8),
+                )
+            )
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    return buf.getvalue(), hdr, recs
+
+
+class TestIndexedSplits:
+    def test_indexed_equals_probabilistic_partition(self, tmp_path):
+        blob, hdr, recs = synth_bam_bytes(3000)
+        p = tmp_path / "synth.bam"
+        p.write_bytes(blob)
+        fmt = BamInputFormat()
+        prob = all_records_via_splits(fmt, str(p), 100_000)
+        # Now with a .splitting-bai present.
+        sb = indices.build_splitting_bai(blob, granularity=77)
+        with open(splitting_bai_path(str(p)), "wb") as f:
+            sb.save(f)
+        idx = all_records_via_splits(fmt, str(p), 100_000)
+        assert idx == prob == [r.raw for r in recs]
+
+    def test_bad_index_falls_back(self, tmp_path):
+        blob, hdr, recs = synth_bam_bytes(500)
+        p = tmp_path / "synth.bam"
+        p.write_bytes(blob)
+        (tmp_path / ("synth.bam" + indices.SPLITTING_BAI_EXT)).write_bytes(
+            b"garbage!"
+        )
+        fmt = BamInputFormat()
+        got = all_records_via_splits(fmt, str(p), 100_000)
+        assert got == [r.raw for r in recs]
+
+
+class TestLargeHeader:
+    def test_records_survive_header_spanning_splits(self, tmp_path):
+        # The "no reads in first split" regression
+        # (TestBAMInputFormat.java:56-62): header text larger than several
+        # split sizes must not lose records.
+        blob, hdr, recs = synth_bam_bytes(300, header_pad=300_000)
+        p = tmp_path / "bigheader.bam"
+        p.write_bytes(blob)
+        fmt = BamInputFormat()
+        got = all_records_via_splits(fmt, str(p), 65_536)
+        assert got == [r.raw for r in recs]
+
+
+class TestIntervalFiltering:
+    def test_bounded_traversal_prunes_and_keeps(self, tmp_path):
+        # Coordinate-sorted BAM + intervals: the chunk-span filter must keep
+        # every overlapping record (coarse superset, refined later on device).
+        hdr = bam.BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr21\tLN:46709983",
+            [("chr21", 46709983)],
+        )
+        recs = [
+            bam.build_record(
+                f"r{i:05d}", 0, 500 * i, 60, 0, [(100, "M")], "A" * 100,
+                bytes([30] * 100),
+            )
+            for i in range(2000)
+        ]
+        buf = io.BytesIO()
+        bam.write_bam(buf, hdr, iter(recs))
+        p = tmp_path / "sorted.bam"
+        p.write_bytes(buf.getvalue())
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.bounded-traversal", True)
+        conf.set("hadoopbam.bam.intervals", "chr21:100000-150000")
+        fmt = BamInputFormat(conf)
+        splits = fmt.get_splits([str(p)], split_size=100_000)
+        got_names = set()
+        for s in splits:
+            b = fmt.read_split(s)
+            for i in range(b.n_records):
+                got_names.add(b.record(i).read_name)
+        expect = {
+            r.read_name
+            for r in recs
+            if r.pos < 150000 and r.pos + r.reference_length() > 100000 - 1
+        }
+        assert expect <= got_names
+        # And pruning really happened: far-away records are gone.
+        assert "r01999" not in got_names
+
+    def test_intervals_plus_unmapped_tail_in_same_split(self, tmp_path):
+        # A split overlapping both interval chunks and the unmapped tail must
+        # yield BOTH: the unmapped pass is additive, not an elif
+        # (BAMInputFormat.java:609-631).
+        hdr = bam.BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr21\tLN:46709983",
+            [("chr21", 46709983)],
+        )
+        recs = [
+            bam.build_record(
+                f"m{i:03d}", 0, 1000 * i, 60, 0, [(50, "M")], "A" * 50,
+                bytes([30] * 50),
+            )
+            for i in range(100)
+        ] + [
+            bam.build_record(
+                f"u{i}", -1, -1, 0, bam.FLAG_UNMAPPED, [], "ACGT", bytes([20] * 4)
+            )
+            for i in range(3)
+        ]
+        buf = io.BytesIO()
+        bam.write_bam(buf, hdr, iter(recs))
+        p = tmp_path / "both.bam"
+        p.write_bytes(buf.getvalue())
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.bounded-traversal", True)
+        conf.set("hadoopbam.bam.intervals", "chr21:1-20000")
+        conf.set_boolean("hadoopbam.bam.traverse-unplaced-unmapped", True)
+        fmt = BamInputFormat(conf)
+        got = set()
+        for s in fmt.get_splits([str(p)], split_size=1 << 20):
+            b = fmt.read_split(s)
+            for i in range(b.n_records):
+                got.add(b.record(i).read_name)
+        assert {"m000", "m010"} <= got
+        assert {"u0", "u1", "u2"} <= got, "unmapped tail lost next to intervals"
+
+
+class TestWriterAndMerger:
+    def test_parts_merge_to_valid_bam_with_merged_index(self, tmp_path):
+        blob, hdr, recs = synth_bam_bytes(1200, with_unmapped=False)
+        part_dir = tmp_path / "out"
+        part_dir.mkdir()
+        chunks = [recs[:500], recs[500:900], recs[900:]]
+        for i, chunk in enumerate(chunks):
+            part = part_dir / f"part-r-{i:05d}"
+            with open(part, "wb") as f, open(
+                str(part) + indices.SPLITTING_BAI_EXT, "wb"
+            ) as sf:
+                w = BamOutputWriter(
+                    f,
+                    hdr,
+                    write_header=False,
+                    append_terminator=False,
+                    write_splitting_bai=True,
+                    splitting_bai_stream=sf,
+                    granularity=100,
+                )
+                for r in chunk:
+                    w.write_record(r)
+                w.close()
+        nio.write_success(part_dir)
+        out = tmp_path / "merged.bam"
+        merge_bam_parts(
+            str(part_dir), str(out), hdr, write_splitting_bai=True
+        )
+        hdr2, recs2 = bam.read_bam(str(out))
+        assert [r.raw for r in recs2] == [r.raw for r in recs]
+        assert out.read_bytes().endswith(bgzf.TERMINATOR)
+        # Every merged-index voffset must decode a record
+        # (TestBAMOutputFormat.java:176-226 oracle).
+        sb = indices.SplittingBai.load(str(out) + indices.SPLITTING_BAI_EXT)
+        data = out.read_bytes()
+        r = bgzf.BgzfReader(data)
+        import struct
+
+        for v in sb.voffsets[:-1]:
+            r.seek_voffset(v)
+            (bs,) = struct.unpack("<I", r.read_fully(4))
+            rec, _ = bam.decode_record(
+                struct.pack("<I", bs) + r.read_fully(bs), 0
+            )
+            assert rec.l_read_name >= 1
+        assert sb.bam_size() == len(data)
+
+    def test_merge_requires_success_marker(self, tmp_path):
+        part_dir = tmp_path / "out"
+        part_dir.mkdir()
+        hdr = bam.BamHeader("@HD\tVN:1.6", [])
+        with pytest.raises(FileNotFoundError):
+            merge_bam_parts(str(part_dir), str(tmp_path / "m.bam"), hdr)
