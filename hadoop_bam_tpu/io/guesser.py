@@ -147,16 +147,16 @@ class BamSplitGuesser:
         co, cs, us = [], [], []
         pos = cp
         while len(co) < BLOCKS_NEEDED_FOR_GUESS + 1 and pos < len(window):
-            hdr = bgzf.parse_block_header(window, pos)
-            if hdr is None or pos + hdr[0] > len(window):
+            try:
+                csize, usize = bgzf.read_block_at(window, pos)
+            except bgzf.BgzfError:
+                break  # chain ends (or lying ISIZE) inside the window
+            if pos + csize > len(window):
                 break
-            usize = struct.unpack_from("<I", window, pos + hdr[0] - 4)[0]
-            if usize > bgzf.MAX_BLOCK_SIZE:
-                break  # lying ISIZE → not a real block chain
             co.append(pos)
-            cs.append(hdr[0])
+            cs.append(csize)
             us.append(usize)
-            pos += hdr[0]
+            pos += csize
         if not co:
             return False
         try:

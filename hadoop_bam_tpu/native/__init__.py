@@ -21,13 +21,13 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "bgzf_native.cpp")
 _LIB_NAME = "_libhbam_native.so"
-_ABI = 1
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed: Optional[str] = None
 
 MAX_BLOCK = 0x10000
+_ABI = 2
 
 
 def _build(lib_path: str) -> None:
@@ -59,6 +59,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hbam_record_chain.restype = i64
     lib.hbam_record_chain.argtypes = [u8p, i64, i64, i64p, i64]
+    lib.hbam_gather_records.restype = i64
+    lib.hbam_gather_records.argtypes = [u8p, i64p, i64p, i64p, i64, u8p]
     return lib
 
 
@@ -75,8 +77,12 @@ def _get() -> Optional[ctypes.CDLL]:
                 lib_path
             ) < os.path.getmtime(_SRC):
                 _build(lib_path)
-            lib = _bind(ctypes.CDLL(lib_path))
-            if lib.hbam_abi_version() != _ABI:
+            try:
+                lib = _bind(ctypes.CDLL(lib_path))
+                stale = lib.hbam_abi_version() != _ABI
+            except (AttributeError, OSError):
+                stale = True  # older .so missing symbols → rebuild
+            if stale:
                 _build(lib_path)
                 lib = _bind(ctypes.CDLL(lib_path))
             _lib = lib
@@ -265,6 +271,52 @@ def record_chain(data, start: int, end: Optional[int] = None) -> np.ndarray:
 
             raise BamError(f"record chain misaligned in [{start},{end})")
         return offs[:n].copy()
+
+
+def gather_records(
+    data,
+    rec_off: np.ndarray,
+    rec_len: np.ndarray,
+    order: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Permuted concat of (block_size word + body) per record — one memcpy
+    each, no index-array temporaries (fast on low-core hosts)."""
+    a = _as_u8(data)
+    lib = _get()
+    off = np.ascontiguousarray(rec_off, dtype=np.int64)
+    ln = np.ascontiguousarray(rec_len, dtype=np.int64)
+    if len(off) and (
+        (off.min() < 4)
+        or int((off + ln).max()) > len(a)
+        or ln.min() < 0
+    ):
+        raise IndexError("record extents out of bounds for data buffer")
+    if order is not None:
+        order = np.ascontiguousarray(order, dtype=np.int64)
+        if len(order) and (order.min() < 0 or order.max() >= len(off)):
+            raise IndexError("order indices out of range")
+        n = len(order)  # rows to emit — may be a slice of the batch
+        total = int((ln[order] + 4).sum())
+    else:
+        n = len(off)
+        total = int((ln + 4).sum())
+    out = np.empty(total, dtype=np.uint8)
+    if lib is None:
+        w = 0
+        idx = order if order is not None else np.arange(n)
+        for r in idx:
+            l = int(ln[r]) + 4
+            s = int(off[r]) - 4
+            out[w : w + l] = a[s : s + l]
+            w += l
+        return out
+    lib.hbam_gather_records(
+        _ptr(a, ctypes.c_uint8), _ptr(off, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64),
+        _ptr(order, ctypes.c_int64) if order is not None else None,
+        n, _ptr(out, ctypes.c_uint8),
+    )
+    return out
 
 
 def decompress_all(data, check_crc: bool = True, threads: Optional[int] = None) -> np.ndarray:

@@ -230,6 +230,22 @@ int64_t hbam_record_chain(const uint8_t* data, int64_t start, int64_t end,
   return n;
 }
 
-int hbam_abi_version() { return 1; }
+// Gather records (block_size word + body) in permuted order into `out`.
+// rec_off points at record *bodies* (the u32 size word sits 4 bytes before).
+// Returns total bytes written.
+int64_t hbam_gather_records(const uint8_t* data, const int64_t* rec_off,
+                            const int64_t* rec_len, const int64_t* order,
+                            int64_t n, uint8_t* out) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = order ? order[i] : i;
+    const int64_t len = rec_len[r] + 4;
+    std::memcpy(out + w, data + rec_off[r] - 4, len);
+    w += len;
+  }
+  return w;
+}
+
+int hbam_abi_version() { return 2; }
 
 }  // extern "C"

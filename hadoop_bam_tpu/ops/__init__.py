@@ -1,0 +1,20 @@
+"""Device ops: the dense phases of the pipeline as JAX/XLA/Pallas programs.
+
+The reference's per-record hot loops (BGZF inflate → BAM decode → key → sort,
+BAMRecordReader.java:223-232 and the shuffle) become batched device programs:
+
+- ``decode``: fixed-field gather from a raw record-byte tensor into the SoA
+  columns (the device half of SURVEY.md §7 stage 4),
+- ``keys``: the 64-bit coordinate key as (hi, lo) int32/uint32 pairs with
+  Java-exact signed semantics (BAMRecordReader.java:81-121),
+- ``sort``: single-chip multi-key sort producing a permutation,
+- ``quality``: FASTQ/QSEQ quality-encoding conversion + histograms
+  (SequencedFragment.java:229-309 semantics) — elementwise + one-hot matmul
+  so the MXU does the counting,
+- ``pallas``: hand-written TPU kernels for the ops XLA doesn't fuse well.
+
+Everything here is shape-static and jit-compatible; ragged record tails stay
+in the uint8 sideband and are addressed by offset columns.
+"""
+
+from . import cigar, decode, keys, sort, quality  # noqa: F401

@@ -1,0 +1,72 @@
+"""Device-side BAM fixed-field decode: byte tensor + offsets → SoA columns.
+
+The device half of SURVEY.md §7 stage 4: once the host has inflated blocks
+and walked the record chain (native/), the raw record bytes ship to device
+*once* as a uint8 tensor, and every fixed field of every record is gathered
+and bit-assembled there in parallel — the batched replacement for htsjdk's
+per-record ``BAMRecordCodec.decode`` loop (BAMRecordReader.java:223-232).
+
+All shapes are static under jit: callers pad ``offsets`` to a fixed batch
+size with a trailing sentinel (offset 0, masked by ``valid``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _le(data: jax.Array, at: jax.Array, nbytes: int) -> jax.Array:
+    """Little-endian gather: uint32 from ``nbytes`` bytes at ``at``."""
+    v = jnp.zeros(at.shape, dtype=jnp.uint32)
+    for k in range(nbytes):
+        v = v | (data[at + k].astype(jnp.uint32) << jnp.uint32(8 * k))
+    return v
+
+
+@partial(jax.jit, donate_argnums=())
+def soa_decode_device(data: jax.Array, offsets: jax.Array) -> Dict[str, jax.Array]:
+    """``data``: uint8[B]; ``offsets``: int32[N] record (block_size-word)
+    offsets.  Returns the SoA dict matching spec.bam.soa_decode.
+    """
+    body = offsets + 4
+    u32 = lambda off: _le(data, body + off, 4)
+    i32 = lambda off: u32(off).astype(jnp.int32)
+    u16 = lambda off: _le(data, body + off, 2).astype(jnp.int32)
+    u8 = lambda off: data[body + off].astype(jnp.int32)
+
+    return {
+        "refid": i32(0),
+        "pos": i32(4),
+        "l_read_name": u8(8),
+        "mapq": u8(9),
+        "bin": u16(10),
+        "n_cigar_op": u16(12),
+        "flag": u16(14),
+        "l_seq": i32(16),
+        "next_refid": i32(20),
+        "next_pos": i32(24),
+        "tlen": i32(28),
+        "rec_off": body,
+        "rec_len": _le(data, offsets, 4).astype(jnp.int32),
+    }
+
+
+def pad_offsets(offsets, batch: int):
+    """Pad an offsets array to ``batch`` rows; returns (padded, valid mask).
+
+    Pad rows point at offset 0 (always in-bounds) and are masked out.
+    """
+    import numpy as np
+
+    n = len(offsets)
+    if n > batch:
+        raise ValueError(f"batch {batch} < record count {n}")
+    padded = np.zeros(batch, dtype=np.int32)
+    padded[:n] = offsets
+    valid = np.zeros(batch, dtype=bool)
+    valid[:n] = True
+    return padded, valid

@@ -1,0 +1,55 @@
+"""Sort-key construction on device, bit-exact with the reference.
+
+The shuffle key is Java ``long getKey0(int refIdx, int pos0) = (long)refIdx
+<< 32 | pos0`` (BAMRecordReader.java:119-121) — note the *sign extension* of
+``pos0`` (and of the murmur hash for unmapped reads) floods the high word
+when negative.  TPUs prefer 32-bit lanes, so the key is carried as a pair
+``(hi: int32, lo: uint32)`` whose lexicographic order (hi signed, lo
+unsigned) equals signed-int64 order of the packed key.  ``lax.sort`` with
+``num_keys=2`` implements exactly that comparison.
+
+Unmapped reads need ``murmur3`` over ragged record bytes; that column is
+computed host-side (utils/murmur3, batched in native/) and passed in as
+``hash32`` — the device op just selects per the reference's condition
+(unmapped flag OR refid<0 OR alignmentStart<0, BAMRecordReader.java:85-86).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..spec.bam import FLAG_UNMAPPED, INT_MAX
+
+
+def make_keys(
+    refid: jax.Array,  # int32[N]
+    pos: jax.Array,  # int32[N], 0-based, -1 if unplaced
+    flag: jax.Array,  # int32[N]
+    hash32: jax.Array,  # int32[N], murmur3 low word (only used when unmapped)
+) -> tuple[jax.Array, jax.Array]:
+    """(hi: int32[N], lo: uint32[N]) with Java-exact packing."""
+    unmapped = ((flag & FLAG_UNMAPPED) != 0) | (refid < 0) | ((pos + 1) < 0)
+    sel_hi = jnp.where(unmapped, jnp.int32(INT_MAX), refid)
+    sel_lo = jnp.where(unmapped, hash32, pos)
+    # Java `|` sign-extends the low int into the long: a negative low word
+    # turns the whole high word into 0xffffffff.
+    hi = jnp.where(sel_lo < 0, jnp.int32(-1), sel_hi)
+    lo = sel_lo.astype(jnp.uint32)
+    return hi, lo
+
+
+def pack_keys_np(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host-side: (hi, lo) → signed int64 key (for oracle comparison)."""
+    return (hi.astype(np.int64) << np.int64(32)) | lo.astype(np.uint32).astype(
+        np.int64
+    )
+
+
+def split_keys_np(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: signed int64 key → (hi int32, lo uint32)."""
+    return (
+        (keys >> np.int64(32)).astype(np.int32),
+        (keys & np.int64(0xFFFFFFFF)).astype(np.uint32),
+    )

@@ -1,0 +1,7 @@
+"""Hand-written Pallas TPU kernels for ops XLA doesn't fuse well.
+
+Kernels fall back to interpreter mode off-TPU (tests run them on the CPU
+mesh), and to the plain-XLA ops/ implementations when Pallas is unavailable.
+"""
+
+from .histogram import quality_histogram  # noqa: F401

@@ -1,0 +1,103 @@
+"""Quality-encoding ops + histograms, batched for the VPU/MXU.
+
+Semantics from the reference (SequencedFragment.java:229-309,
+FormatConstants.java:30-48): Sanger = Phred+33 (range [0,93]), Illumina =
+Phred+64 (range [0,62]); conversion shifts by 31 after range validation.
+The per-byte Java loops become masked elementwise ops over a whole batch;
+range violations are *reported* (index of first bad byte per row, -1 if ok)
+rather than thrown, so a jit program can carry them as data (the
+STRICT/LENIENT/SILENT policy is applied host-side).
+
+The quality histogram — baseline config #3's kernel — is computed as a
+one-hot × ones matmul so the reduction runs on the MXU in bfloat16-free
+int32 space, instead of a scatter-add that would serialize on the VPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+SANGER_OFFSET = 33
+SANGER_MAX = 93
+ILLUMINA_OFFSET = 64
+ILLUMINA_MAX = 62
+
+
+@jax.jit
+def verify_quality_sanger(qual: jax.Array, valid: jax.Array) -> jax.Array:
+    """First offending index per row, -1 if in-range (verifyQuality
+    semantics).  ``qual``: uint8[B, L]; ``valid``: bool[B, L] length masks."""
+    bad = valid & (
+        (qual < SANGER_OFFSET) | (qual > SANGER_OFFSET + SANGER_MAX)
+    )
+    return _first_true(bad)
+
+
+@jax.jit
+def verify_quality_illumina(qual: jax.Array, valid: jax.Array) -> jax.Array:
+    bad = valid & (
+        (qual < ILLUMINA_OFFSET) | (qual > ILLUMINA_OFFSET + ILLUMINA_MAX)
+    )
+    return _first_true(bad)
+
+
+def _first_true(mask: jax.Array) -> jax.Array:
+    L = mask.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    hit = jnp.where(mask, idx, L)
+    first = jnp.min(hit, axis=-1)
+    return jnp.where(first == L, jnp.int32(-1), first.astype(jnp.int32))
+
+
+@jax.jit
+def illumina_to_sanger(qual: jax.Array) -> jax.Array:
+    """Phred+64 → Phred+33 (validation is the caller's verify_* pass)."""
+    return (qual.astype(jnp.int32) - (ILLUMINA_OFFSET - SANGER_OFFSET)).astype(
+        jnp.uint8
+    )
+
+
+@jax.jit
+def sanger_to_illumina(qual: jax.Array) -> jax.Array:
+    return (qual.astype(jnp.int32) + (ILLUMINA_OFFSET - SANGER_OFFSET)).astype(
+        jnp.uint8
+    )
+
+
+@partial(jax.jit, static_argnames=("nbins",))
+def histogram_u8(values: jax.Array, valid: jax.Array, nbins: int = 64) -> jax.Array:
+    """Counts of each value in [0, nbins) over the valid positions.
+
+    One-hot [B*L, nbins] contracted against ones on the MXU; int32 output.
+    Out-of-range values fall outside every one-hot column and count nowhere.
+    """
+    v = values.reshape(-1).astype(jnp.int32)
+    m = valid.reshape(-1)
+    onehot = (
+        (v[:, None] == jnp.arange(nbins, dtype=jnp.int32)[None, :])
+        & m[:, None]
+    ).astype(jnp.float32)
+    counts = jnp.sum(onehot, axis=0)  # XLA maps this reduction onto the MXU
+    return counts.astype(jnp.int32)
+
+
+@jax.jit
+def base_counts(seq_codes: jax.Array, valid: jax.Array) -> jax.Array:
+    """Counts of the 16 4-bit BAM base codes (=ACMGRSVTWYHKDBN) — the
+    base-count reduction of baseline config #3."""
+    v = seq_codes.reshape(-1).astype(jnp.int32)
+    m = valid.reshape(-1)
+    onehot = (
+        (v[:, None] == jnp.arange(16, dtype=jnp.int32)[None, :]) & m[:, None]
+    ).astype(jnp.float32)
+    return jnp.sum(onehot, axis=0).astype(jnp.int32)
+
+
+@jax.jit
+def unpack_seq_nibbles(packed: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """uint8[B, L/2] packed 4-bit bases → (hi, lo) uint8[B, L/2] nibbles."""
+    return packed >> 4, packed & 0xF

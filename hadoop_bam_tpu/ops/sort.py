@@ -1,0 +1,45 @@
+"""Single-chip sort: the per-device phase of the coordinate sort.
+
+``lax.sort`` with two key operands ((hi signed, lo unsigned) — signed-int64
+order, see ops/keys.py) plus a validity column for padding.  XLA lowers this
+to an efficient on-chip sort; the returned permutation indexes the original
+rows so the ragged byte sideband can be reordered host-side (or gathered
+device-side when columns are packed fixed-width).
+
+This replaces the MapReduce shuffle's within-reducer merge-sort; the
+cross-chip phase lives in parallel/shuffle.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def sort_keys(
+    hi: jax.Array, lo: jax.Array, valid: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort by the 64-bit key; invalid (padding) rows sink to the end.
+
+    Returns (hi_sorted, lo_sorted, permutation int32[N]).
+    """
+    n = hi.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if valid is None:
+        hi_s, lo_s, perm = lax.sort((hi, lo, idx), num_keys=2, is_stable=True)
+        return hi_s, lo_s, perm
+    invalid = (~valid).astype(jnp.uint8)
+    _, hi_s, lo_s, perm = lax.sort(
+        (invalid, hi, lo, idx), num_keys=3, is_stable=True
+    )
+    return hi_s, lo_s, perm
+
+
+@jax.jit
+def apply_permutation(columns: dict, perm: jax.Array) -> dict:
+    """Gather every SoA column through the sort permutation (device-side)."""
+    return {k: v[perm] for k, v in columns.items()}

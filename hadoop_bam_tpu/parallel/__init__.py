@@ -1,0 +1,12 @@
+"""Distributed execution: device meshes + XLA-collective shuffle.
+
+The reference's two distribution mechanisms (SURVEY.md §2.7) map to:
+- data parallelism over file splits → sharded batches over a
+  ``jax.sharding.Mesh`` (one split-batch shard per device),
+- the MapReduce sort shuffle → a range-partitioned ``all_to_all`` under
+  ``shard_map`` (ICI within a slice, DCN across slices), keyed by the same
+  64-bit ``(refIdx<<32|pos0)`` packing.
+"""
+
+from .mesh import make_mesh, data_axis  # noqa: F401
+from .shuffle import DistributedSort  # noqa: F401

@@ -1,0 +1,37 @@
+"""Mesh construction helpers.
+
+One logical axis ``d`` carries the record data parallelism (the analog of
+"one mapper per split"); multi-host topologies extend the same axis across
+hosts so the shuffle's ``all_to_all`` rides ICI within a slice and DCN
+across slices — XLA inserts the right collectives from the sharding alone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "d"
+
+
+def data_axis() -> str:
+    return DATA_AXIS
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all visible devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))

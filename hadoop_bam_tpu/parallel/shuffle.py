@@ -1,0 +1,223 @@
+"""Range-partitioned distributed sort: the MapReduce shuffle as all_to_all.
+
+The reference sorts by shipping ``(refIdx<<32|pos0)``-keyed records through
+Hadoop's shuffle to range-partitioned reducers (BAMRecordReader.java:81-121 +
+total-order partitioner, SURVEY.md §3.5).  Here the same algorithm runs as a
+single SPMD program under ``shard_map`` over a device mesh:
+
+1. every device sorts its local keys and contributes ``S`` evenly-spaced
+   samples (an ``all_gather`` — the splitter election a total-order
+   partitioner does host-side),
+2. ``D-1`` splitters cut the key space; each row's destination device is its
+   splitter bucket (ties stay on one device, so no cross-device stability
+   issue),
+3. rows scatter into a ``[D, capacity]`` send buffer and exchange via
+   ``lax.all_to_all`` (ICI/DCN — the shuffle's data plane),
+4. each device locally sorts what it received; concatenated device outputs
+   are the global order.
+
+Keys travel as (hi: int32, lo: uint32) pairs (signed-int64 order — see
+ops/keys.py); the payload is (src_dev, src_row) so the host can permute the
+ragged record bytes afterwards.  Capacity overflow is *detected* (psum'd
+count returned) — the caller re-runs with a larger capacity rather than
+silently dropping records.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+_HI_PAD = jnp.int32(0x7FFFFFFF)
+_LO_PAD = jnp.uint32(0xFFFFFFFF)
+
+
+class ShuffleResult(NamedTuple):
+    hi: jax.Array  # int32[D*C] sorted within+across devices
+    lo: jax.Array  # uint32[D*C]
+    valid: jax.Array  # bool[D*C]
+    src_dev: jax.Array  # int32[D*C]
+    src_row: jax.Array  # int32[D*C]
+    overflow: jax.Array  # int32[] — rows that did not fit (must be 0)
+
+
+class DistributedSort:
+    """A compiled distributed sort over a fixed mesh/shape configuration."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        rows_per_device: int,
+        capacity_per_pair: Optional[int] = None,
+        samples_per_device: int = 64,
+    ):
+        self.mesh = mesh
+        self.n_devices = mesh.devices.size
+        self.rows = rows_per_device
+        d = self.n_devices
+        # Default capacity: perfectly balanced load + 60% headroom.
+        self.capacity = capacity_per_pair or max(
+            16, int(np.ceil(rows_per_device / d * 1.6))
+        )
+        self.samples = samples_per_device
+        self._step = self._build()
+
+    # -- the SPMD program ---------------------------------------------------
+
+    def _build(self):
+        d = self.n_devices
+        rows, cap, S = self.rows, self.capacity, self.samples
+        axis = DATA_AXIS
+
+        def local(hi, lo, valid):
+            # [rows] per device.
+            dev = lax.axis_index(axis).astype(jnp.int32)
+
+            # 1. local sort (invalid rows sink) + sample election.  Samples
+            # from padding-only devices carry a validity flag so they cannot
+            # poison the splitters.
+            inv = (~valid).astype(jnp.uint8)
+            _, hi_s, lo_s = lax.sort((inv, hi, lo), num_keys=3)
+            nvalid = jnp.sum(valid).astype(jnp.int32)
+            pos = (jnp.arange(S, dtype=jnp.int32) * jnp.maximum(nvalid, 1)) // S
+            samp_ok = jnp.broadcast_to(nvalid > 0, (S,))
+            samp_hi = jnp.where(samp_ok, hi_s[pos], _HI_PAD)
+            samp_lo = jnp.where(samp_ok, lo_s[pos], _LO_PAD)
+            all_hi = lax.all_gather(samp_hi, axis, tiled=True)  # [D*S]
+            all_lo = lax.all_gather(samp_lo, axis, tiled=True)
+            all_ok = lax.all_gather(samp_ok, axis, tiled=True)
+            g_inv = (~all_ok).astype(jnp.uint8)
+            _, g_hi, g_lo = lax.sort((g_inv, all_hi, all_lo), num_keys=3)
+            n_ok = jnp.sum(all_ok).astype(jnp.int32)
+            # Quantile cuts over the *valid* sample prefix only.
+            cut = jnp.clip(
+                (jnp.arange(1, d, dtype=jnp.int32) * n_ok) // d,
+                0,
+                d * S - 1,
+            )
+            sp_hi, sp_lo = g_hi[cut], g_lo[cut]  # [D-1] splitters
+
+            # 2. destination bucket: count of splitters <= key ("right"
+            # side keeps ties together on the lower device).
+            key_gt = (hi[:, None] > sp_hi[None, :]) | (
+                (hi[:, None] == sp_hi[None, :])
+                & (lo[:, None] >= sp_lo[None, :])
+            )
+            dest = jnp.sum(key_gt, axis=1).astype(jnp.int32)  # [rows] in [0,D)
+
+            # 3. rank within destination group → send-buffer slot.
+            order = jnp.argsort(
+                jnp.where(valid, dest, d).astype(jnp.int32), stable=True
+            )
+            dsorted = dest[order]
+            group_start = jnp.searchsorted(dsorted, jnp.arange(d, dtype=jnp.int32))
+            rank_sorted = jnp.arange(rows, dtype=jnp.int32) - group_start[
+                jnp.clip(dsorted, 0, d - 1)
+            ]
+            rank = jnp.zeros(rows, jnp.int32).at[order].set(rank_sorted)
+            fits = valid & (rank < cap)
+            slot = jnp.where(fits, dest * cap + rank, d * cap)  # OOB → drop
+            overflow = jnp.sum(valid & ~fits).astype(jnp.int32)
+
+            def scatter(col, pad):
+                buf = jnp.full((d * cap,), pad, dtype=col.dtype)
+                return buf.at[slot].set(col, mode="drop").reshape(d, cap)
+
+            b_hi = scatter(hi, _HI_PAD)
+            b_lo = scatter(lo, _LO_PAD)
+            b_val = scatter(valid, False)
+            b_dev = scatter(jnp.full((rows,), 0, jnp.int32) + dev, -1)
+            b_row = scatter(jnp.arange(rows, dtype=jnp.int32), -1)
+
+            # 4. the shuffle data plane.
+            def exchange(b):
+                return lax.all_to_all(
+                    b, axis, split_axis=0, concat_axis=0, tiled=False
+                ).reshape(d * cap)
+
+            r_hi = exchange(b_hi)
+            r_lo = exchange(b_lo)
+            r_val = exchange(b_val)
+            r_dev = exchange(b_dev)
+            r_row = exchange(b_row)
+
+            # 5. local sort of the received rows.
+            r_inv = (~r_val).astype(jnp.uint8)
+            _, s_hi, s_lo, s_val, s_dev, s_row = lax.sort(
+                (r_inv, r_hi, r_lo, r_val, r_dev, r_row), num_keys=3
+            )
+            total_overflow = lax.psum(overflow, axis)
+            return s_hi, s_lo, s_val, s_dev, s_row, total_overflow
+
+        spec = P(DATA_AXIS)
+        fn = shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, spec, spec, spec, spec, P()),
+        )
+        return jax.jit(fn)
+
+    # -- host-facing API ----------------------------------------------------
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def __call__(
+        self, hi: jax.Array, lo: jax.Array, valid: jax.Array
+    ) -> ShuffleResult:
+        """Inputs are [D*rows] arrays (sharded or host-resident)."""
+        s_hi, s_lo, s_val, s_dev, s_row, ovf = self._step(hi, lo, valid)
+        return ShuffleResult(s_hi, s_lo, s_val, s_dev, s_row, ovf)
+
+    def sort_global(
+        self,
+        keys: np.ndarray,
+        valid: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Host convenience: int64 keys (padded to D*rows) → globally sorted
+        keys + the permutation (indices into the input), via the device mesh.
+        """
+        from ..ops.keys import pack_keys_np, split_keys_np
+
+        n = len(keys)
+        total = self.n_devices * self.rows
+        if n > total:
+            raise ValueError(f"{n} rows exceed mesh budget {total}")
+        # Randomize row placement first: a block-concentrated layout (e.g. an
+        # already-sorted input) would otherwise route one device's whole batch
+        # into a single (src,dst) pair and overflow its capacity.  Host-side
+        # permutation costs no collective; it is inverted via src ids below.
+        rng = np.random.default_rng(0xB462)
+        scatter = rng.permutation(total)
+        pad_keys = np.full(total, (0x7FFFFFFF << 32) | 0xFFFFFFFF, np.int64)
+        v = np.zeros(total, dtype=bool)
+        pad_keys[scatter[:n]] = keys
+        v[scatter[:n]] = True if valid is None else valid
+        inv = np.empty(total, dtype=np.int64)
+        inv[scatter] = np.arange(total)
+        hi, lo = split_keys_np(pad_keys)
+        res = self(jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(v))
+        if int(res.overflow) > 0:
+            raise RuntimeError(
+                f"shuffle capacity exceeded by {int(res.overflow)} rows; "
+                f"re-run with larger capacity_per_pair (now {self.capacity})"
+            )
+        s_val = np.asarray(res.valid)
+        s_hi = np.asarray(res.hi)[s_val]
+        s_lo = np.asarray(res.lo)[s_val]
+        device_pos = (
+            np.asarray(res.src_dev)[s_val].astype(np.int64) * self.rows
+            + np.asarray(res.src_row)[s_val].astype(np.int64)
+        )
+        perm = inv[device_pos]  # undo the randomization pre-pass
+        return pack_keys_np(s_hi, s_lo), perm, int(res.overflow)

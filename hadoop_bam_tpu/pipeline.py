@@ -1,0 +1,165 @@
+"""End-to-end jobs: the reference's example MapReduce programs, TPU-native.
+
+``sort_bam`` is the TestBAM coordinate-sort job (SURVEY.md §3.5): read
+record-aligned splits → batched decode → 64-bit keying → sort → headerless
+parts → merge to one valid BAM.  The sort runs either on one chip
+(``lax.sort``) or across a mesh (range-partitioned ``all_to_all`` shuffle),
+selected by ``mesh``.
+
+The host↔device contract: fixed-field SoA columns and keys live on device;
+ragged record bytes stay host-side and are permuted once at write time (the
+LazyBAMRecord stance — the sort never touches variable-length payloads).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .conf import Configuration
+from .io.bam import (
+    BamInputFormat,
+    BamOutputWriter,
+    RecordBatch,
+    read_header,
+)
+from .io.merger import merge_bam_parts
+from .ops.keys import make_keys, pack_keys_np
+from .ops.sort import sort_keys
+from .parallel.mesh import make_mesh
+from .parallel.shuffle import DistributedSort
+from .spec import bam
+from .utils import nio
+
+
+@dataclass
+class SortStats:
+    n_records: int
+    n_splits: int
+    backend: str
+
+
+def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
+    """One global batch over all splits (offsets rebased into the
+    concatenated sideband)."""
+    if not batches:
+        return RecordBatch(
+            soa={k: np.empty(0, np.int64) for k in bam.SOA_FIELDS},
+            data=np.empty(0, np.uint8),
+            keys=np.empty(0, np.int64),
+        )
+    if len(batches) == 1:
+        return batches[0]
+    data = np.concatenate([b.data for b in batches])
+    base = np.cumsum([0] + [len(b.data) for b in batches[:-1]])
+    soa = {}
+    for k in bam.SOA_FIELDS:
+        cols = [b.soa[k] for b in batches]
+        if k == "rec_off":
+            cols = [c + base[i] for i, c in enumerate(cols)]
+        soa[k] = np.concatenate(cols)
+    keys = np.concatenate([b.keys for b in batches])
+    return RecordBatch(soa=soa, data=data, keys=keys)
+
+
+def _batch_keys_device(batch: RecordBatch) -> np.ndarray:
+    """Device path for key construction (host murmur column for unmapped)."""
+    soa = batch.soa
+    refid = jnp.asarray(soa["refid"].astype(np.int32))
+    pos = jnp.asarray(soa["pos"].astype(np.int32))
+    flag = jnp.asarray(soa["flag"].astype(np.int32))
+    # murmur hashes were already folded into batch.keys by the reader.
+    hash32 = jnp.asarray((batch.keys & 0xFFFFFFFF).astype(np.int32))
+    hi, lo = make_keys(refid, pos, flag, hash32)
+    return pack_keys_np(np.asarray(hi), np.asarray(lo))
+
+
+def sort_bam(
+    in_paths: Sequence[str] | str,
+    out_path: str,
+    conf: Optional[Configuration] = None,
+    split_size: int = 32 << 20,
+    mesh=None,
+    distributed: Optional[DistributedSort] = None,
+    level: int = 6,
+    write_splitting_bai: bool = False,
+) -> SortStats:
+    """Coordinate-sort BAM file(s) into one merged BAM."""
+    if isinstance(in_paths, str):
+        in_paths = [in_paths]
+    fmt = BamInputFormat(conf)
+    header = read_header(in_paths[0]).with_sort_order("coordinate")
+    splits = fmt.get_splits(in_paths, split_size=split_size)
+    batches: List[RecordBatch] = [fmt.read_split(s) for s in splits]
+    all_keys = (
+        np.concatenate([b.keys for b in batches])
+        if batches
+        else np.empty(0, np.int64)
+    )
+    n = len(all_keys)
+
+    if distributed is not None or mesh is not None:
+        ds = distributed
+        if ds is None:
+            mesh = mesh or make_mesh()
+            rows = -(-max(n, 1) // mesh.devices.size)
+            ds = DistributedSort(mesh, rows_per_device=rows)
+        backend = f"mesh[{ds.n_devices}]"
+        try:
+            _, perm, _ = ds.sort_global(all_keys)
+        except RuntimeError:
+            # Degenerate key skew: retry with full capacity.
+            ds = DistributedSort(
+                ds.mesh, ds.rows, capacity_per_pair=ds.rows
+            )
+            _, perm, _ = ds.sort_global(all_keys)
+    else:
+        backend = "single-device"
+        from .ops.keys import split_keys_np
+
+        hi, lo = split_keys_np(all_keys)
+        _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
+        perm = np.asarray(perm)
+
+    # Concatenate batches into one global batch view, then write permuted
+    # parts with the vectorized gather + batched native deflate.
+    from .io.bam import write_part_fast
+
+    merged = _concat_batches(batches)
+    with tempfile.TemporaryDirectory(
+        dir=os.path.dirname(os.path.abspath(out_path)) or "."
+    ) as td:
+        n_parts = max(1, len(batches))
+        bounds = [len(perm) * i // n_parts for i in range(n_parts + 1)]
+        for pi in range(n_parts):
+            order = perm[bounds[pi] : bounds[pi + 1]]
+            part = os.path.join(td, f"part-r-{pi:05d}")
+            sb_stream = None
+            try:
+                if write_splitting_bai:
+                    sb_stream = open(
+                        part + ".splitting-bai", "wb"
+                    )
+                with open(part, "wb") as f:
+                    write_part_fast(
+                        f,
+                        merged,
+                        order=order,
+                        level=level,
+                        splitting_bai_stream=sb_stream,
+                    )
+            finally:
+                if sb_stream is not None:
+                    sb_stream.close()
+        nio.write_success(td)
+        merge_bam_parts(
+            td, out_path, header, write_splitting_bai=write_splitting_bai
+        )
+    return SortStats(n_records=n, n_splits=len(splits), backend=backend)

@@ -103,6 +103,20 @@ def parse_block_header(buf: bytes, pos: int = 0) -> Optional[Tuple[int, int]]:
     return None
 
 
+def read_block_at(buf, pos: int) -> Tuple[int, int]:
+    """(csize, usize) of the BGZF block at ``pos``, ISIZE-validated — the one
+    shared header probe used by every chain walker."""
+    hdr = parse_block_header(buf, pos)
+    if hdr is None:
+        raise BgzfError(f"bad BGZF block at {pos}")
+    if pos + hdr[0] > len(buf):
+        raise BgzfError(f"truncated BGZF block at offset {pos}")
+    usize = struct.unpack_from("<I", buf, pos + hdr[0] - 4)[0]
+    if usize > MAX_BLOCK_SIZE:
+        raise BgzfError(f"ISIZE {usize} beyond BGZF bound at {pos}")
+    return hdr[0], usize
+
+
 def find_next_block(buf: bytes, start: int = 0) -> Optional[Tuple[int, int]]:
     """Scan ``buf`` from ``start`` for the next plausible BGZF block header.
 

@@ -1,0 +1,23 @@
+"""Driver-contract smoke tests (mirrors what the driver runs)."""
+
+import numpy as np
+
+import jax
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    hi_s = np.asarray(out[0])
+    lo_s = np.asarray(out[1])
+    packed = (hi_s.astype(np.int64) << 32) | lo_s.astype(np.int64)
+    assert np.array_equal(packed, np.sort(packed)), "entry output not sorted"
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)

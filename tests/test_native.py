@@ -76,6 +76,28 @@ def test_find_next_block_guessing():
     assert native.find_next_block(blob, int(co[-1]) + 1) == -1
 
 
+def test_gather_records_with_partial_order(reference_resources):
+    # A permutation slice shorter than the batch must only emit (and read)
+    # that many rows — regression for an OOB read of the order array.
+    raw = (reference_resources / "test.bam").read_bytes()
+    data = native.decompress_all(raw)
+    _, p = bam.BamHeader.decode(data.tobytes())
+    offs = native.record_chain(data, p)
+    lens = np.array(
+        [int.from_bytes(data[o : o + 4].tobytes(), "little") for o in offs],
+        dtype=np.int64,
+    )
+    body_offs = offs + 4
+    order = np.array([5, 3, 100], dtype=np.int32)
+    out = native.gather_records(data, body_offs, lens, order)
+    expect = b"".join(
+        data[offs[i] : offs[i] + 4 + lens[i]].tobytes() for i in order
+    )
+    assert out.tobytes() == expect
+    full = native.gather_records(data, body_offs, lens, None)
+    assert full.tobytes() == data[p:].tobytes()
+
+
 def test_whole_file_decompress(reference_resources):
     raw = (reference_resources / "test.bam").read_bytes()
     assert native.decompress_all(raw).tobytes() == bgzf.decompress_all(raw)

@@ -1,0 +1,202 @@
+"""Device-op tests (CPU mesh): decode, keys, sort, quality, cigar, pallas."""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hadoop_bam_tpu.ops import cigar as cigar_ops
+from hadoop_bam_tpu.ops import decode as decode_ops
+from hadoop_bam_tpu.ops import keys as keys_ops
+from hadoop_bam_tpu.ops import quality as quality_ops
+from hadoop_bam_tpu.ops import sort as sort_ops
+from hadoop_bam_tpu.spec import bam
+
+
+def make_batch(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        unmapped = i % 9 == 0
+        cig = [] if unmapped else [(30, "M"), (5, "I"), (10, "M"), (20, "S")]
+        recs.append(
+            bam.build_record(
+                f"q{i:04d}",
+                -1 if unmapped else int(rng.integers(0, 4)),
+                -1 if unmapped else int(rng.integers(0, 1 << 24)),
+                60,
+                bam.FLAG_UNMAPPED if unmapped else 0,
+                cig,
+                "ACGT" * 10 + "NNACG",
+                bytes(rng.integers(10, 40, 45).tolist()),
+            )
+        )
+    blob = b"".join(r.encode() for r in recs)
+    offsets = bam.record_offsets(np.frombuffer(blob, np.uint8), 0)
+    soa = bam.soa_decode(blob, offsets)
+    return blob, offsets, soa, recs
+
+
+class TestDeviceDecode:
+    def test_matches_host_oracle(self):
+        blob, offsets, soa, recs = make_batch()
+        out = decode_ops.soa_decode_device(
+            jnp.asarray(np.frombuffer(blob, np.uint8)),
+            jnp.asarray(offsets.astype(np.int32)),
+        )
+        for k in bam.SOA_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(out[k]).astype(np.int64),
+                soa[k].astype(np.int64),
+                err_msg=k,
+            )
+
+    def test_pad_offsets(self):
+        padded, valid = decode_ops.pad_offsets(np.array([0, 100, 200]), 8)
+        assert list(padded[:3]) == [0, 100, 200]
+        assert valid.sum() == 3 and not valid[3:].any()
+        with pytest.raises(ValueError):
+            decode_ops.pad_offsets(np.arange(9), 8)
+
+
+class TestKeys:
+    def test_device_keys_match_reference_oracle(self):
+        blob, offsets, soa, recs = make_batch()
+        oracle = bam.soa_keys(soa, blob)
+        hash32 = (oracle & 0xFFFFFFFF).astype(np.int64)
+        hash32 = np.where(hash32 >= 1 << 31, hash32 - (1 << 32), hash32).astype(
+            np.int32
+        )
+        hi, lo = keys_ops.make_keys(
+            jnp.asarray(soa["refid"].astype(np.int32)),
+            jnp.asarray(soa["pos"].astype(np.int32)),
+            jnp.asarray(soa["flag"].astype(np.int32)),
+            jnp.asarray(hash32),
+        )
+        packed = keys_ops.pack_keys_np(np.asarray(hi), np.asarray(lo))
+        np.testing.assert_array_equal(packed, oracle)
+
+    def test_sign_extension_quirk(self):
+        # mapped pos=-1 → whole key -1 (Java | sign extension).
+        hi, lo = keys_ops.make_keys(
+            jnp.asarray(np.array([2], np.int32)),
+            jnp.asarray(np.array([-1], np.int32)),
+            jnp.asarray(np.array([0], np.int32)),
+            jnp.asarray(np.array([0], np.int32)),
+        )
+        assert keys_ops.pack_keys_np(np.asarray(hi), np.asarray(lo))[0] == -1
+
+    def test_split_pack_roundtrip(self):
+        keys = np.array([-1, 0, 1 << 40, (3 << 32) | 7, -(5 << 32)], np.int64)
+        hi, lo = keys_ops.split_keys_np(keys)
+        np.testing.assert_array_equal(keys_ops.pack_keys_np(hi, lo), keys)
+
+
+class TestSort:
+    def test_sort_matches_numpy_signed_order(self):
+        rng = np.random.default_rng(3)
+        keys = rng.integers(-(1 << 62), 1 << 62, 5000, dtype=np.int64)
+        hi, lo = keys_ops.split_keys_np(keys)
+        hi_s, lo_s, perm = sort_ops.sort_keys(jnp.asarray(hi), jnp.asarray(lo))
+        got = keys_ops.pack_keys_np(np.asarray(hi_s), np.asarray(lo_s))
+        np.testing.assert_array_equal(got, np.sort(keys))
+        np.testing.assert_array_equal(keys[np.asarray(perm)], got)
+
+    def test_invalid_rows_sink(self):
+        keys = np.array([5, -3, 7, 1], np.int64)
+        valid = np.array([True, True, False, True])
+        hi, lo = keys_ops.split_keys_np(keys)
+        hi_s, lo_s, perm = sort_ops.sort_keys(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid)
+        )
+        got = keys_ops.pack_keys_np(np.asarray(hi_s), np.asarray(lo_s))
+        assert list(got[:3]) == [-3, 1, 5]
+        assert np.asarray(perm)[3] == 2
+
+
+class TestQuality:
+    def test_conversions_roundtrip(self):
+        q = np.arange(33, 33 + 63, dtype=np.uint8).reshape(1, -1)
+        il = quality_ops.sanger_to_illumina(jnp.asarray(q))
+        back = quality_ops.illumina_to_sanger(il)
+        np.testing.assert_array_equal(np.asarray(back), q)
+
+    def test_verify_reports_first_bad_index(self):
+        q = np.full((2, 5), 40, np.uint8)
+        q[1, 3] = 10  # below Sanger offset 33
+        valid = np.ones((2, 5), bool)
+        idx = quality_ops.verify_quality_sanger(jnp.asarray(q), jnp.asarray(valid))
+        assert list(np.asarray(idx)) == [-1, 3]
+        # Masked positions are ignored.
+        valid[1, 3] = False
+        idx2 = quality_ops.verify_quality_sanger(jnp.asarray(q), jnp.asarray(valid))
+        assert list(np.asarray(idx2)) == [-1, -1]
+
+    def test_histogram_matches_bincount(self):
+        rng = np.random.default_rng(5)
+        v = rng.integers(0, 64, (50, 30)).astype(np.uint8)
+        m = rng.random((50, 30)) < 0.8
+        h = quality_ops.histogram_u8(jnp.asarray(v), jnp.asarray(m), nbins=64)
+        np.testing.assert_array_equal(
+            np.asarray(h), np.bincount(v[m], minlength=64)
+        )
+
+
+class TestCigar:
+    def test_reference_lengths_match_objects(self):
+        blob, offsets, soa, recs = make_batch()
+        spans = cigar_ops.reference_lengths_np(
+            np.frombuffer(blob, np.uint8), soa
+        )
+        expect = np.array([r.reference_length() for r in recs])
+        np.testing.assert_array_equal(spans, expect)
+
+    def test_padded_device_version_agrees(self):
+        blob, offsets, soa, recs = make_batch()
+        data = np.frombuffer(blob, np.uint8)
+        packed = cigar_ops.pack_cigars_padded(data, soa, max_ops=8)
+        spans = cigar_ops.reference_lengths_padded(jnp.asarray(packed))
+        expect = cigar_ops.reference_lengths_np(data, soa)
+        np.testing.assert_array_equal(np.asarray(spans), expect)
+
+    def test_overlap_mask_exact(self):
+        blob, offsets, soa, recs = make_batch()
+        data = np.frombuffer(blob, np.uint8)
+        spans = cigar_ops.reference_lengths_np(data, soa)
+        iv_refid = np.array([1, 2], np.int32)
+        iv_beg = np.array([1000, 1 << 20], np.int32)
+        iv_end = np.array([1 << 22, 1 << 23], np.int32)
+        mask = cigar_ops.overlap_mask(
+            jnp.asarray(soa["refid"].astype(np.int32)),
+            jnp.asarray(soa["pos"].astype(np.int32)),
+            jnp.asarray(spans.astype(np.int32)),
+            jnp.asarray(iv_refid),
+            jnp.asarray(iv_beg),
+            jnp.asarray(iv_end),
+        )
+        expect = np.zeros(len(recs), bool)
+        for i, r in enumerate(recs):
+            if r.pos < 0:
+                continue
+            end = r.pos + max(1, r.reference_length())
+            for rid, b, e in zip(iv_refid, iv_beg, iv_end):
+                if r.refid == rid and r.pos < e and end > b:
+                    expect[i] = True
+        np.testing.assert_array_equal(np.asarray(mask), expect)
+
+
+class TestPallasHistogram:
+    def test_interpret_mode_matches_numpy(self):
+        from hadoop_bam_tpu.ops.pallas import quality_histogram
+
+        rng = np.random.default_rng(11)
+        v = rng.integers(0, 94, (130, 40)).astype(np.int32)
+        m = (rng.random((130, 40)) < 0.7).astype(np.int32)
+        h = quality_histogram(
+            jnp.asarray(v), jnp.asarray(m), nbins=128, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(h), np.bincount(v[m.astype(bool)], minlength=128)
+        )

@@ -1,0 +1,76 @@
+"""Distributed shuffle-sort tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.parallel import DistributedSort, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def test_mesh_has_8_devices(mesh):
+    assert mesh.devices.size == 8
+
+
+def test_random_keys_sort_globally(mesh):
+    ds = DistributedSort(mesh, rows_per_device=500)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(-(1 << 62), 1 << 62, 3700, dtype=np.int64)
+    skeys, perm, ovf = ds.sort_global(keys)
+    assert ovf == 0
+    np.testing.assert_array_equal(skeys, np.sort(keys))
+    np.testing.assert_array_equal(keys[perm], skeys)
+
+
+def test_bam_like_keys_with_unmapped_tail(mesh):
+    # refid<<32|pos keys plus INT_MAX-headed unmapped keys and the negative
+    # sign-extension quirk keys: the global order must match numpy's signed
+    # sort, with negatives first and INT_MAX block last.
+    rng = np.random.default_rng(1)
+    mapped = (rng.integers(0, 24, 3000, dtype=np.int64) << 32) | rng.integers(
+        0, 1 << 28, 3000, dtype=np.int64
+    )
+    unmapped = (np.int64(0x7FFFFFFF) << 32) | rng.integers(
+        0, 1 << 32, 300, dtype=np.int64
+    )
+    quirk = np.full(10, -1, dtype=np.int64)
+    keys = np.concatenate([mapped, unmapped, quirk])
+    rng.shuffle(keys)
+    ds = DistributedSort(make_mesh(), rows_per_device=600)
+    skeys, perm, _ = ds.sort_global(keys)
+    np.testing.assert_array_equal(skeys, np.sort(keys))
+    assert skeys[0] == -1
+
+
+def test_skewed_keys_overflow_detected_not_dropped(mesh):
+    ds = DistributedSort(mesh, rows_per_device=400, capacity_per_pair=80)
+    keys = np.zeros(3200, dtype=np.int64)  # worst-case skew
+    with pytest.raises(RuntimeError, match="capacity exceeded"):
+        ds.sort_global(keys)
+    # Full capacity always succeeds.
+    ds2 = DistributedSort(mesh, rows_per_device=400, capacity_per_pair=400)
+    skeys, perm, ovf = ds2.sort_global(keys)
+    assert ovf == 0 and len(skeys) == 3200
+
+
+def test_partial_fill_and_valid_mask(mesh):
+    ds = DistributedSort(mesh, rows_per_device=128)
+    keys = np.arange(100, dtype=np.int64)[::-1].copy()
+    skeys, perm, ovf = ds.sort_global(keys)
+    assert ovf == 0
+    np.testing.assert_array_equal(skeys, np.arange(100))
+    np.testing.assert_array_equal(perm, np.arange(100)[::-1])
+
+
+def test_presorted_and_reverse_inputs(mesh):
+    ds = DistributedSort(mesh, rows_per_device=256)
+    for keys in (
+        np.arange(2000, dtype=np.int64),
+        np.arange(2000, dtype=np.int64)[::-1].copy(),
+    ):
+        skeys, perm, ovf = ds.sort_global(keys)
+        assert ovf == 0
+        np.testing.assert_array_equal(skeys, np.arange(2000))
