@@ -1,0 +1,99 @@
+"""AnySAM dispatch: extension trust + first-byte content sniffing.
+
+Reference semantics (AnySAMInputFormat.java / SAMFormat.java):
+- with ``hadoopbam.anysam.trust-exts`` (default true), `.bam`/`.cram`/`.sam`
+  extensions decide (SAMFormat.inferFromFilePath),
+- otherwise the first byte: ``0x1f`` (gzip/BGZF) → BAM, ``C`` (CRAM magic)
+  → CRAM, ``@`` (header line) → SAM (SAMFormat.java:53-62),
+- per-path format decisions are cached (AnySAMInputFormat.java:126-156),
+- getSplits partitions by format and delegates to the per-format planners
+  (:223-256).
+
+Output side: ``AnySamOutputFormat`` picks the writer from
+``hadoopbam.anysam.output-format`` (AnySAMOutputFormat.java:32-58).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from ..conf import ANYSAM_TRUST_EXTS, Configuration
+from .bam import BamInputFormat, RecordBatch
+from .sam import SamInputFormat
+from .splits import ByteSplit, FileVirtualSplit
+
+AnySplit = Union[ByteSplit, FileVirtualSplit]
+
+
+def infer_from_file_path(path: str) -> Optional[str]:
+    low = path.lower()
+    if low.endswith(".bam"):
+        return "bam"
+    if low.endswith(".cram"):
+        return "cram"
+    if low.endswith(".sam"):
+        return "sam"
+    return None
+
+
+def infer_from_data(first_byte: int) -> Optional[str]:
+    """SAMFormat.inferFromData (SAMFormat.java:53-62)."""
+    if first_byte == 0x1F:
+        return "bam"
+    if first_byte == 0x43:  # 'C' of the CRAM magic
+        return "cram"
+    if first_byte == 0x40:  # '@' of a header line
+        return "sam"
+    return None
+
+
+class AnySamInputFormat:
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        self._format_cache: Dict[str, Optional[str]] = {}
+        self._bam = BamInputFormat(self.conf)
+        self._sam = SamInputFormat(self.conf)
+
+    def get_format(self, path: str) -> str:
+        if path in self._format_cache:
+            fmt = self._format_cache[path]
+        else:
+            fmt = None
+            if self.conf.get_boolean(ANYSAM_TRUST_EXTS, True):
+                fmt = infer_from_file_path(path)
+            if fmt is None:
+                with open(path, "rb") as f:
+                    head = f.read(1)
+                fmt = infer_from_data(head[0]) if head else None
+            self._format_cache[path] = fmt
+        if fmt is None:
+            raise IOError(f"unknown SAM format in {path}")
+        return fmt
+
+    def get_splits(self, paths, split_size: int = 4 << 20) -> List[AnySplit]:
+        by_fmt: Dict[str, List[str]] = {}
+        for p in paths:
+            by_fmt.setdefault(self.get_format(p), []).append(p)
+        out: List[AnySplit] = []
+        for fmt, group in sorted(by_fmt.items()):
+            if fmt == "bam":
+                out.extend(self._bam.get_splits(group, split_size))
+            elif fmt == "sam":
+                out.extend(self._sam.get_splits(group, split_size))
+            else:
+                from .cram import CramInputFormat
+
+                out.extend(
+                    CramInputFormat(self.conf).get_splits(group, split_size)
+                )
+        return out
+
+    def read_split(self, split: AnySplit) -> RecordBatch:
+        if isinstance(split, FileVirtualSplit):
+            return self._bam.read_split(split)
+        fmt = self.get_format(split.path)
+        if fmt == "sam":
+            return self._sam.read_split(split)
+        from .cram import CramInputFormat
+
+        return CramInputFormat(self.conf).read_split(split)

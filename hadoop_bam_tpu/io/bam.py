@@ -506,7 +506,10 @@ def write_part_fast(
     from the deterministic blocking (payload cut every MAX_PAYLOAD bytes),
     so no per-record Python loop runs.  Returns bytes written."""
     payload = gather_record_bytes(batch, order)
-    blob = native.deflate_blocks(payload, level=level, threads=threads)
+    # Explicit block size: the analytic voffset math below depends on it.
+    blob = native.deflate_blocks(
+        payload, level=level, threads=threads, block_payload=bgzf.MAX_PAYLOAD
+    )
     stream.write(blob)
     if splitting_bai_stream is not None:
         ln = batch.soa["rec_len"].astype(np.int64) + 4

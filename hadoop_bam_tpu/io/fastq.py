@@ -1,0 +1,220 @@
+"""FASTQ input/output with the reference's resync, ID-parse, and quality
+rules (FastqInputFormat.java, FastqOutputFormat.java).
+
+- split resync: scan for an ``@`` line with a ``+`` line two lines later,
+  backtracking when the guess was the quality line (:156-198),
+- Casava 1.8 Illumina ID regex → metadata (:92-93, 362-381), ``/N``
+  read-number suffix fallback (:349-360),
+- qualities converted to Sanger (Illumina input) or range-verified
+  (:318-341); failed-QC filtering per ``hbam.fastq-input.filter-failed-qc``,
+- writer reconstructs the ID from metadata when present and re-encodes
+  quality per ``hbam.fastq-output.base-quality-encoding``
+  (FastqOutputFormat.java:117-183).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from ..conf import (
+    Configuration,
+    FASTQ_BASE_QUALITY_ENCODING,
+    FASTQ_FILTER_FAILED_QC,
+    FASTQ_OUTPUT_BASE_QUALITY_ENCODING,
+    INPUT_BASE_QUALITY_ENCODING,
+    INPUT_FILTER_FAILED_QC,
+)
+from ..spec.fragment import (
+    FormatException,
+    FragmentBatch,
+    SequencedFragment,
+    convert_quality,
+    verify_quality,
+)
+from .splits import ByteSplit
+from .text import SplitLineReader, plan_byte_splits, read_decompressed
+
+# Casava 1.8: instrument:run:flowcell:lane:tile:x:y read:filtered:control:index
+ILLUMINA_PATTERN = re.compile(
+    r"([^:]+):(\d+):([^:]*):(\d+):(\d+):(-?\d+):(-?\d+)\s+([123]):([YN]):(\d+):(.*)"
+)
+
+
+def scan_illumina_id(name: str, frag: SequencedFragment) -> bool:
+    m = ILLUMINA_PATTERN.fullmatch(name)
+    if not m:
+        return False
+    frag.instrument = m.group(1)
+    frag.run_number = int(m.group(2))
+    frag.flowcell_id = m.group(3)
+    frag.lane = int(m.group(4))
+    frag.tile = int(m.group(5))
+    frag.xpos = int(m.group(6))
+    frag.ypos = int(m.group(7))
+    frag.read = int(m.group(8))
+    frag.filter_passed = m.group(9) == "N"
+    frag.control_number = int(m.group(10))
+    frag.index_sequence = m.group(11)
+    return True
+
+
+def scan_read_number(name: str, frag: SequencedFragment) -> None:
+    """``/N`` suffix fallback (FastqInputFormat.java:349-360)."""
+    if len(name) >= 2 and name[-2] == "/" and name[-1].isdigit():
+        frag.read = int(name[-1])
+
+
+class FastqInputFormat:
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+
+    def _encoding(self) -> str:
+        enc = self.conf.get(
+            FASTQ_BASE_QUALITY_ENCODING,
+            self.conf.get(INPUT_BASE_QUALITY_ENCODING, "sanger"),
+        )
+        if enc not in ("sanger", "illumina"):
+            raise ValueError(f"Unknown input base quality encoding value {enc}")
+        return enc
+
+    def _filter_failed(self) -> bool:
+        raw = self.conf.get(
+            FASTQ_FILTER_FAILED_QC, self.conf.get(INPUT_FILTER_FAILED_QC)
+        )
+        c = Configuration({"k": raw} if raw is not None else None)
+        return c.get_boolean("k", False)
+
+    def get_splits(self, paths, split_size: int = 4 << 20) -> List[ByteSplit]:
+        out: List[ByteSplit] = []
+        for p in sorted(paths):
+            out.extend(plan_byte_splits(p, split_size))
+        return out
+
+    def position_at_first_record(
+        self, data: bytes, start: int, end: int
+    ) -> int:
+        """The @/+ resync with backtracking (FastqInputFormat.java:156-198)."""
+        if start == 0:
+            return 0
+        r = SplitLineReader(data, start, len(data))
+        pos = r.tell()
+        while pos < end:
+            line_start = pos
+            line = r.read_line()
+            if line is None:
+                return len(data)
+            if line.startswith(b"@"):
+                backtrack = r.tell()
+                r.read_line()  # sequence?
+                third = r.read_line()  # '+' if line_start was a record start
+                if third is not None and third.startswith(b"+"):
+                    return line_start
+                r.pos = backtrack  # it was a quality line: resume after it
+                pos = backtrack
+            else:
+                pos = r.tell()
+        return pos
+
+    def read_split(
+        self, split: ByteSplit, data: Optional[bytes] = None
+    ) -> FragmentBatch:
+        if data is None:
+            import os
+
+            raw_size = os.path.getsize(split.path)
+            data = read_decompressed(split.path)
+            if len(data) != raw_size and split.start == 0:
+                # unsplittable compressed file: the single split covers the
+                # whole decompressed payload
+                split = ByteSplit(split.path, 0, len(data))
+        start = self.position_at_first_record(data, split.start, split.end)
+        r = SplitLineReader(data, 0, split.end)
+        r.pos = start
+        encoding = self._encoding()
+        filter_failed = self._filter_failed()
+        names: List[str] = []
+        frags: List[SequencedFragment] = []
+        look_for_illumina = True
+        while r.pos < split.end:
+            id_line = r.read_line()
+            if id_line is None:
+                break
+            if not id_line.startswith(b"@"):
+                raise FormatException(
+                    f"unexpected fastq record start at {split.path}: {id_line!r}"
+                )
+            name = id_line[1:].decode()
+            seq = r.read_line()
+            plus = r.read_line()
+            qual = r.read_line()
+            if seq is None or plus is None or qual is None:
+                raise FormatException(
+                    f"unexpected end of file in fastq record. Id: {name}"
+                )
+            if not plus.startswith(b"+"):
+                raise FormatException(
+                    "unexpected fastq line separating sequence and quality: "
+                    f"{plus!r}. Sequence ID: {name}"
+                )
+            frag = SequencedFragment(sequence=bytes(seq), quality=bytes(qual))
+            look_for_illumina = look_for_illumina and scan_illumina_id(
+                name, frag
+            )
+            if not look_for_illumina:
+                scan_read_number(name, frag)
+            if filter_failed and frag.filter_passed is False:
+                continue
+            if encoding == "illumina":
+                frag.quality = convert_quality(
+                    frag.quality, "illumina", "sanger"
+                )
+            else:
+                bad = verify_quality(frag.quality, "sanger")
+                if bad >= 0:
+                    raise FormatException(
+                        "fastq base quality score out of range for Sanger "
+                        f"Phred+33 format (found {frag.quality[bad] - 33}).\n"
+                        "Although Sanger format has been requested, maybe "
+                        "qualities are in Illumina Phred+64 format?\n"
+                        f"Sequence ID: {name}"
+                    )
+            names.append(name)
+            frags.append(frag)
+        return FragmentBatch.from_fragments(names, frags)
+
+
+class FastqOutputFormat:
+    """Write fragments as FASTQ (FastqOutputFormat.java semantics)."""
+
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        enc = self.conf.get(FASTQ_OUTPUT_BASE_QUALITY_ENCODING, "sanger")
+        if enc not in ("sanger", "illumina"):
+            raise ValueError(f"Unknown output base quality encoding {enc}")
+        self.encoding = enc
+
+    def format_record(
+        self, frag: SequencedFragment, key: Optional[str] = None
+    ) -> bytes:
+        if frag.instrument is not None:
+            # Reconstruct the Casava 1.8 id (FastqOutputFormat.java:117-145).
+            name = (
+                f"{frag.instrument}:{frag.run_number}:{frag.flowcell_id}:"
+                f"{frag.lane}:{frag.tile}:{frag.xpos}:{frag.ypos} "
+                f"{frag.read or 1}:"
+                f"{'N' if frag.filter_passed in (None, True) else 'Y'}:"
+                f"{frag.control_number or 0}:{frag.index_sequence or ''}"
+            )
+        elif key is not None:
+            name = key
+        else:
+            name = ""
+        qual = frag.quality
+        if self.encoding == "illumina":
+            qual = convert_quality(qual, "sanger", "illumina")
+        return b"@" + name.encode() + b"\n" + frag.sequence + b"\n+\n" + qual + b"\n"
+
+    def write(self, stream, batch: FragmentBatch) -> None:
+        for name, frag in zip(batch.names, batch.fragments):
+            stream.write(self.format_record(frag, key=name))

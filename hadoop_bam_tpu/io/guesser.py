@@ -150,9 +150,7 @@ class BamSplitGuesser:
             try:
                 csize, usize = bgzf.read_block_at(window, pos)
             except bgzf.BgzfError:
-                break  # chain ends (or lying ISIZE) inside the window
-            if pos + csize > len(window):
-                break
+                break  # chain ends, truncates, or lies inside the window
             co.append(pos)
             cs.append(csize)
             us.append(usize)

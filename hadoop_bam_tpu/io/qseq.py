@@ -1,0 +1,154 @@
+"""QSEQ input/output (QseqInputFormat.java / QseqOutputFormat.java).
+
+11 tab-separated fields per line: machine, run, lane, tile, x, y, index,
+read, sequence, quality, filter.  Key = ``machine:run:lane:tile:x:y:read``
+(:344-363); ``.`` bases become ``N`` and the index field treats ``0`` as
+null (:378-385); default input quality encoding is Illumina Phred+64,
+converted to Sanger (:403-426).  Split resync = drop the partial first line
+(:136-155).  The writer emits ``N``→``.`` and re-encodes quality
+(QseqOutputFormat.java:98-157).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..conf import (
+    Configuration,
+    INPUT_BASE_QUALITY_ENCODING,
+    INPUT_FILTER_FAILED_QC,
+    QSEQ_BASE_QUALITY_ENCODING,
+    QSEQ_FILTER_FAILED_QC,
+    QSEQ_OUTPUT_BASE_QUALITY_ENCODING,
+)
+from ..spec.fragment import (
+    FormatException,
+    FragmentBatch,
+    SequencedFragment,
+    convert_quality,
+    verify_quality,
+)
+from .splits import ByteSplit
+from .text import SplitLineReader, plan_byte_splits, read_decompressed
+
+NUM_QSEQ_COLS = 11
+
+
+def parse_qseq_line(line: bytes) -> tuple[str, SequencedFragment]:
+    fields = line.split(b"\t")
+    if len(fields) != NUM_QSEQ_COLS:
+        raise FormatException(
+            f"found {len(fields)} fields instead of 11. Line: {line!r}"
+        )
+    frag = SequencedFragment()
+    frag.instrument = fields[0].decode()
+    frag.run_number = int(fields[1])
+    frag.lane = int(fields[2])
+    frag.tile = int(fields[3])
+    frag.xpos = int(fields[4])
+    frag.ypos = int(fields[5])
+    frag.read = int(fields[7])
+    frag.filter_passed = fields[10][:1] != b"0"
+    if fields[6][:1] == b"0":  # 0 is a null index sequence (:378-382)
+        frag.index_sequence = None
+    else:
+        frag.index_sequence = fields[6].decode().replace(".", "N")
+    frag.sequence = fields[8].replace(b".", b"N")
+    frag.quality = bytes(fields[9])
+    key = b":".join(fields[0:6] + [fields[7]]).decode()
+    return key, frag
+
+
+class QseqInputFormat:
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+
+    def _encoding(self) -> str:
+        enc = self.conf.get(
+            QSEQ_BASE_QUALITY_ENCODING,
+            self.conf.get(INPUT_BASE_QUALITY_ENCODING, "illumina"),
+        )
+        if enc not in ("sanger", "illumina"):
+            raise ValueError(f"Unknown input base quality encoding value {enc}")
+        return enc
+
+    def _filter_failed(self) -> bool:
+        raw = self.conf.get(
+            QSEQ_FILTER_FAILED_QC, self.conf.get(INPUT_FILTER_FAILED_QC)
+        )
+        c = Configuration({"k": raw} if raw is not None else None)
+        return c.get_boolean("k", False)
+
+    def get_splits(self, paths, split_size: int = 4 << 20) -> List[ByteSplit]:
+        out: List[ByteSplit] = []
+        for p in sorted(paths):
+            out.extend(plan_byte_splits(p, split_size))
+        return out
+
+    def read_split(
+        self, split: ByteSplit, data: Optional[bytes] = None
+    ) -> FragmentBatch:
+        if data is None:
+            import os
+
+            raw_size = os.path.getsize(split.path)
+            data = read_decompressed(split.path)
+            if len(data) != raw_size and split.start == 0:
+                split = ByteSplit(split.path, 0, len(data))
+        r = SplitLineReader(data, split.start, split.end)
+        encoding = self._encoding()
+        filter_failed = self._filter_failed()
+        names: List[str] = []
+        frags: List[SequencedFragment] = []
+        for _, line in r.lines():
+            if not line:
+                continue
+            key, frag = parse_qseq_line(line)
+            if filter_failed and frag.filter_passed is False:
+                continue
+            if encoding == "illumina":
+                frag.quality = convert_quality(frag.quality, "illumina", "sanger")
+            else:
+                bad = verify_quality(frag.quality, "sanger")
+                if bad >= 0:
+                    raise FormatException(
+                        "qseq base quality score out of range for Sanger "
+                        f"Phred+33 format (found {frag.quality[bad] - 33})."
+                    )
+            names.append(key)
+            frags.append(frag)
+        return FragmentBatch.from_fragments(names, frags)
+
+
+class QseqOutputFormat:
+    """Write fragments as QSEQ lines (QseqOutputFormat.java:98-157)."""
+
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        enc = self.conf.get(QSEQ_OUTPUT_BASE_QUALITY_ENCODING, "illumina")
+        if enc not in ("sanger", "illumina"):
+            raise ValueError(f"Unknown output base quality encoding {enc}")
+        self.encoding = enc
+
+    def format_record(self, frag: SequencedFragment) -> bytes:
+        qual = frag.quality
+        if self.encoding == "illumina":
+            qual = convert_quality(qual, "sanger", "illumina")
+        fields = [
+            (frag.instrument or "").encode(),
+            str(frag.run_number or 0).encode(),
+            str(frag.lane or 0).encode(),
+            str(frag.tile or 0).encode(),
+            str(frag.xpos or 0).encode(),
+            str(frag.ypos or 0).encode(),
+            (frag.index_sequence or "0").encode(),
+            str(frag.read or 1).encode(),
+            frag.sequence.replace(b"N", b"."),
+            qual,
+            b"1" if frag.filter_passed in (None, True) else b"0",
+        ]
+        return b"\t".join(fields) + b"\n"
+
+    def write(self, stream, batch: FragmentBatch) -> None:
+        for frag in batch.fragments:
+            stream.write(self.format_record(frag))

@@ -1,0 +1,104 @@
+"""Text-format plumbing: byte splits, line iteration, split resync rules.
+
+The LineReader-layer equivalent (reference LineReader.java fork of Hadoop's):
+CR/LF/CRLF handling, plus the classic split protocol — a reader whose split
+starts mid-file discards the partial first line and reads one record past
+its end so every record belongs to exactly one split
+(SAMRecordReader.java:108-146, QseqInputFormat.java:136-155).
+
+Compressed text files are unsplittable (single full-file split), matching
+FastqInputFormat.java:393-398 — except BGZF, which the VCF path handles
+with virtual splits.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Iterator, List, Optional, Tuple
+
+from ..spec import bgzf
+from .splits import ByteSplit
+
+MAX_LINE_LENGTH = 20000  # reference FastqInputFormat.java MAX_LINE_LENGTH
+
+
+def is_gzip(path: str) -> bool:
+    with open(path, "rb") as f:
+        return f.read(2) == b"\x1f\x8b"
+
+
+def plan_byte_splits(
+    path: str, split_size: int, splittable: Optional[bool] = None
+) -> List[ByteSplit]:
+    size = os.path.getsize(path)
+    if splittable is None:
+        splittable = not is_gzip(path)
+    if not splittable:
+        return [ByteSplit(path, 0, size)] if size else []
+    return [
+        ByteSplit(path, s, min(split_size, size - s))
+        for s in range(0, size, split_size)
+    ]
+
+
+def read_decompressed(path: str) -> bytes:
+    """Whole-file read through the gzip/BGZF codec chain (the
+    CompressionCodecFactory role, VCFRecordReader.java:121-131)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:2] == b"\x1f\x8b":
+        if bgzf.is_bgzf(raw):
+            return bgzf.decompress_all(raw)
+        return gzip.decompress(raw)
+    return raw
+
+
+class SplitLineReader:
+    """Iterate complete lines of one byte split of an uncompressed file.
+
+    A split starting at ``start > 0`` skips the (possibly partial) first
+    line; iteration continues past ``end`` to finish the last line that
+    *started* inside the split.  Line terminators (LF or CRLF) are stripped,
+    as in the reference LineReader (:111-173).
+    """
+
+    def __init__(self, data: bytes, start: int, end: int):
+        self.data = data
+        self.end = end
+        if start > 0:
+            nl = data.find(b"\n", start - 1)
+            self.pos = len(data) if nl < 0 else nl + 1
+        else:
+            self.pos = 0
+
+    def tell(self) -> int:
+        return self.pos
+
+    def at_end(self) -> bool:
+        return self.pos >= self.end or self.pos >= len(self.data)
+
+    def read_line(self) -> Optional[bytes]:
+        """Next line (terminator stripped) regardless of the split end;
+        None at EOF."""
+        if self.pos >= len(self.data):
+            return None
+        nl = self.data.find(b"\n", self.pos)
+        if nl < 0:
+            line = self.data[self.pos :]
+            self.pos = len(self.data)
+        else:
+            line = self.data[self.pos : nl]
+            self.pos = nl + 1
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        return line
+
+    def lines(self) -> Iterator[Tuple[int, bytes]]:
+        """(start_offset, line) for every line starting inside the split."""
+        while not self.at_end():
+            at = self.pos
+            line = self.read_line()
+            if line is None:
+                break
+            yield at, line

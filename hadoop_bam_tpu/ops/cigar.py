@@ -59,9 +59,15 @@ def pack_cigars_padded(
     """Gather cigars into a device-friendly [N, max_ops] uint32 tensor
     (0-padded; op code 0 with length 0 is a no-op)."""
     n = len(soa["rec_off"])
+    n_ops_all = soa["n_cigar_op"].astype(np.int64)
+    if n and int(n_ops_all.max()) > max_ops:
+        raise ValueError(
+            f"record has {int(n_ops_all.max())} CIGAR ops > max_ops={max_ops}; "
+            "truncating would understate reference spans"
+        )
     out = np.zeros((n, max_ops), dtype=np.uint32)
     cigar_off = soa["rec_off"].astype(np.int64) + 32 + soa["l_read_name"]
-    n_ops = np.minimum(soa["n_cigar_op"].astype(np.int64), max_ops)
+    n_ops = n_ops_all
     for k in range(max_ops):
         rows = n_ops > k
         if not rows.any():

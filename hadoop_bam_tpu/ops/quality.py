@@ -80,9 +80,9 @@ def histogram_u8(values: jax.Array, valid: jax.Array, nbins: int = 64) -> jax.Ar
     onehot = (
         (v[:, None] == jnp.arange(nbins, dtype=jnp.int32)[None, :])
         & m[:, None]
-    ).astype(jnp.float32)
-    counts = jnp.sum(onehot, axis=0)  # XLA maps this reduction onto the MXU
-    return counts.astype(jnp.int32)
+    ).astype(jnp.int32)
+    # int32 accumulation: float32 would silently drop counts past 2^24.
+    return jnp.sum(onehot, axis=0)
 
 
 @jax.jit
@@ -93,8 +93,8 @@ def base_counts(seq_codes: jax.Array, valid: jax.Array) -> jax.Array:
     m = valid.reshape(-1)
     onehot = (
         (v[:, None] == jnp.arange(16, dtype=jnp.int32)[None, :]) & m[:, None]
-    ).astype(jnp.float32)
-    return jnp.sum(onehot, axis=0).astype(jnp.int32)
+    ).astype(jnp.int32)
+    return jnp.sum(onehot, axis=0)
 
 
 @jax.jit

@@ -31,7 +31,6 @@ from .io.bam import (
     read_header,
 )
 from .io.merger import merge_bam_parts
-from .ops.keys import make_keys, pack_keys_np
 from .ops.sort import sort_keys
 from .parallel.mesh import make_mesh
 from .parallel.shuffle import DistributedSort
@@ -67,18 +66,6 @@ def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
         soa[k] = np.concatenate(cols)
     keys = np.concatenate([b.keys for b in batches])
     return RecordBatch(soa=soa, data=data, keys=keys)
-
-
-def _batch_keys_device(batch: RecordBatch) -> np.ndarray:
-    """Device path for key construction (host murmur column for unmapped)."""
-    soa = batch.soa
-    refid = jnp.asarray(soa["refid"].astype(np.int32))
-    pos = jnp.asarray(soa["pos"].astype(np.int32))
-    flag = jnp.asarray(soa["flag"].astype(np.int32))
-    # murmur hashes were already folded into batch.keys by the reader.
-    hash32 = jnp.asarray((batch.keys & 0xFFFFFFFF).astype(np.int32))
-    hi, lo = make_keys(refid, pos, flag, hash32)
-    return pack_keys_np(np.asarray(hi), np.asarray(lo))
 
 
 def sort_bam(

@@ -303,7 +303,10 @@ def build_record(
         )
     else:
         qual_b = qual if qual else b"\xff" * l_seq
-    bin_ = reg2bin(pos, pos + max(1, _ref_span(cigar))) if pos >= 0 else 4680
+    # htsjdk ignores the CIGAR for flag-unmapped reads: their alignment end
+    # equals their start, so the bin covers a single base.
+    span = 1 if (flag & FLAG_UNMAPPED) else max(1, _ref_span(cigar))
+    bin_ = reg2bin(pos, pos + span) if pos >= 0 else 4680
     body = (
         _FIXED.pack(
             refid,

@@ -1,0 +1,165 @@
+"""CRAM container structure: magic, ITF8/LTF8 varints, container headers.
+
+The structural layer the reference uses for split planning — its
+CRAMInputFormat collects container start offsets by iterating container
+headers (CRAMInputFormat.java:58-70 via htsjdk's CramContainerIterator) and
+snaps splits to them.  This module parses the CRAM 2.1/3.x framing: file
+definition, container header fields, and the EOF container detection.
+
+Record-level decode (core/external blocks, entropy codecs) is intentionally
+not implemented yet — containers are planned/counted here, and readers
+surface a clear capability error (SURVEY.md §7 stage 8 defers CRAM codec
+breadth; the container header's nRecords already supports counting).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+MAGIC = b"CRAM"
+FILE_DEFINITION_LEN = 26  # magic + 2 version bytes + 20-byte file id
+
+
+class CramError(IOError):
+    pass
+
+
+def read_itf8(buf: bytes, pos: int) -> Tuple[int, int]:
+    """CRAM ITF8 varint → (value, new_pos)."""
+    b0 = buf[pos]
+    if b0 < 0x80:
+        return b0, pos + 1
+    if b0 < 0xC0:
+        return ((b0 & 0x7F) << 8) | buf[pos + 1], pos + 2
+    if b0 < 0xE0:
+        return ((b0 & 0x3F) << 16) | (buf[pos + 1] << 8) | buf[pos + 2], pos + 3
+    if b0 < 0xF0:
+        return (
+            ((b0 & 0x1F) << 24)
+            | (buf[pos + 1] << 16)
+            | (buf[pos + 2] << 8)
+            | buf[pos + 3]
+        ), pos + 4
+    v = (
+        ((b0 & 0x0F) << 28)
+        | (buf[pos + 1] << 20)
+        | (buf[pos + 2] << 12)
+        | (buf[pos + 3] << 4)
+        | (buf[pos + 4] & 0x0F)
+    )
+    # sign: ITF8 carries int32 values
+    if v >= 1 << 31:
+        v -= 1 << 32
+    return v, pos + 5
+
+
+def read_ltf8(buf: bytes, pos: int) -> Tuple[int, int]:
+    """CRAM LTF8 varint (int64) → (value, new_pos)."""
+    b0 = buf[pos]
+    n_extra = 0
+    probe = 0x80
+    while n_extra < 8 and b0 & probe:
+        n_extra += 1
+        probe >>= 1
+    if n_extra == 0:
+        return b0, pos + 1
+    if n_extra < 8:
+        v = b0 & (0xFF >> (n_extra + 1))
+    else:
+        v = 0
+    for i in range(n_extra):
+        v = (v << 8) | buf[pos + 1 + i]
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, pos + 1 + n_extra
+
+
+@dataclass
+class ContainerHeader:
+    offset: int  # file offset of this container
+    length: int  # byte length of the container's block data
+    ref_seq_id: int
+    start_pos: int
+    align_span: int
+    n_records: int
+    record_counter: int
+    bases: int
+    n_blocks: int
+    landmarks: List[int]
+    header_size: int  # bytes consumed by this header itself
+
+    @property
+    def next_offset(self) -> int:
+        return self.offset + self.header_size + self.length
+
+    @property
+    def is_eof(self) -> bool:
+        """EOF container: ref -1, 0 records/blocks... htsjdk detects via
+        content; the spec's v3 EOF has ref_seq_id=-1 and n_records=0."""
+        return self.ref_seq_id == -1 and self.n_records == 0 and self.n_blocks <= 1
+
+
+def parse_file_definition(data: bytes) -> Tuple[int, int]:
+    """Returns (major, minor) version; validates the magic."""
+    if data[:4] != MAGIC:
+        raise CramError("missing CRAM magic")
+    return data[4], data[5]
+
+
+def parse_container_header(
+    data: bytes, pos: int, major: int
+) -> ContainerHeader:
+    start = pos
+    if pos + 4 > len(data):
+        raise CramError(f"truncated container header at {pos}")
+    (length,) = struct.unpack_from("<i", data, pos)
+    pos += 4
+    ref_seq_id, pos = read_itf8(data, pos)
+    start_pos, pos = read_itf8(data, pos)
+    align_span, pos = read_itf8(data, pos)
+    n_records, pos = read_itf8(data, pos)
+    record_counter, pos = read_ltf8(data, pos)
+    bases, pos = read_ltf8(data, pos)
+    n_blocks, pos = read_itf8(data, pos)
+    n_landmarks, pos = read_itf8(data, pos)
+    landmarks = []
+    for _ in range(n_landmarks):
+        lm, pos = read_itf8(data, pos)
+        landmarks.append(lm)
+    if major >= 3:
+        pos += 4  # crc32
+    return ContainerHeader(
+        offset=start,
+        length=length,
+        ref_seq_id=ref_seq_id,
+        start_pos=start_pos,
+        align_span=align_span,
+        n_records=n_records,
+        record_counter=record_counter,
+        bases=bases,
+        n_blocks=n_blocks,
+        landmarks=landmarks,
+        header_size=pos - start,
+    )
+
+
+def iter_containers(data: bytes) -> List[ContainerHeader]:
+    """All container headers incl. the EOF container (CramContainerIterator
+    equivalent)."""
+    major, _ = parse_file_definition(data)
+    out: List[ContainerHeader] = []
+    pos = FILE_DEFINITION_LEN
+    while pos < len(data):
+        hdr = parse_container_header(data, pos, major)
+        out.append(hdr)
+        pos = hdr.next_offset
+    if pos != len(data):
+        raise CramError("container chain misaligned")
+    return out
+
+
+def container_offsets(data: bytes) -> List[int]:
+    """Start offsets of data containers (first = the CRAM header container)."""
+    return [c.offset for c in iter_containers(data)]

@@ -1,0 +1,186 @@
+"""FASTQ/QSEQ tests, mirroring the reference's literal-string fixtures
+(TestFastqInputFormat.java / TestQseqInputFormat.java style)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.conf import Configuration
+from hadoop_bam_tpu.io.fastq import (
+    FastqInputFormat,
+    FastqOutputFormat,
+    scan_illumina_id,
+)
+from hadoop_bam_tpu.io.qseq import QseqInputFormat, QseqOutputFormat, parse_qseq_line
+from hadoop_bam_tpu.io.splits import ByteSplit
+from hadoop_bam_tpu.spec.fragment import (
+    FormatException,
+    SequencedFragment,
+    convert_quality,
+    verify_quality,
+)
+
+ONE_FASTQ = (
+    b"@ERR020229.10880 HWI-ST168_161:1:1:1373:2042/1\n"
+    b"TTGGATGATAGGGATTATTTGACTCGAATATTGGAAATAGCTGTTTATATTTTTTAAAAATGGTCTGTAACTGGTGACAGGACGCTTCGAT\n"
+    b"+\n"
+    b"###########################################################################################\n"
+)
+
+ILLUMINA_FASTQ = (
+    b"@EAS139:136:FC706VJ:2:2104:15343:197393 1:N:18:ATCACG\n"
+    b"TTGGATGAT\n"
+    b"+\n"
+    b"IIIIIIIII\n"
+)
+
+
+def batch_from(fmt, data: bytes, start=0, end=None):
+    end = len(data) if end is None else end
+    return fmt.read_split(ByteSplit("<mem>", start, end - start), data=data)
+
+
+class TestFastq:
+    def test_basic_record(self):
+        b = batch_from(FastqInputFormat(), ONE_FASTQ)
+        assert b.n_records == 1
+        assert b.names[0].startswith("ERR020229.10880")
+        assert b.fragments[0].read == 1  # /1 suffix
+        assert len(b.fragments[0].sequence) == 91
+
+    def test_illumina_id_parse(self):
+        b = batch_from(FastqInputFormat(), ILLUMINA_FASTQ)
+        f = b.fragments[0]
+        assert f.instrument == "EAS139"
+        assert f.run_number == 136
+        assert f.flowcell_id == "FC706VJ"
+        assert (f.lane, f.tile, f.xpos, f.ypos) == (2, 2104, 15343, 197393)
+        assert f.read == 1
+        assert f.filter_passed is True  # 'N' == not filtered
+        assert f.control_number == 18
+        assert f.index_sequence == "ATCACG"
+
+    def test_split_resync_mid_record(self):
+        data = ONE_FASTQ * 5
+        fmt = FastqInputFormat()
+        # Split starting inside record 2 must resync to record 3... — total
+        # across two splits is exactly once.
+        cut = len(ONE_FASTQ) + 30
+        b1 = batch_from(fmt, data, 0, cut)
+        b2 = batch_from(fmt, data, cut, len(data))
+        assert b1.n_records + b2.n_records == 5
+
+    @pytest.mark.parametrize("cut_frac", [0.1, 0.33, 0.5, 0.77])
+    def test_exactly_once_any_cut(self, cut_frac):
+        data = ONE_FASTQ * 20
+        cut = int(len(data) * cut_frac)
+        fmt = FastqInputFormat()
+        n = batch_from(fmt, data, 0, cut).n_records + batch_from(
+            fmt, data, cut, len(data)
+        ).n_records
+        assert n == 20
+
+    def test_quality_at_plus_tricky_resync(self):
+        # A quality line starting with '@' must not be mistaken for an ID
+        # (the backtracking case, FastqInputFormat.java:170-190).
+        rec = b"@id1\nACGT\n+\n@@@@\n@id2\nTTTT\n+\nHHHH\n"
+        fmt = FastqInputFormat()
+        cut = 6  # inside the sequence of record 1
+        b2 = batch_from(fmt, rec, cut, len(rec))
+        assert b2.names == ["id2"]
+
+    def test_illumina_encoding_conversion(self):
+        illumina = b"@r\nAC\n+\n" + bytes([64 + 30, 64 + 2]) + b"\n"
+        conf = Configuration({"hbam.fastq-input.base-quality-encoding": "illumina"})
+        b = batch_from(FastqInputFormat(conf), illumina)
+        assert b.fragments[0].quality == bytes([33 + 30, 33 + 2])
+
+    def test_sanger_out_of_range_raises(self):
+        bad = b"@r\nAC\n+\n" + bytes([5, 33]) + b"\n"
+        with pytest.raises(FormatException):
+            batch_from(FastqInputFormat(), bad)
+
+    def test_filter_failed_qc(self):
+        data = (
+            b"@m:1:f:1:1:10:10 1:Y:0:\nAA\n+\nII\n"
+            b"@m:1:f:1:1:10:11 1:N:0:\nCC\n+\nII\n"
+        )
+        conf = Configuration({"hbam.fastq-input.filter-failed-qc": "true"})
+        b = batch_from(FastqInputFormat(conf), data)
+        assert b.n_records == 1
+        assert b.fragments[0].sequence == b"CC"
+
+    def test_output_roundtrip_with_id_reconstruction(self):
+        b = batch_from(FastqInputFormat(), ILLUMINA_FASTQ)
+        out = io.BytesIO()
+        FastqOutputFormat().write(out, b)
+        b2 = batch_from(FastqInputFormat(), out.getvalue())
+        assert b2.fragments[0].sequence == b.fragments[0].sequence
+        assert b2.fragments[0].instrument == "EAS139"
+        assert out.getvalue().startswith(b"@EAS139:136:FC706VJ:2:2104:15343:197393 1:N:18:ATCACG\n")
+
+
+QSEQ_LINE = (
+    b"EAS139\t136\t2\t5\t1000\t12850\t0\t1\tATCACG.TTAC\t"
+    + bytes([64 + 30] * 11)
+    + b"\t1"
+)
+
+
+class TestQseq:
+    def test_parse_line(self):
+        key, frag = parse_qseq_line(QSEQ_LINE)
+        assert key == "EAS139:136:2:5:1000:12850:1"
+        assert frag.sequence == b"ATCACGNTTAC"  # '.' -> 'N'
+        assert frag.index_sequence is None  # '0' index is null
+        assert frag.filter_passed is True
+
+    def test_read_split_converts_illumina_default(self):
+        data = QSEQ_LINE + b"\n"
+        b = batch_from(QseqInputFormat(), data)
+        assert b.n_records == 1
+        assert b.fragments[0].quality == bytes([33 + 30] * 11)
+
+    def test_malformed_field_count(self):
+        with pytest.raises(FormatException):
+            parse_qseq_line(b"only\tthree\tfields")
+
+    def test_exactly_once_across_cut(self):
+        data = (QSEQ_LINE + b"\n") * 10
+        fmt = QseqInputFormat()
+        cut = len(QSEQ_LINE) + 10
+        n = batch_from(fmt, data, 0, cut).n_records + batch_from(
+            fmt, data, cut, len(data)
+        ).n_records
+        assert n == 10
+
+    def test_writer_roundtrip(self):
+        b = batch_from(QseqInputFormat(), QSEQ_LINE + b"\n")
+        out = io.BytesIO()
+        QseqOutputFormat().write(out, b)
+        key2, frag2 = parse_qseq_line(out.getvalue().rstrip(b"\n"))
+        assert frag2.sequence == b.fragments[0].sequence
+        # writer re-encodes to illumina and '.'-codes Ns
+        assert b"ATCACG." in out.getvalue()
+
+
+class TestQualityHelpers:
+    def test_convert_and_verify(self):
+        q = bytes([64, 90, 110])
+        s = convert_quality(q, "illumina", "sanger")
+        assert s == bytes([33, 59, 79])
+        assert verify_quality(s, "sanger") == -1
+        assert verify_quality(bytes([5]), "sanger") == 0
+        with pytest.raises(FormatException):
+            convert_quality(bytes([30]), "illumina", "sanger")
+        with pytest.raises(ValueError):
+            convert_quality(q, "illumina", "illumina")
+
+    def test_batch_tensors(self):
+        data = ONE_FASTQ + ILLUMINA_FASTQ
+        b = batch_from(FastqInputFormat(), data)
+        assert b.seq.shape[0] == 2
+        mask = b.valid_mask()
+        assert mask[0].sum() == 91 and mask[1].sum() == 9
+        assert b.seq.dtype == np.uint8

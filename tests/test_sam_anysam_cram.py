@@ -1,0 +1,125 @@
+"""SAM text path, AnySAM dispatch, CRAM container planning."""
+
+import io
+
+import pytest
+
+from hadoop_bam_tpu.conf import Configuration
+from hadoop_bam_tpu.io.anysam import AnySamInputFormat, infer_from_data
+from hadoop_bam_tpu.io.cram import CramDecodeUnsupported, CramInputFormat
+from hadoop_bam_tpu.io.sam import SamInputFormat, SamOutputWriter
+from hadoop_bam_tpu.io.splits import ByteSplit
+from hadoop_bam_tpu.spec import bam, sam
+
+R = "/root/reference/src/test/resources/"
+
+
+class TestSamCodec:
+    def test_fixture_roundtrip_exact_text(self, reference_resources):
+        raw = open(R + "test.sam", "rb").read()
+        hdr, recs = sam.read_sam(raw)
+        body = [l for l in raw.decode().split("\n") if l and not l.startswith("@")]
+        assert [sam.record_to_sam_line(r, hdr) for r in recs] == body
+
+    def test_binary_text_binary_identity(self, reference_resources):
+        hdr, recs = bam.read_bam(R + "test.bam")
+        buf = io.BytesIO()
+        sam.write_sam(buf, hdr, recs)
+        _, r2 = sam.read_sam(buf.getvalue())
+        assert all(a.raw == b.raw for a, b in zip(recs, r2))
+        assert len(r2) == len(recs)
+
+    def test_tag_codec_types(self):
+        hdr = bam.BamHeader("@SQ\tSN:c\tLN:100", [("c", 100)])
+        line = (
+            "q1\t0\tc\t10\t60\t4M\t*\t0\t0\tACGT\tIIII\t"
+            "NM:i:2\tXX:Z:hello\tXY:A:x\tXF:f:1.5\tXB:B:c,-1,2,3\tXH:H:1AFF"
+        )
+        rec = sam.sam_line_to_record(line, hdr)
+        assert sam.record_to_sam_line(rec, hdr) == line
+
+    def test_headerless_sam(self, reference_resources):
+        # test_headerless.sam parses with an empty reference dictionary only
+        # if records are unmapped/ref '*'; here we just ensure a clean error
+        # or parse for the fixture.
+        raw = open(R + "test_headerless.sam", "rb").read()
+        try:
+            hdr, recs = sam.read_sam(raw)
+            assert len(recs) > 0
+        except (KeyError, sam.SamError):
+            pass  # mapped records without @SQ cannot resolve ref indices
+
+
+class TestSamInputFormat:
+    def test_split_exactly_once(self, tmp_path, reference_resources):
+        hdr, recs = bam.read_bam(R + "test.bam")
+        p = tmp_path / "big.sam"
+        with open(p, "wb") as f:
+            sam.write_sam(f, hdr, recs[:800])
+        fmt = SamInputFormat()
+        splits = fmt.get_splits([str(p)], split_size=50_000)
+        assert len(splits) > 2
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        assert total == 800
+
+    def test_writer_batch(self, tmp_path, reference_resources):
+        hdr, recs = bam.read_bam(R + "test.bam")
+        p = tmp_path / "out.sam"
+        with open(p, "wb") as f:
+            w = SamOutputWriter(f, hdr)
+            for r in recs[:10]:
+                w.write_record(r)
+        hdr2, r2 = sam.read_sam(p.read_bytes())
+        assert [r.raw for r in r2] == [r.raw for r in recs[:10]]
+
+
+class TestAnySam:
+    def test_content_sniffing(self):
+        assert infer_from_data(0x1F) == "bam"
+        assert infer_from_data(ord("C")) == "cram"
+        assert infer_from_data(ord("@")) == "sam"
+        assert infer_from_data(ord("Z")) is None
+
+    def test_misnamed_bam_detected_by_content(self, reference_resources):
+        # misnamedBam.sam is BAM bytes named .sam
+        # (TestAnySAMInputFormat.java:18+): content sniffing must win when
+        # extensions aren't trusted.
+        conf = Configuration({"hadoopbam.anysam.trust-exts": "false"})
+        fmt = AnySamInputFormat(conf)
+        assert fmt.get_format(R + "misnamedBam.sam") == "bam"
+        # With trusted extensions it is treated as SAM (reference behavior).
+        fmt2 = AnySamInputFormat()
+        assert fmt2.get_format(R + "misnamedBam.sam") == "sam"
+
+    def test_dispatch_reads_bam_and_sam(self, tmp_path, reference_resources):
+        hdr, recs = bam.read_bam(R + "test.bam")
+        samp = tmp_path / "t.sam"
+        with open(samp, "wb") as f:
+            sam.write_sam(f, hdr, recs[:50])
+        fmt = AnySamInputFormat()
+        splits = fmt.get_splits([R + "test.bam", str(samp)], split_size=1 << 22)
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        assert total == 2277 + 50
+
+
+class TestCram:
+    def test_container_aligned_splits(self, reference_resources):
+        fmt = CramInputFormat()
+        splits = fmt.get_splits([R + "test.cram"], split_size=1000)
+        # All data containers covered exactly once.
+        assert sum(fmt.count_records(s) for s in splits) == 2
+        inv = fmt.container_inventory(R + "test.cram")
+        assert inv[-1].is_eof
+        assert sum(c.n_records for c in inv) == 2
+
+    def test_read_split_reports_capability_gap(self, reference_resources):
+        fmt = CramInputFormat()
+        splits = fmt.get_splits([R + "test.cram"], split_size=1 << 20)
+        with pytest.raises(CramDecodeUnsupported):
+            fmt.read_split(splits[0])
+
+    def test_reference_source_conf(self):
+        conf = Configuration(
+            {"hadoopbam.cram.reference-source-path": "/ref/x.fa"}
+        )
+        assert CramInputFormat(conf).reference_source_path() == "/ref/x.fa"

@@ -1,0 +1,222 @@
+"""VCF + FASTA path tests against the reference fixtures."""
+
+import io
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.conf import Configuration
+from hadoop_bam_tpu.io.fasta import FastaInputFormat
+from hadoop_bam_tpu.io.splits import ByteSplit
+from hadoop_bam_tpu.io.vcf import (
+    VcfInputFormat,
+    VcfRecordWriter,
+    merge_vcf_parts,
+    read_vcf_header,
+    sniff_vcf_format,
+)
+from hadoop_bam_tpu.spec import bgzf
+from hadoop_bam_tpu.spec.vcf import (
+    FormatException,
+    VcfHeader,
+    parse_variant_line,
+    variant_key,
+)
+from hadoop_bam_tpu.utils import nio
+from hadoop_bam_tpu.utils.murmur3 import murmurhash3_chars
+
+R = "/root/reference/src/test/resources/"
+
+
+class TestVariantParsing:
+    def test_basic_line(self):
+        v = parse_variant_line(
+            "chr1\t109\trs1\tA\tT,C\t30.5\tPASS\tDP=10;END=120\tGT\t0|1"
+        )
+        assert (v.chrom, v.pos, v.id, v.ref) == ("chr1", 109, "rs1", "A")
+        assert v.alts == ["T", "C"]
+        assert v.qual == 30.5
+        assert v.end == 120  # END= wins
+        assert v.genotypes_raw == "GT\t0|1"
+
+    def test_end_from_ref_length(self):
+        v = parse_variant_line("1\t100\t.\tACGT\tA\t.\t.\t.")
+        assert v.end == 103
+        assert v.qual is None and v.filters == []
+
+    def test_malformed_raises(self):
+        with pytest.raises(FormatException):
+            parse_variant_line("chr1\tnotanumber\t.\tA\tT\t.\t.\t.")
+        with pytest.raises(FormatException):
+            parse_variant_line("chr1\t5\t.\tA")
+
+    def test_key_semantics(self):
+        hdr = VcfHeader.parse(
+            "##fileformat=VCFv4.2\n##contig=<ID=chr1>\n##contig=<ID=chr2>\n#CHROM\tPOS"
+        )
+        v = parse_variant_line("chr2\t100\t.\tA\tT\t.\t.\t.")
+        assert variant_key(hdr, v) == (1 << 32) | 99
+        # Unknown contig → (int)murmur3_chars, sign-extended (java cast).
+        v2 = parse_variant_line("chrUn\t1\t.\tA\tT\t.\t.\t.")
+        h = murmurhash3_chars("chrUn", 0) & 0xFFFFFFFF
+        h32 = h - (1 << 32) if h >= 1 << 31 else h
+        # start-1 == 0, so the key is just the (possibly negative) index
+        # shifted into the high word.
+        assert variant_key(hdr, v2) == h32 << 32
+
+
+class TestVcfInput:
+    @pytest.mark.parametrize(
+        "name,expect_multi",
+        [
+            ("HiSeq.10000.vcf", True),
+            ("HiSeq.10000.vcf.bgz", True),
+            ("HiSeq.10000.vcf.gz", False),
+            ("HiSeq.10000.vcf.bgzf.gz", True),
+        ],
+    )
+    def test_split_matrix_exactly_once(
+        self, reference_resources, name, expect_multi
+    ):
+        # The reference's parameterized format-matrix test
+        # (TestVCFInputFormat.java:56-88): each codec × split-cardinality,
+        # counts vs ground truth.
+        fmt = VcfInputFormat()
+        splits = fmt.get_splits([R + name], split_size=100_000)
+        if expect_multi:
+            assert len(splits) > 1
+        else:
+            assert len(splits) == 1
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        assert total == 9965
+
+    def test_sniffing(self, reference_resources):
+        assert sniff_vcf_format(R + "test.vcf", False) == "vcf"
+        assert sniff_vcf_format(R + "test.bgzf.bcf", False) == "bcf"
+        assert sniff_vcf_format(R + "misnamedBam.sam", False) is None
+
+    def test_stringency_policies(self, reference_resources):
+        # invalid_info_field.vcf has 'yes' in the DP (Integer) field — our
+        # lexical parser accepts it, so drive the policy with a líne that is
+        # structurally bad instead.
+        bad = (
+            "##fileformat=VCFv4.2\n##contig=<ID=c>\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+            "c\t1\t.\tA\tT\t.\t.\t.\n"
+            "c\tBAD\t.\tA\tT\t.\t.\t.\n"
+            "c\t5\t.\tA\tT\t.\t.\t.\n"
+        ).encode()
+        strict = VcfInputFormat(
+            Configuration(
+                {"hadoopbam.vcfrecordreader.validation-stringency": "STRICT"}
+            )
+        )
+        with pytest.raises(FormatException):
+            strict.read_split(ByteSplit("<m>", 0, len(bad)), data=bad)
+        lenient = VcfInputFormat(
+            Configuration(
+                {"hadoopbam.vcfrecordreader.validation-stringency": "LENIENT"}
+            )
+        )
+        b = lenient.read_split(ByteSplit("<m>", 0, len(bad)), data=bad)
+        assert b.n_records == 2  # bad line skipped
+
+    def test_interval_filtering_records_and_splits(self, reference_resources):
+        conf = Configuration({"hadoopbam.vcf.intervals": "chr1:100-2000"})
+        fmt = VcfInputFormat(conf)
+        splits = fmt.get_splits([R + "HiSeq.10000.vcf.bgz"], split_size=100_000)
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        plain = VcfInputFormat()
+        all_b = plain.read_split(
+            plain.get_splits([R + "HiSeq.10000.vcf"], split_size=1 << 30)[0]
+        )
+        expect = sum(
+            1
+            for v in all_b.variants
+            if v.chrom == "chr1" and v.start <= 2000 and v.end >= 100
+        )
+        assert total == expect > 0
+
+    def test_header_reader_all_codecs(self, reference_resources):
+        for name in ["test.vcf", "test.vcf.gz", "test.vcf.bgz"]:
+            hdr = read_vcf_header(R + name)
+            assert hdr.samples == ["NA00001", "NA00002", "NA00003"]
+
+
+class TestVcfWriterAndMerger:
+    def _variants(self):
+        fmt = VcfInputFormat()
+        b = fmt.read_split(
+            fmt.get_splits([R + "test.vcf"], split_size=1 << 30)[0]
+        )
+        return b
+
+    def test_roundtrip_plain(self, reference_resources, tmp_path):
+        b = self._variants()
+        out = io.BytesIO()
+        w = VcfRecordWriter(out, b.header, write_header=True)
+        for v in b.variants:
+            w.write(v)
+        w.close()
+        fmt = VcfInputFormat()
+        b2 = fmt.read_split(
+            ByteSplit("<m>", 0, len(out.getvalue())), data=out.getvalue()
+        )
+        assert [v.format_line() for v in b2.variants] == [
+            v.format_line() for v in b.variants
+        ]
+
+    def test_headerless_parts_merge_bgzf(self, reference_resources, tmp_path):
+        b = self._variants()
+        part_dir = tmp_path / "out"
+        part_dir.mkdir()
+        halves = [b.variants[:3], b.variants[3:]]
+        for i, chunk in enumerate(halves):
+            with open(part_dir / f"part-r-{i:05d}", "wb") as f:
+                w = VcfRecordWriter(
+                    f, b.header, write_header=False, compress_bgzf=True
+                )
+                for v in chunk:
+                    w.write(v)
+                w.close()
+        nio.write_success(part_dir)
+        out = tmp_path / "merged.vcf.bgz"
+        merge_vcf_parts(str(part_dir), str(out), b.header)
+        data = out.read_bytes()
+        assert data.endswith(bgzf.TERMINATOR)
+        fmt = VcfInputFormat()
+        b2 = fmt.read_split(ByteSplit(str(out), 0, len(data)), data=data)
+        assert b2.n_records == b.n_records
+
+    def test_merge_rejects_bcf(self, tmp_path):
+        part_dir = tmp_path / "out"
+        part_dir.mkdir()
+        (part_dir / "part-r-00000").write_bytes(b"BCF\x02\x02xxxx")
+        nio.write_success(part_dir)
+        hdr = VcfHeader.parse("##fileformat=VCFv4.2\n#CHROM\tPOS")
+        with pytest.raises(ValueError, match="BCF"):
+            merge_vcf_parts(str(part_dir), str(tmp_path / "m"), hdr)
+
+
+class TestFasta:
+    def test_one_split_per_contig(self, reference_resources):
+        fmt = FastaInputFormat()
+        splits = fmt.get_splits([R + "mini-chr1-chr2.fasta"])
+        assert len(splits) == 2
+        b1 = fmt.read_split(splits[0])
+        b2 = fmt.read_split(splits[1])
+        assert b1.contig != b2.contig
+        assert len(b1.bases) > 0 and len(b2.bases) > 0
+        # positions are 1-based and line-cumulative
+        frags = b1.fragments()
+        assert frags[0].position == 1
+        if len(frags) > 1:
+            assert frags[1].position == 1 + len(frags[0].sequence)
+
+    def test_auxf_reference(self, reference_resources):
+        fmt = FastaInputFormat()
+        splits = fmt.get_splits([R + "auxf.fa"])
+        batch = fmt.read_split(splits[0])
+        # .fai gives the ground truth length for the first contig.
+        fai_line = open(R + "auxf.fa.fai").readline().split("\t")
+        assert batch.contig == fai_line[0]
+        assert len(batch.bases) == int(fai_line[1])
