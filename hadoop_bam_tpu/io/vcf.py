@@ -107,15 +107,23 @@ class VcfInputFormat:
 
     # -- planning -----------------------------------------------------------
 
-    def get_splits(self, paths, split_size: int = 4 << 20) -> List[ByteSplit]:
+    def get_splits(self, paths, split_size: int = 4 << 20):
+        """Partition by sniffed format and delegate BCF files to the BCF
+        planner (VCFInputFormat.java:271-297); returns a mixed list of
+        ByteSplit (VCF) and FileVirtualSplit (BCF)."""
         trust = self.conf.get_boolean(VCF_TRUST_EXTS, True)
+        bcf_paths = [p for p in paths if sniff_vcf_format(p, trust) == "bcf"]
+        if bcf_paths:
+            from .bcf import BcfInputFormat
+
+            sub = BcfInputFormat(self.conf)
+            rest = [p for p in paths if p not in bcf_paths]
+            mixed = list(sub.get_splits(bcf_paths, split_size))
+            if rest:
+                mixed += self.get_splits(rest, split_size)
+            return mixed
         out: List[ByteSplit] = []
         for path in sorted(paths):
-            fmt = sniff_vcf_format(path, trust)
-            if fmt == "bcf":
-                raise NotImplementedError(
-                    "BCF split planning lives in BcfInputFormat"
-                )
             size = os.path.getsize(path)
             with open(path, "rb") as f:
                 head = f.read(18)
@@ -171,9 +179,16 @@ class VcfInputFormat:
     # -- reading ------------------------------------------------------------
 
     def read_split(
-        self, split: ByteSplit, data: Optional[bytes] = None
+        self, split, data: Optional[bytes] = None
     ) -> VariantBatch:
-        """Decode every variant whose line starts inside the split."""
+        """Decode every variant whose line starts inside the split.  BCF
+        splits (FileVirtualSplit) route to the BCF reader."""
+        from .splits import FileVirtualSplit
+
+        if isinstance(split, FileVirtualSplit):
+            from .bcf import BcfInputFormat
+
+            return BcfInputFormat(self.conf).read_split(split, data)
         header_text, payload, lo, hi = self._split_payload(split, data)
         header = VcfHeader.parse(header_text)
         stringency = self._stringency()
@@ -330,10 +345,20 @@ def merge_vcf_parts(
 
 
 def read_vcf_header(path: str) -> VcfHeader:
-    """Header from VCF / gz-VCF / BGZF-VCF (try-then-fallback,
-    util/VCFHeaderReader.java:51-78; BCF handled by the BCF module)."""
+    """Header from VCF / gz-VCF / BGZF-VCF / BCF without knowing which
+    (try-VCF-then-BCF, util/VCFHeaderReader.java:51-78)."""
     with open(path, "rb") as f:
         raw = f.read(1 << 22)
+    probe = raw
+    if bgzf.is_bgzf(raw):
+        try:
+            probe = bgzf.inflate_block(raw, 0)[0]
+        except bgzf.BgzfError:
+            probe = raw
+    if probe[:3] == b"BCF":
+        from .bcf import read_bcf_header
+
+        return read_bcf_header(raw)[0].vcf
     if raw[:2] == b"\x1f\x8b":
         if bgzf.is_bgzf(raw):
             chunk = bytearray()
