@@ -81,12 +81,17 @@ class AnySamInputFormat:
             elif fmt == "sam":
                 out.extend(self._sam.get_splits(group, split_size))
             else:
-                from .cram import CramInputFormat
-
-                out.extend(
-                    CramInputFormat(self.conf).get_splits(group, split_size)
-                )
+                out.extend(self._cram().get_splits(group, split_size))
         return out
+
+    def _cram(self):
+        """One cached CRAM reader — its ReferenceSource parses the FASTA
+        once, not per split."""
+        if getattr(self, "_cram_fmt", None) is None:
+            from .cram import CramInputFormat
+
+            self._cram_fmt = CramInputFormat(self.conf)
+        return self._cram_fmt
 
     def read_split(self, split: AnySplit) -> RecordBatch:
         if isinstance(split, FileVirtualSplit):
@@ -94,6 +99,4 @@ class AnySamInputFormat:
         fmt = self.get_format(split.path)
         if fmt == "sam":
             return self._sam.read_split(split)
-        from .cram import CramInputFormat
-
-        return CramInputFormat(self.conf).read_split(split)
+        return self._cram().read_split(split)

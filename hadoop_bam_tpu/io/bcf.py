@@ -272,7 +272,7 @@ class BcfInputFormat:
         while p + 8 <= end:
             try:
                 v, p = bcf.decode_record(payload, p, hdr)
-            except (bcf.BcfError, struct.error):
+            except (bcf.BcfError, struct.error, IndexError, ValueError, KeyError):
                 if stringency == "STRICT":
                     raise
                 break

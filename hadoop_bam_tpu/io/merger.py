@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from ..spec import bam, bgzf, indices
+from ..spec import bam, bgzf, cram, indices
 from ..utils import nio
 
 
@@ -69,3 +69,22 @@ def merge_bam_parts(
                     total_length=total,
                     out=f,
                 )
+
+
+def merge_cram_parts(
+    part_dir: str,
+    out_path: str,
+    header: bam.BamHeader,
+    check_success: bool = True,
+) -> None:
+    """Headerless CRAM parts → one valid CRAM: file definition + header
+    container, part containers untouched, EOF marker appended
+    (util/SAMFileMerger.java:77-78,96-102 CRAM arm)."""
+    if check_success:
+        nio.check_success(part_dir)
+    parts = nio.list_parts(part_dir)
+    with open(out_path, "wb") as out:
+        out.write(cram.MAGIC + bytes([3, 0]) + b"\x00" * 20)
+        out.write(cram.encode_file_header_container(header.text, 3))
+        nio.concat_files(parts, out)
+        out.write(cram.EOF_V3)

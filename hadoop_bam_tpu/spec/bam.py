@@ -145,6 +145,23 @@ class BamHeader:
         return BamHeader(text, refs), p
 
 
+def header_from_text(text: str) -> "BamHeader":
+    """Header from SAM text alone: the reference dictionary is rebuilt from
+    the ``@SQ`` lines (SAM/CRAM header readers share this)."""
+    refs: List[Tuple[str, int]] = []
+    for line in text.split("\n"):
+        if line.startswith("@SQ"):
+            name: Optional[str] = None
+            ln = 0
+            for f in line.split("\t")[1:]:
+                if f.startswith("SN:"):
+                    name = f[3:]
+                elif f.startswith("LN:"):
+                    ln = int(f[3:])
+            refs.append((name or "?", ln))
+    return BamHeader(text, refs)
+
+
 @dataclass
 class BamRecord:
     """One alignment; fixed fields decoded, variable tails as raw bytes.

@@ -6,7 +6,7 @@ import pytest
 
 from hadoop_bam_tpu.conf import Configuration
 from hadoop_bam_tpu.io.anysam import AnySamInputFormat, infer_from_data
-from hadoop_bam_tpu.io.cram import CramDecodeUnsupported, CramInputFormat
+from hadoop_bam_tpu.io.cram import CramInputFormat
 from hadoop_bam_tpu.io.sam import SamInputFormat, SamOutputWriter
 from hadoop_bam_tpu.io.splits import ByteSplit
 from hadoop_bam_tpu.spec import bam, sam
@@ -112,11 +112,19 @@ class TestCram:
         assert inv[-1].is_eof
         assert sum(c.n_records for c in inv) == 2
 
-    def test_read_split_reports_capability_gap(self, reference_resources):
-        fmt = CramInputFormat()
+    def test_read_split_decodes_htsjdk_cram(self, reference_resources):
+        """Full record decode of the htsjdk-written CRAM 2.1 fixture against
+        its FASTA reference (CRAMRecordReader.java:43-88 capability)."""
+        conf = Configuration(
+            {"hadoopbam.cram.reference-source-path": R + "auxf.fa"}
+        )
+        fmt = CramInputFormat(conf)
         splits = fmt.get_splits([R + "test.cram"], split_size=1 << 20)
-        with pytest.raises(CramDecodeUnsupported):
-            fmt.read_split(splits[0])
+        batch = fmt.read_split(splits[0])
+        assert batch.n_records == 2
+        r0, r1 = batch.record(0), batch.record(1)
+        assert (r0.read_name, r0.pos + 1, r0.cigar_string()) == ("Fred", 1, "10M")
+        assert (r1.read_name, r1.pos + 1, r1.seq) == ("Jim", 11, "AAAAAAAAAA")
 
     def test_reference_source_conf(self):
         conf = Configuration(
