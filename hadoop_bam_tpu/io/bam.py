@@ -28,6 +28,7 @@ import numpy as np
 from .. import native
 from ..conf import (
     BAM_BOUNDED_TRAVERSAL,
+    BAM_ENABLE_BAI_SPLITTER,
     BAM_INTERVALS,
     BAM_TRAVERSE_UNPLACED_UNMAPPED,
     BAM_WRITE_SPLITTING_BAI,
@@ -125,7 +126,91 @@ class BamInputFormat:
                 return self._indexed_splits(path, byte_splits, idx)
             except IOError:
                 pass  # bad index → regenerate probabilistically (:305-308)
+        if self.conf.get_boolean(BAM_ENABLE_BAI_SPLITTER):
+            bai_path = _find_bai(path)
+            if bai_path is not None:
+                try:
+                    bai = indices.Bai.load(bai_path)
+                    return self._bai_splits(path, byte_splits, bai)
+                except IOError:
+                    pass  # unreadable .bai → fall through to the guesser
         return self._probabilistic_splits(path, byte_splits)
+
+    def _bai_splits(
+        self,
+        path: str,
+        byte_splits: List[Tuple[int, int]],
+        bai: indices.Bai,
+    ) -> List[FileVirtualSplit]:
+        """Tier-2 planning from the linear `.bai` index
+        (BAMInputFormat.addBAISplits, BAMInputFormat.java:322-465).
+
+        The linear index stores, per 16kb genome window, the smallest virtual
+        offset of any record overlapping it; every such offset is a known
+        record boundary.  Splits snap to the first boundary at/after their
+        byte start; a split with no boundary inside it falls back to the
+        heuristic guesser (the reference's :432-445 behaviour).  Start
+        offsets computed this way are contiguous — each split's end is the
+        next split's start, and the last extends past EOF — so every record
+        is read exactly once, including the unmapped tail.
+        """
+        voffs: List[int] = []
+        for rid in range(len(bai.refs)):
+            voffs.extend(v for v in bai.linear_index(rid) if v > 0)
+        first = bai.first_offset()
+        if first is not None:
+            voffs.append(first)
+        if not voffs:
+            raise IOError("empty .bai: no linear index entries")
+        varr = np.unique(np.asarray(voffs, dtype=np.int64))
+        coffs = varr >> 16  # compressed file offsets of the boundaries
+        size = byte_splits[-1][1]
+        if int(coffs[-1]) >= size:
+            # Stale/mismatched index: a boundary points past EOF (the
+            # splitting-bai tier's bam_size() guard equivalent).
+            raise IOError(".bai does not match file: offset past EOF")
+        end_sentinel = (size << 16) | 0xFFFF
+
+        guesser: Optional[BamSplitGuesser] = None
+        file_data: Optional[bytes] = None
+        starts: List[int] = []
+        for j, (start, end) in enumerate(byte_splits):
+            if j == 0:
+                # First split starts at the first record, header skipped
+                # (the reference's getFilePointerSpanningReads, :115-123).
+                _, vfirst = read_header_voffset(path)
+                starts.append(vfirst)
+                continue
+            k = int(np.searchsorted(coffs, start, side="left"))
+            if k < len(varr) and coffs[k] < end:
+                starts.append(int(varr[k]))
+                continue
+            # No indexed boundary in this split: guess (:432-445).  The
+            # guesser needs raw bytes — load the file once, lazily.
+            if guesser is None:
+                if file_data is None:
+                    with open(path, "rb") as f:
+                        file_data = f.read()
+                hdr, _ = _read_header(file_data)
+                guesser = BamSplitGuesser(file_data, hdr.n_refs)
+            g = guesser.guess_next_record_start(start, end)
+            if g != end:
+                starts.append(g)
+            else:
+                # Miss: take the next indexed boundary at/after ``end`` so
+                # ``starts`` stays monotone (a raw (end<<16)|0xffff sentinel
+                # could exceed the next split's snapped start and make
+                # adjacent splits overlap → records read twice).
+                starts.append(int(varr[k]) if k < len(varr) else end_sentinel)
+
+        out: List[FileVirtualSplit] = []
+        for j, vstart in enumerate(starts):
+            vend = starts[j + 1] if j + 1 < len(starts) else end_sentinel
+            if vstart < vend:
+                out.append(FileVirtualSplit(path, vstart, vend))
+        if not out:
+            raise IOError(f"'{path}': no reads found via .bai splitter")
+        return out
 
     def _indexed_splits(
         self,
@@ -299,11 +384,11 @@ def _read_header(data: bytes) -> Tuple[bam.BamHeader, int]:
     return hdr, r.tell_voffset()
 
 
-def read_header(path_or_bytes) -> bam.BamHeader:
-    """Read just the header, pulling file bytes incrementally (a 100GB BAM
-    must not be slurped to learn its reference dictionary)."""
+def read_header_voffset(path_or_bytes) -> Tuple[bam.BamHeader, int]:
+    """Header + first-record virtual offset, pulling file bytes incrementally
+    (a 100GB BAM must not be slurped to learn its reference dictionary)."""
     if not isinstance(path_or_bytes, str):
-        return _read_header(path_or_bytes)[0]
+        return _read_header(path_or_bytes)
     size = os.path.getsize(path_or_bytes)
     chunk = 1 << 20
     with open(path_or_bytes, "rb") as f:
@@ -311,11 +396,15 @@ def read_header(path_or_bytes) -> bam.BamHeader:
             f.seek(0)
             data = f.read(chunk)
             try:
-                return _read_header(data)[0]
+                return _read_header(data)
             except (bgzf.BgzfError, bam.BamError):
                 if chunk >= size:
                     raise
                 chunk *= 8
+
+
+def read_header(path_or_bytes) -> bam.BamHeader:
+    return read_header_voffset(path_or_bytes)[0]
 
 
 def read_virtual_range(

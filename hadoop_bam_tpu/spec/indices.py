@@ -285,6 +285,21 @@ class Bai:
                         best = c.beg
         return best
 
+    def save(self, stream: BinaryIO) -> None:
+        stream.write(BAI_MAGIC)
+        stream.write(struct.pack("<i", len(self.refs)))
+        for ref in self.refs:
+            stream.write(struct.pack("<i", len(ref.bins)))
+            for bin_ in sorted(ref.bins):
+                chunks = ref.bins[bin_]
+                stream.write(struct.pack("<Ii", bin_, len(chunks)))
+                for c in chunks:
+                    stream.write(struct.pack("<QQ", c.beg, c.end))
+            stream.write(struct.pack("<i", len(ref.linear)))
+            for v in ref.linear:
+                stream.write(struct.pack("<Q", v))
+        stream.write(struct.pack("<Q", self.n_no_coor or 0))
+
     def unmapped_span_start(self) -> Optional[int]:
         """Upper bound voffset of all mapped chunks — where the unmapped tail
         begins (BAMInputFormat.java:576-584 semantics)."""
@@ -337,20 +352,7 @@ class BaiBuilder:
         return Bai(self.refs, self.n_no_coor)
 
     def save(self, stream: BinaryIO) -> None:
-        bai = self.build()
-        stream.write(BAI_MAGIC)
-        stream.write(struct.pack("<i", len(bai.refs)))
-        for ref in bai.refs:
-            stream.write(struct.pack("<i", len(ref.bins)))
-            for bin_ in sorted(ref.bins):
-                chunks = ref.bins[bin_]
-                stream.write(struct.pack("<Ii", bin_, len(chunks)))
-                for c in chunks:
-                    stream.write(struct.pack("<QQ", c.beg, c.end))
-            stream.write(struct.pack("<i", len(ref.linear)))
-            for v in ref.linear:
-                stream.write(struct.pack("<Q", v))
-        stream.write(struct.pack("<Q", self.n_no_coor))
+        self.build().save(stream)
 
 
 def build_bai(bam_path_or_bytes: Union[str, bytes]) -> "Bai":

@@ -160,6 +160,96 @@ class TestIndexedSplits:
         assert got == [r.raw for r in recs]
 
 
+class TestBaiSplitter:
+    """Tier-2 planning via the linear `.bai` index
+    (BAMInputFormat.addBAISplits, BAMInputFormat.java:322-465)."""
+
+    def _sorted_bam(self, tmp_path, n=3000):
+        # Random seq/qual so the file doesn't compress below the split
+        # size — the multi-split path must actually exercise.
+        rng = np.random.default_rng(7)
+        hdr = bam.BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr21\tLN:46709983\n"
+            "@SQ\tSN:chr22\tLN:50818468",
+            [("chr21", 46709983), ("chr22", 50818468)],
+        )
+        recs = []
+        for i in range(n):
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, 76))
+            recs.append(
+                bam.build_record(
+                    f"pair{i:06d}", i % 2, 1000 * i % 46000000, 60,
+                    bam.FLAG_PAIRED, [(76, "M")], seq,
+                    bytes(rng.integers(2, 40, 76).astype(np.uint8)),
+                )
+            )
+        for i in range(4):
+            recs.append(
+                bam.build_record(
+                    f"unm{i}", -1, -1, 0, bam.FLAG_UNMAPPED, [], "ACGTACGT",
+                    bytes([20] * 8),
+                )
+            )
+        key = lambda r: (
+            (0x7FFFFFFF, 0) if r.refid < 0 else (r.refid, r.pos)
+        )
+        recs = sorted(recs, key=key)
+        buf = io.BytesIO()
+        bam.write_bam(buf, hdr, iter(recs))
+        blob = buf.getvalue()
+        p = tmp_path / "sorted.bam"
+        p.write_bytes(blob)
+        bai = indices.build_bai(blob)
+        with open(str(p) + ".bai", "wb") as f:
+            bai.save(f)
+        return str(p), recs
+
+    @pytest.mark.parametrize("split_size", [40_000, 100_000, 10_000_000])
+    def test_bai_splits_partition_exactly_once(self, tmp_path, split_size):
+        path, recs = self._sorted_bam(tmp_path)
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.enable-bai-splitter", True)
+        fmt = BamInputFormat(conf)
+        got = all_records_via_splits(fmt, path, split_size)
+        assert got == [r.raw for r in recs]
+
+    def test_bai_splits_match_probabilistic(self, tmp_path):
+        path, recs = self._sorted_bam(tmp_path)
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.enable-bai-splitter", True)
+        via_bai = all_records_via_splits(BamInputFormat(conf), path, 80_000)
+        via_guess = all_records_via_splits(BamInputFormat(), path, 80_000)
+        assert via_bai == via_guess
+
+    def test_stale_bai_falls_back_to_guesser(self, tmp_path):
+        # A .bai whose offsets point past EOF (file was rewritten shorter)
+        # must be rejected at planning time, not blow up at read time.
+        path, recs = self._sorted_bam(tmp_path)
+        bai = indices.Bai.load(str(path) + ".bai")
+        for ref in bai.refs:
+            ref.linear = [v + (10**9 << 16) for v in ref.linear if v]
+            ref.bins = {
+                b: [indices.Chunk(c.beg + (10**9 << 16), c.end + (10**9 << 16))
+                    for c in cs]
+                for b, cs in ref.bins.items()
+            }
+        with open(str(path) + ".bai", "wb") as f:
+            bai.save(f)
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.enable-bai-splitter", True)
+        got = all_records_via_splits(BamInputFormat(conf), path, 80_000)
+        assert got == [r.raw for r in recs]
+
+    def test_missing_bai_falls_back_to_guesser(self, tmp_path):
+        blob, hdr, recs = synth_bam_bytes(400)
+        p = tmp_path / "nobai.bam"
+        p.write_bytes(blob)
+        conf = Configuration()
+        conf.set_boolean("hadoopbam.bam.enable-bai-splitter", True)
+        got = all_records_via_splits(BamInputFormat(conf), str(p), 100_000)
+        assert got == [r.raw for r in recs]
+
+
 class TestLargeHeader:
     def test_records_survive_header_spanning_splits(self, tmp_path):
         # The "no reads in first split" regression
