@@ -1,0 +1,228 @@
+"""Operator CLI: the reference's per-class ``main()`` entry points, unified.
+
+The reference exposes L8 utilities as ``java -cp … <Class> args``:
+``SplittingBAMIndexer.main`` (SplittingBAMIndexer.java:72),
+``SplittingBAMIndex.main`` (SplittingBAMIndex.java:116),
+``BGZFBlockIndexer.main`` (util/BGZFBlockIndexer.java:42),
+``BAMSplitGuesser.main`` (BAMSplitGuesser.java:341),
+``BCFSplitGuesser.main`` (BCFSplitGuesser.java:368) and
+``GetSortedBAMHeader.main`` (util/GetSortedBAMHeader.java:36).  Here they are
+subcommands of ``python -m hadoop_bam_tpu``, plus ``sort`` (the end-to-end
+TestBAM-style coordinate sort the reference only ships as an example job) and
+``bai-index`` (the reference delegates `.bai` construction to htsjdk).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _cmd_splitting_index(args) -> int:
+    from .spec import indices
+
+    for path in args.bam:
+        idx = indices.build_splitting_bai(path, granularity=args.granularity)
+        out = path + indices.SPLITTING_BAI_EXT
+        with open(out, "wb") as f:
+            idx.save(f)
+        print(f"{out}: {idx.size()} offsets (granularity {args.granularity})")
+    return 0
+
+
+def _cmd_splitting_index_dump(args) -> int:
+    from .spec import indices
+
+    idx = indices.SplittingBai.load(args.index)
+    print(f"{args.index}: {idx.size()} offsets, bam size {idx.bam_size()}")
+    for v in idx.voffsets:
+        print(f"{v >> 16}:{v & 0xFFFF}")
+    return 0
+
+
+def _cmd_bgzf_index(args) -> int:
+    from .spec.indices import BGZFI_EXT, BgzfBlockIndex
+
+    for path in args.file:
+        with open(path, "rb") as f:
+            data = f.read()
+        idx = BgzfBlockIndex.build(data, granularity=args.granularity)
+        out = path + BGZFI_EXT
+        with open(out, "wb") as f:
+            idx.save(f)
+        print(f"{out}: {idx.size()} offsets (granularity {args.granularity})")
+    return 0
+
+
+def _cmd_bai_index(args) -> int:
+    from .spec import indices
+
+    for path in args.bam:
+        bai = indices.build_bai(path)
+        out = path + ".bai"
+        with open(out, "wb") as f:
+            bai.save(f)
+        print(f"{out}: {len(bai.refs)} references")
+    return 0
+
+
+def _cmd_bam_guess(args) -> int:
+    from .io.bam import read_header
+    from .io.guesser import BamSplitGuesser
+
+    with open(args.bam, "rb") as f:
+        data = f.read()
+    hdr = read_header(data)
+    end = args.end if args.end is not None else len(data)
+    g = BamSplitGuesser(data, hdr.n_refs)
+    v = g.guess_next_record_start(args.pos, end)
+    if v == end:
+        print(f"no BAM record found in [{args.pos},{end})")
+        return 1
+    print(f"{v >> 16}:{v & 0xFFFF}")
+    return 0
+
+
+def _cmd_bcf_guess(args) -> int:
+    from .io.bcf import BcfSplitGuesser, read_bcf_header
+
+    with open(args.bcf, "rb") as f:
+        data = f.read()
+    hdr, _ = read_bcf_header(data)
+    end = args.end if args.end is not None else len(data)
+    g = BcfSplitGuesser(data, hdr)
+    v = g.guess_next_record_start(args.pos, end)
+    if v is None:
+        print(f"no BCF record found in [{args.pos},{end})")
+        return 1
+    if g.compressed:
+        print(f"{v >> 16}:{v & 0xFFFF}")
+    else:
+        # _guess_plain returns the degenerate voffset form (off << 16);
+        # report the plain file offset for uncompressed input.
+        print(v >> 16)
+    return 0
+
+
+def _cmd_sorted_header(args) -> int:
+    from .io.bam import read_header
+    from .io.merger import prepare_bam_header_block
+
+    hdr = read_header(args.bam).with_sort_order("coordinate")
+    block = prepare_bam_header_block(hdr)
+    if args.out == "-":
+        sys.stdout.buffer.write(block)
+    else:
+        with open(args.out, "wb") as f:
+            f.write(block)
+        print(f"{args.out}: {len(block)} bytes (BGZF header block)")
+    return 0
+
+
+def _cmd_sort(args) -> int:
+    from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
+    from .pipeline import sort_bam
+
+    conf = Configuration()
+    if args.write_splitting_bai:
+        conf.set_boolean(BAM_WRITE_SPLITTING_BAI, True)
+    mesh = None
+    if args.devices:
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.devices)
+    stats = sort_bam(
+        list(args.bam),
+        args.output,
+        conf=conf,
+        split_size=args.split_size,
+        mesh=mesh,
+        level=args.level,
+        write_splitting_bai=args.write_splitting_bai,
+    )
+    print(
+        f"{args.output}: {stats.n_records} records from {stats.n_splits} "
+        f"splits via {stats.backend}"
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hadoop_bam_tpu",
+        description="TPU-native splittable bioinformatics format toolkit",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser(
+        "splitting-index",
+        help="build .splitting-bai record index(es) for BAM file(s)",
+    )
+    s.add_argument("bam", nargs="+")
+    s.add_argument("-g", "--granularity", type=int, default=4096)
+    s.set_defaults(func=_cmd_splitting_index)
+
+    s = sub.add_parser(
+        "splitting-index-dump", help="print a .splitting-bai's offsets"
+    )
+    s.add_argument("index")
+    s.set_defaults(func=_cmd_splitting_index_dump)
+
+    s = sub.add_parser(
+        "bgzf-index", help="build .bgzfi block index(es) for BGZF file(s)"
+    )
+    s.add_argument("file", nargs="+")
+    s.add_argument("-g", "--granularity", type=int, default=1024)
+    s.set_defaults(func=_cmd_bgzf_index)
+
+    s = sub.add_parser(
+        "bai-index", help="build a standard .bai for a coordinate-sorted BAM"
+    )
+    s.add_argument("bam", nargs="+")
+    s.set_defaults(func=_cmd_bai_index)
+
+    s = sub.add_parser(
+        "bam-guess", help="find the first BAM record start at/after a byte position"
+    )
+    s.add_argument("bam")
+    s.add_argument("pos", type=int)
+    s.add_argument("--end", type=int, default=None)
+    s.set_defaults(func=_cmd_bam_guess)
+
+    s = sub.add_parser(
+        "bcf-guess", help="find the first BCF record start at/after a byte position"
+    )
+    s.add_argument("bcf")
+    s.add_argument("pos", type=int)
+    s.add_argument("--end", type=int, default=None)
+    s.set_defaults(func=_cmd_bcf_guess)
+
+    s = sub.add_parser(
+        "sorted-header",
+        help="extract a BAM header, set SO:coordinate, emit as a BGZF block",
+    )
+    s.add_argument("bam")
+    s.add_argument("out", nargs="?", default="-")
+    s.set_defaults(func=_cmd_sorted_header)
+
+    s = sub.add_parser("sort", help="coordinate-sort BAM file(s) end to end")
+    s.add_argument("bam", nargs="+")
+    s.add_argument("-o", "--output", required=True)
+    s.add_argument("--split-size", type=int, default=32 << 20)
+    s.add_argument("--level", type=int, default=6)
+    s.add_argument("--devices", type=int, default=0,
+                   help="sort over an n-device mesh (0 = single device)")
+    s.add_argument("--write-splitting-bai", action="store_true")
+    s.set_defaults(func=_cmd_sort)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

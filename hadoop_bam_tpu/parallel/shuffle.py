@@ -41,8 +41,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-_HI_PAD = jnp.int32(0x7FFFFFFF)
-_LO_PAD = jnp.uint32(0xFFFFFFFF)
+# NumPy scalars, not jnp: a module-level jnp constant would initialize the
+# device backend at import time, breaking host-only use of the package.
+_HI_PAD = np.int32(0x7FFFFFFF)
+_LO_PAD = np.uint32(0xFFFFFFFF)
 
 
 class ShuffleResult(NamedTuple):

@@ -23,7 +23,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .conf import Configuration
+from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
 from .io.bam import (
     BamInputFormat,
     BamOutputWriter,
@@ -78,10 +78,18 @@ def sort_bam(
     level: int = 6,
     write_splitting_bai: bool = False,
 ) -> SortStats:
-    """Coordinate-sort BAM file(s) into one merged BAM."""
+    """Coordinate-sort BAM file(s) into one merged BAM.
+
+    ``hadoopbam.bam.write-splitting-bai`` in ``conf`` enables the per-part
+    splitting index like the kwarg does (the reference's config-driven
+    WRITE_SPLITTING_BAI, BAMOutputFormat.java)."""
     if isinstance(in_paths, str):
         in_paths = [in_paths]
     fmt = BamInputFormat(conf)
+    if conf is not None:
+        write_splitting_bai = write_splitting_bai or conf.get_boolean(
+            BAM_WRITE_SPLITTING_BAI
+        )
     header = read_header(in_paths[0]).with_sort_order("coordinate")
     splits = fmt.get_splits(in_paths, split_size=split_size)
     batches: List[RecordBatch] = [fmt.read_split(s) for s in splits]
