@@ -132,19 +132,32 @@ def _cmd_sort(args) -> int:
         from .parallel.mesh import make_mesh
 
         mesh = make_mesh(args.devices)
-    stats = sort_bam(
-        list(args.bam),
-        args.output,
-        conf=conf,
-        split_size=args.split_size,
-        mesh=mesh,
-        level=args.level,
-        write_splitting_bai=args.write_splitting_bai,
+    import contextlib
+
+    from .utils.tracing import METRICS, device_trace
+
+    ctx = (
+        device_trace(args.trace_dir) if args.trace_dir
+        else contextlib.nullcontext()
     )
+    with ctx:
+        stats = sort_bam(
+            list(args.bam),
+            args.output,
+            conf=conf,
+            split_size=args.split_size,
+            mesh=mesh,
+            level=args.level,
+            write_splitting_bai=args.write_splitting_bai,
+        )
     print(
         f"{args.output}: {stats.n_records} records from {stats.n_splits} "
         f"splits via {stats.backend}"
     )
+    if args.metrics:
+        import json
+
+        print(json.dumps(METRICS.report(), indent=2, sort_keys=True))
     return 0
 
 
@@ -214,6 +227,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--devices", type=int, default=0,
                    help="sort over an n-device mesh (0 = single device)")
     s.add_argument("--write-splitting-bai", action="store_true")
+    s.add_argument("--metrics", action="store_true",
+                   help="print the span/counter report after the run")
+    s.add_argument("--trace-dir", default=None,
+                   help="capture a JAX profiler (XPlane) trace here")
     s.set_defaults(func=_cmd_sort)
 
     return p

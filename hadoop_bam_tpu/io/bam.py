@@ -36,6 +36,7 @@ from ..conf import (
 )
 from ..spec import bam, bgzf, indices
 from ..utils.intervals import Interval, parse_intervals
+from ..utils.tracing import METRICS
 from .guesser import BamSplitGuesser
 from .splits import FileVirtualSplit
 
@@ -541,6 +542,11 @@ def read_virtual_range(
         if with_keys and len(soa["refid"])
         else np.empty(0, dtype=np.int64)
     )
+    METRICS.count("bam.blocks_inflated", len(voffs_l))
+    METRICS.count("bam.bytes_inflated", len(payload))
+    METRICS.count("bam.records_decoded", len(offsets))
+    if interval_chunks is not None:
+        METRICS.count("bam.records_kept", len(soa["refid"]))
     return RecordBatch(soa=soa, data=arr, keys=keys)
 
 

@@ -24,6 +24,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
+from .utils.tracing import METRICS, span
 from .io.bam import (
     BamInputFormat,
     BamOutputWriter,
@@ -91,14 +92,18 @@ def sort_bam(
             BAM_WRITE_SPLITTING_BAI
         )
     header = read_header(in_paths[0]).with_sort_order("coordinate")
-    splits = fmt.get_splits(in_paths, split_size=split_size)
-    batches: List[RecordBatch] = [fmt.read_split(s) for s in splits]
+    with span("sort_bam.plan"):
+        splits = fmt.get_splits(in_paths, split_size=split_size)
+    with span("sort_bam.read"):
+        batches: List[RecordBatch] = [fmt.read_split(s) for s in splits]
     all_keys = (
         np.concatenate([b.keys for b in batches])
         if batches
         else np.empty(0, np.int64)
     )
     n = len(all_keys)
+    METRICS.count("sort_bam.records", n)
+    METRICS.count("sort_bam.splits", len(splits))
 
     if distributed is not None or mesh is not None:
         ds = distributed
@@ -107,28 +112,30 @@ def sort_bam(
             rows = -(-max(n, 1) // mesh.devices.size)
             ds = DistributedSort(mesh, rows_per_device=rows)
         backend = f"mesh[{ds.n_devices}]"
-        try:
-            _, perm, _ = ds.sort_global(all_keys)
-        except RuntimeError:
-            # Degenerate key skew: retry with full capacity.
-            ds = DistributedSort(
-                ds.mesh, ds.rows, capacity_per_pair=ds.rows
-            )
-            _, perm, _ = ds.sort_global(all_keys)
+        with span("sort_bam.shuffle_sort"):
+            try:
+                _, perm, _ = ds.sort_global(all_keys)
+            except RuntimeError:
+                # Degenerate key skew: retry with full capacity.
+                ds = DistributedSort(
+                    ds.mesh, ds.rows, capacity_per_pair=ds.rows
+                )
+                _, perm, _ = ds.sort_global(all_keys)
     else:
         backend = "single-device"
         from .ops.keys import split_keys_np
 
-        hi, lo = split_keys_np(all_keys)
-        _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
-        perm = np.asarray(perm)
+        with span("sort_bam.device_sort"):
+            hi, lo = split_keys_np(all_keys)
+            _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
+            perm = np.asarray(perm)
 
     # Concatenate batches into one global batch view, then write permuted
     # parts with the vectorized gather + batched native deflate.
     from .io.bam import write_part_fast
 
     merged = _concat_batches(batches)
-    with tempfile.TemporaryDirectory(
+    with span("sort_bam.write_merge"), tempfile.TemporaryDirectory(
         dir=os.path.dirname(os.path.abspath(out_path)) or "."
     ) as td:
         n_parts = max(1, len(batches))

@@ -1,0 +1,141 @@
+"""Tracing, counters, and progress: the observability the reference lacks.
+
+The reference's only instrumentation is a deprecated nanosecond ``Timer``
+(util/Timer.java:4-12) and a ``-`` progress tick every 500MB in its indexers
+(SplittingBAMIndexer.java:144,277-282); task progress is Hadoop's
+``getProgress()`` contract.  Per SURVEY.md §5 the TPU build wires real
+tracing instead: wall-clock spans + named counters in a process-local
+registry, an optional 500MB-cadence progress printer, and hooks into the JAX
+profiler (XPlane) so device phases show up in TensorBoard traces.
+
+Everything degrades to no-ops: spans/counters are cheap dict updates, and the
+profiler hooks import ``jax`` lazily so host-only tools never touch a device
+backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class MetricsRegistry:
+    """Thread-safe named counters + cumulative span timings."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._spans: Dict[str, float] = {}
+        self._span_counts: Dict[str, int] = {}
+
+    def count(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def add_span(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._spans[name] = self._spans.get(name, 0.0) + seconds
+            self._span_counts[name] = self._span_counts.get(name, 0) + 1
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "span_seconds": dict(self._spans),
+                "span_counts": dict(self._span_counts),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._spans.clear()
+            self._span_counts.clear()
+
+
+METRICS = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None) -> Iterator[None]:
+    """Timed scope, cumulative per name; also annotates the JAX profiler
+    timeline when a trace is active (TraceAnnotation is ~free otherwise)."""
+    reg = registry or METRICS
+    ann = _annotation(name)
+    t0 = time.perf_counter()
+    try:
+        if ann is not None:
+            with ann:
+                yield
+        else:
+            yield
+    finally:
+        reg.add_span(name, time.perf_counter() - t0)
+
+
+def _annotation(name: str):
+    """A jax.profiler.TraceAnnotation if jax is already imported, else None
+    (never *triggers* a jax import — host-only tools stay device-free)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler API unavailable
+        return None
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture an XPlane trace of the enclosed scope into ``log_dir``
+    (viewable in TensorBoard/XProf).  The real replacement for the
+    reference's Timer: device timelines, not host nanoseconds."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class Progress:
+    """Byte-cadence progress ticks (SplittingBAMIndexer.java:277-282 prints
+    one ``-`` per 500MB; here: a callback or stderr tick, plus totals)."""
+
+    DEFAULT_CADENCE = 500 << 20
+
+    def __init__(
+        self,
+        total_bytes: Optional[int] = None,
+        cadence: int = DEFAULT_CADENCE,
+        sink=None,
+    ) -> None:
+        self.total = total_bytes
+        self.cadence = cadence
+        self.done = 0
+        self._next = cadence
+        self._sink = sink if sink is not None else self._default_sink
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _default_sink(progress: "Progress") -> None:
+        sys.stderr.write("-")
+        sys.stderr.flush()
+
+    def advance(self, nbytes: int) -> None:
+        with self._lock:
+            self.done += nbytes
+            fire = self.done >= self._next
+            if fire:
+                self._next += self.cadence * (
+                    1 + (self.done - self._next) // self.cadence
+                )
+        if fire:
+            self._sink(self)
+
+    def fraction(self) -> float:
+        """Hadoop ``getProgress()`` analog; 0.0 when the total is unknown
+        (the reference's virtual-offset progress is likewise inexact)."""
+        if not self.total:
+            return 0.0
+        return min(1.0, self.done / self.total)

@@ -1,0 +1,80 @@
+"""Observability tests: spans, counters, progress cadence, pipeline wiring
+(the replacement for the reference's deprecated util/Timer.java and the
+500MB progress ticks of SplittingBAMIndexer.java:277-282)."""
+
+import io
+import threading
+
+import numpy as np
+
+from hadoop_bam_tpu.utils.tracing import (
+    METRICS,
+    MetricsRegistry,
+    Progress,
+    span,
+)
+
+
+def test_span_accumulates():
+    reg = MetricsRegistry()
+    for _ in range(3):
+        with span("phase.x", reg):
+            pass
+    rep = reg.report()
+    assert rep["span_counts"]["phase.x"] == 3
+    assert rep["span_seconds"]["phase.x"] >= 0.0
+
+
+def test_counters_threadsafe():
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.count("n")
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert reg.report()["counters"]["n"] == 8000
+
+
+def test_progress_cadence():
+    ticks = []
+    p = Progress(total_bytes=100, cadence=10, sink=lambda pr: ticks.append(pr.done))
+    p.advance(25)  # crosses 10 and 20 → one tick, next at 30
+    p.advance(4)
+    p.advance(1)  # hits 30
+    assert len(ticks) == 2
+    assert p.fraction() == 0.3
+
+
+def test_progress_unknown_total():
+    p = Progress(sink=lambda pr: None)
+    p.advance(10)
+    assert p.fraction() == 0.0
+
+
+def test_pipeline_emits_metrics(tmp_path):
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.spec import bam
+
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\n@SQ\tSN:c\tLN:100000", [("c", 100000)]
+    )
+    recs = [
+        bam.build_record(f"r{i}", 0, (97 * i) % 90000, 60, 0, [(10, "M")],
+                         "ACGTACGTAC", bytes([30] * 10))
+        for i in range(200)
+    ]
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    p = tmp_path / "m.bam"
+    p.write_bytes(buf.getvalue())
+    METRICS.reset()
+    sort_bam(str(p), str(tmp_path / "out.bam"))
+    rep = METRICS.report()
+    assert rep["counters"]["sort_bam.records"] == 200
+    assert rep["counters"]["bam.records_decoded"] >= 200
+    for phase in ("sort_bam.plan", "sort_bam.read", "sort_bam.device_sort",
+                  "sort_bam.write_merge"):
+        assert rep["span_counts"][phase] == 1, phase
