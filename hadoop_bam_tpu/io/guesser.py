@@ -58,7 +58,11 @@ class BamSplitGuesser:
             except (bgzf.BgzfError, bam.BamError, struct.error):
                 pass
 
-        window = self.data[beg : min(end, beg + MAX_BYTES_READ, len(self.data))]
+        # The buffer extends MAX_BYTES_READ past beg regardless of ``end``:
+        # ``end`` bounds where a record may *start*, not the verify window
+        # (BAMSplitGuesser.java:127-140 reads the full buffer; only the
+        # candidate-block search is clamped to min(end-beg, 0xffff)).
+        window = self.data[beg : min(beg + MAX_BYTES_READ, len(self.data))]
         first_bgzf_end = min(end - beg, 0xFFFF)
         cp = 0
         while True:

@@ -8,5 +8,6 @@ The reference's two distribution mechanisms (SURVEY.md §2.7) map to:
   64-bit ``(refIdx<<32|pos0)`` packing.
 """
 
+from .executor import ElasticExecutor, PartFailedError  # noqa: F401
 from .mesh import make_mesh, data_axis  # noqa: F401
 from .shuffle import DistributedSort  # noqa: F401

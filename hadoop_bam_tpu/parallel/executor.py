@@ -1,0 +1,148 @@
+"""Elastic per-split execution: the Hadoop task-retry contract, in-process.
+
+The reference delegates failure handling to Hadoop: a failed map/reduce task
+is re-executed up to ``mapreduce.{map,reduce}.maxattempts`` times, the
+restart unit is the *part file*, and a completed job is marked by the
+``_SUCCESS`` file that the mergers require before touching any part
+(util/SAMFileMerger.java:50-54, util/VCFFileMerger.java:47-51; SURVEY.md §5
+"checkpoint/resume").
+
+``ElasticExecutor`` reproduces that contract for the TPU pipeline's
+host-side fan-out:
+
+- one *attempt* = run ``work_fn(item, tmp_path)``; the part materializes at
+  its final name only via atomic rename, so readers never see torn output;
+- bounded retries per item with a per-item failure log;
+- *resume*: an existing final part is trusted and skipped (a rerun after a
+  crash redoes only missing parts — the part files double as checkpoints,
+  like the reference's reusable ``.splitting-bai`` artifacts);
+- ``_SUCCESS`` written only when every item succeeded;
+- a ``fault_hook(item, attempt)`` seam for fault-injection tests (the
+  reference has none — SURVEY.md §5 calls this out as a gap).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils import nio
+from ..utils.tracing import METRICS
+
+
+class PartFailedError(RuntimeError):
+    """An item exhausted its attempts; carries the per-attempt error log."""
+
+    def __init__(self, failures: Dict[int, List[str]]):
+        self.failures = failures
+        msgs = "; ".join(
+            f"item {i}: {errs[-1]}" for i, errs in sorted(failures.items())
+        )
+        super().__init__(f"{len(failures)} part(s) failed permanently: {msgs}")
+
+
+@dataclass
+class ExecutionReport:
+    parts: List[str]
+    attempts: int
+    retried: int
+    skipped_existing: int
+    failure_log: Dict[int, List[str]] = field(default_factory=dict)
+
+
+class ElasticExecutor:
+    def __init__(
+        self,
+        out_dir: str,
+        max_attempts: int = 3,
+        max_workers: Optional[int] = None,
+        fault_hook: Optional[Callable[[int, int], None]] = None,
+    ) -> None:
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.out_dir = out_dir
+        self.max_attempts = max_attempts
+        # Modest default: each work_fn is typically itself parallel (native
+        # deflate threads) and holds a part's payload in memory.
+        self.max_workers = max_workers or min(4, (os.cpu_count() or 4))
+        self.fault_hook = fault_hook
+
+    def run(
+        self,
+        items: Sequence,
+        work_fn: Callable[[object, str], None],
+        part_name: Callable[[int], str] = lambda i: f"part-r-{i:05d}",
+        mark_success: bool = True,
+    ) -> ExecutionReport:
+        """Run ``work_fn(item, tmp_path)`` per item; return final part paths
+        in item order.  Raises PartFailedError if any item exhausts its
+        attempts (and does NOT write ``_SUCCESS``)."""
+        os.makedirs(self.out_dir, exist_ok=True)
+        n = len(items)
+        parts = [os.path.join(self.out_dir, part_name(i)) for i in range(n)]
+        attempts = 0
+        retried = 0
+        skipped = 0
+        failures: Dict[int, List[str]] = {}
+        lock = threading.Lock()
+
+        def run_one(i: int) -> None:
+            nonlocal attempts, retried, skipped
+            final = parts[i]
+            if os.path.exists(final):
+                with lock:
+                    skipped += 1
+                return
+            errs: List[str] = []
+            for attempt in range(self.max_attempts):
+                # Hadoop's _temporary convention: the leading underscore
+                # keeps in-flight attempts invisible to the part-[mr]-* glob
+                # the mergers use (util/NIOFileUtil.java:24).
+                tmp = os.path.join(
+                    self.out_dir,
+                    f"_temporary.{os.path.basename(final)}.{attempt}",
+                )
+                try:
+                    with lock:
+                        attempts += 1
+                        if attempt > 0:
+                            retried += 1
+                    if self.fault_hook is not None:
+                        self.fault_hook(i, attempt)
+                    work_fn(items[i], tmp)
+                    os.replace(tmp, final)
+                    return
+                except Exception as e:  # noqa: BLE001 - retry boundary
+                    errs.append(f"attempt {attempt}: {type(e).__name__}: {e}")
+                    # Sweep the tmp file AND any side files the work_fn
+                    # derived from it (e.g. tmp+'.sb' index temps).
+                    base = os.path.basename(tmp)
+                    for fn in os.listdir(self.out_dir):
+                        if fn.startswith(base):
+                            try:
+                                os.remove(os.path.join(self.out_dir, fn))
+                            except OSError:
+                                pass
+            with lock:
+                failures[i] = errs
+
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            list(pool.map(run_one, range(n)))
+
+        METRICS.count("executor.attempts", attempts)
+        METRICS.count("executor.retried", retried)
+        METRICS.count("executor.skipped_existing", skipped)
+        if failures:
+            METRICS.count("executor.failed_parts", len(failures))
+            raise PartFailedError(failures)
+        if mark_success:
+            nio.write_success(self.out_dir)
+        return ExecutionReport(
+            parts=parts,
+            attempts=attempts,
+            retried=retried,
+            skipped_existing=skipped,
+        )

@@ -13,6 +13,7 @@ LazyBAMRecord stance — the sort never touches variable-length payloads).
 
 from __future__ import annotations
 
+import contextlib
 import io
 import os
 import tempfile
@@ -33,6 +34,7 @@ from .io.bam import (
 )
 from .io.merger import merge_bam_parts
 from .ops.sort import sort_keys
+from .parallel.executor import ElasticExecutor
 from .parallel.mesh import make_mesh
 from .parallel.shuffle import DistributedSort
 from .spec import bam
@@ -78,6 +80,9 @@ def sort_bam(
     distributed: Optional[DistributedSort] = None,
     level: int = 6,
     write_splitting_bai: bool = False,
+    max_attempts: int = 3,
+    part_dir: Optional[str] = None,
+    write_workers: Optional[int] = None,
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
 
@@ -135,32 +140,54 @@ def sort_bam(
     from .io.bam import write_part_fast
 
     merged = _concat_batches(batches)
-    with span("sort_bam.write_merge"), tempfile.TemporaryDirectory(
-        dir=os.path.dirname(os.path.abspath(out_path)) or "."
-    ) as td:
+    with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
+        if part_dir is not None:
+            # Persistent part dir: the parts are crash-restart units — a
+            # rerun with the same part_dir redoes only missing parts (the
+            # reference's part-file + _SUCCESS resume semantics, §5).
+            td = part_dir
+            os.makedirs(td, exist_ok=True)
+        else:
+            td = stack.enter_context(
+                tempfile.TemporaryDirectory(
+                    dir=os.path.dirname(os.path.abspath(out_path)) or "."
+                )
+            )
+        executor = ElasticExecutor(
+            td, max_attempts=max_attempts, max_workers=write_workers
+        )
+        # Split the native deflate thread budget across concurrent writers.
+        deflate_threads = max(
+            1, (os.cpu_count() or 4) // executor.max_workers
+        )
         n_parts = max(1, len(batches))
         bounds = [len(perm) * i // n_parts for i in range(n_parts + 1)]
-        for pi in range(n_parts):
+
+        def write_one(pi: int, tmp: str) -> None:
             order = perm[bounds[pi] : bounds[pi + 1]]
-            part = os.path.join(td, f"part-r-{pi:05d}")
             sb_stream = None
             try:
                 if write_splitting_bai:
-                    sb_stream = open(
-                        part + ".splitting-bai", "wb"
-                    )
-                with open(part, "wb") as f:
+                    sb_stream = open(tmp + ".sb", "wb")
+                with open(tmp, "wb") as f:
                     write_part_fast(
                         f,
                         merged,
                         order=order,
                         level=level,
                         splitting_bai_stream=sb_stream,
+                        threads=deflate_threads,
                     )
             finally:
                 if sb_stream is not None:
                     sb_stream.close()
-        nio.write_success(td)
+            if write_splitting_bai:
+                os.replace(
+                    tmp + ".sb",
+                    os.path.join(td, f"part-r-{pi:05d}.splitting-bai"),
+                )
+
+        executor.run(list(range(n_parts)), write_one)
         merge_bam_parts(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )

@@ -1,0 +1,189 @@
+"""Elastic execution tests: the Hadoop task-retry / part-restart / _SUCCESS
+contract (SURVEY.md §5 failure-detection notes), with fault injection the
+reference never had."""
+
+import io
+import os
+
+import pytest
+
+from hadoop_bam_tpu.parallel.executor import ElasticExecutor, PartFailedError
+from hadoop_bam_tpu.utils import nio
+
+
+def _write(item, tmp):
+    with open(tmp, "w") as f:
+        f.write(f"payload-{item}")
+
+
+def test_success_path(tmp_path):
+    ex = ElasticExecutor(str(tmp_path / "out"))
+    rep = ex.run([10, 20, 30], _write)
+    assert [open(p).read() for p in rep.parts] == [
+        "payload-10", "payload-20", "payload-30"
+    ]
+    nio.check_success(tmp_path / "out")  # must not raise
+    assert rep.attempts == 3 and rep.retried == 0
+
+
+def test_transient_fault_retried(tmp_path):
+    # Fail every item's first attempt; all must recover on the second.
+    def hook(i, attempt):
+        if attempt == 0:
+            raise IOError(f"transient {i}")
+
+    ex = ElasticExecutor(str(tmp_path / "out"), fault_hook=hook)
+    rep = ex.run([1, 2], _write)
+    assert rep.retried == 2 and rep.attempts == 4
+    nio.check_success(tmp_path / "out")
+
+
+def test_permanent_fault_raises_and_no_success(tmp_path):
+    def hook(i, attempt):
+        if i == 1:
+            raise RuntimeError("device on fire")
+
+    ex = ElasticExecutor(str(tmp_path / "out"), max_attempts=2, fault_hook=hook)
+    with pytest.raises(PartFailedError) as ei:
+        ex.run([0, 1, 2], _write)
+    assert 1 in ei.value.failures
+    assert len(ei.value.failures[1]) == 2  # both attempts logged
+    assert not os.path.exists(tmp_path / "out" / "_SUCCESS")
+    # Healthy parts still materialized — the restart units for a rerun.
+    assert (tmp_path / "out" / "part-r-00000").exists()
+    # No _temporary litter that a part glob could pick up.
+    assert not [
+        p for p in os.listdir(tmp_path / "out") if p.startswith("_temporary")
+    ]
+    assert nio.list_parts(tmp_path / "out") == [
+        tmp_path / "out" / "part-r-00000",
+        tmp_path / "out" / "part-r-00002",
+    ]
+
+
+def test_resume_skips_existing(tmp_path):
+    out = tmp_path / "out"
+    ex = ElasticExecutor(str(out))
+    ex.run([1, 2, 3], _write)
+    calls = []
+
+    def count_writes(item, tmp):
+        calls.append(item)
+        _write(item, tmp)
+
+    os.remove(out / "part-r-00001")
+    rep = ElasticExecutor(str(out)).run([1, 2, 3], count_writes)
+    assert calls == [2]  # only the missing part is redone
+    assert rep.skipped_existing == 2
+
+
+def test_failed_attempt_sweeps_side_files(tmp_path):
+    # A work_fn that creates tmp-derived side files then fails must not
+    # leave them behind (the pipeline's tmp+'.sb' index temps).
+    def messy(item, tmp):
+        with open(tmp + ".sb", "w") as f:
+            f.write("index")
+        raise IOError("boom")
+
+    ex = ElasticExecutor(str(tmp_path / "out"), max_attempts=2)
+    with pytest.raises(PartFailedError):
+        ex.run([0], messy)
+    leftover = [
+        p for p in os.listdir(tmp_path / "out") if p.startswith("_temporary")
+    ]
+    assert leftover == []
+
+
+def test_max_attempts_validation(tmp_path):
+    with pytest.raises(ValueError):
+        ElasticExecutor(str(tmp_path), max_attempts=0)
+
+
+def test_sort_resume_from_part_dir(tmp_path):
+    # Crash mid-write (permanent failure on one part), rerun with the same
+    # part_dir: completed parts are skipped, output completes.
+    from hadoop_bam_tpu import pipeline
+    from hadoop_bam_tpu.spec import bam
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    hdr = bam.BamHeader("@HD\tVN:1.6\n@SQ\tSN:c\tLN:9999999", [("c", 9999999)])
+    recs = [
+        bam.build_record(
+            f"r{i}", 0, int(rng.integers(0, 9000000)), 60, 0, [(100, "M")],
+            "".join("ACGT"[b] for b in rng.integers(0, 4, 100)),
+            bytes(rng.integers(2, 40, 100).astype(np.uint8)),
+        )
+        for i in range(1000)
+    ]
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    src = tmp_path / "in.bam"
+    src.write_bytes(buf.getvalue())
+    pdir = str(tmp_path / "parts")
+    out = tmp_path / "out.bam"
+
+    real_run = ElasticExecutor.run
+    def crashing_run(self, items, work_fn, **kw):
+        def crash_last(item, tmp):
+            if item == len(items) - 1:
+                raise RuntimeError("simulated crash")
+            work_fn(item, tmp)
+        return real_run(self, items, crash_last, **kw)
+
+    ElasticExecutor.run = crashing_run
+    try:
+        with pytest.raises(PartFailedError):
+            pipeline.sort_bam(str(src), str(out), split_size=30_000,
+                              part_dir=pdir, max_attempts=1)
+    finally:
+        ElasticExecutor.run = real_run
+
+    METRICS.reset()
+    pipeline.sort_bam(str(src), str(out), split_size=30_000, part_dir=pdir)
+    _, got = bam.read_bam(str(out))
+    keys = [bam.alignment_key(r) for r in got]
+    assert len(got) == 1000 and keys == sorted(keys)
+    rep = METRICS.report()
+    # The parts completed before the crash were skipped on the rerun.
+    assert rep["counters"]["executor.skipped_existing"] > 0
+
+
+def test_sort_survives_transient_part_failures(tmp_path, monkeypatch):
+    # End to end: sort a BAM while the first write attempt of every part
+    # fails; output must still be complete and sorted.
+    from hadoop_bam_tpu import pipeline
+    from hadoop_bam_tpu.spec import bam
+
+    hdr = bam.BamHeader("@HD\tVN:1.6\n@SQ\tSN:c\tLN:99999", [("c", 99999)])
+    recs = [
+        bam.build_record(f"r{i}", 0, (31 * i) % 90000, 60, 0, [(8, "M")],
+                         "ACGTACGT", bytes([30] * 8))
+        for i in range(300)
+    ]
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    src = tmp_path / "in.bam"
+    src.write_bytes(buf.getvalue())
+
+    real_run = ElasticExecutor.run
+    failed = set()
+
+    def flaky_run(self, items, work_fn, **kw):
+        def flaky_work(item, tmp):
+            if item not in failed:
+                failed.add(item)
+                raise IOError("synthetic first-attempt failure")
+            work_fn(item, tmp)
+
+        return real_run(self, items, flaky_work, **kw)
+
+    monkeypatch.setattr(ElasticExecutor, "run", flaky_run)
+    out = tmp_path / "out.bam"
+    pipeline.sort_bam(str(src), str(out))
+    _, got = bam.read_bam(str(out))
+    keys = [bam.alignment_key(r) for r in got]
+    assert len(got) == 300 and keys == sorted(keys)
+    assert failed  # the fault actually fired

@@ -160,6 +160,36 @@ class TestIndexedSplits:
         assert got == [r.raw for r in recs]
 
 
+class TestGuesserBlocksLargerThanSplit:
+    def test_splits_smaller_than_compressed_blocks(self, tmp_path):
+        # Compressed blocks ~40KB, splits 30KB: a candidate block's 3-block
+        # verify window extends past the split end.  The guesser must still
+        # find record starts (the verify buffer is bounded by
+        # MAX_BYTES_READ past beg, not by the split end).
+        rng = np.random.default_rng(5)
+        hdr = bam.BamHeader(
+            "@HD\tVN:1.6\n@SQ\tSN:c\tLN:9999999", [("c", 9999999)]
+        )
+        recs = [
+            bam.build_record(
+                f"r{i}", 0, int(rng.integers(0, 9000000)), 60, 0,
+                [(100, "M")],
+                "".join("ACGT"[b] for b in rng.integers(0, 4, 100)),
+                bytes(rng.integers(2, 40, 100).astype(np.uint8)),
+            )
+            for i in range(1000)
+        ]
+        buf = io.BytesIO()
+        bam.write_bam(buf, hdr, iter(recs))
+        p = tmp_path / "bigblocks.bam"
+        p.write_bytes(buf.getvalue())
+        fmt = BamInputFormat()
+        splits = fmt.get_splits([str(p)], split_size=30_000)
+        assert len(splits) > 1, "expected one split per ~block"
+        got = all_records_via_splits(fmt, str(p), 30_000)
+        assert got == [r.raw for r in recs]
+
+
 class TestBaiSplitter:
     """Tier-2 planning via the linear `.bai` index
     (BAMInputFormat.addBAISplits, BAMInputFormat.java:322-465)."""
