@@ -52,15 +52,16 @@ def device_histogram(batch: FragmentBatch, n_devices: int = 0):
     import jax
     import jax.numpy as jnp
 
+    from hadoop_bam_tpu.ops.quality import histogram_u8
+
     qual = batch.qual.astype(np.int32) - 33  # Sanger → Phred
     valid = batch.valid_mask()
     nbins = 94  # full Sanger Phred range (0..93)
 
     if n_devices <= 1:
-        hist = jnp.zeros(nbins, jnp.int32).at[
-            jnp.clip(jnp.asarray(qual).ravel(), 0, nbins - 1)
-        ].add(jnp.asarray(valid).ravel().astype(jnp.int32))
-        return np.asarray(hist)
+        return np.asarray(
+            histogram_u8(jnp.asarray(qual), jnp.asarray(valid), nbins=nbins)
+        )
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -78,10 +79,7 @@ def device_histogram(batch: FragmentBatch, n_devices: int = 0):
     valid = np.pad(valid, ((0, pad), (0, 0)))
 
     def shard_fn(q, v):
-        local = jnp.zeros(nbins, jnp.int32).at[
-            jnp.clip(q.ravel(), 0, nbins - 1)
-        ].add(v.ravel().astype(jnp.int32))
-        return jax.lax.psum(local, "d")
+        return jax.lax.psum(histogram_u8(q, v, nbins=nbins), "d")
 
     f = jax.jit(
         shard_map(
@@ -113,18 +111,15 @@ def main() -> int:
     n = sum(b.n_records for b in batches)
     print(f"{n} fragments from {len(splits)} splits")
 
-    merged = FragmentBatch.from_fragments(
-        [nm for b in batches for nm in b.names],
-        [fr for b in batches for fr in b.fragments],
-    )
-    hist = device_histogram(merged, args.devices)
+    # Histograms are additive: reduce per batch, no re-materialized merge.
+    hist = sum(device_histogram(b, args.devices) for b in batches)
     total = int(hist.sum())
     mean_q = float((hist * np.arange(len(hist))).sum() / max(total, 1))
     print(f"bases: {total}, mean Phred: {mean_q:.2f}")
     top = np.argsort(hist)[-5:][::-1]
     for q in top:
         print(f"  Q{int(q):2d}: {int(hist[q])}")
-    assert total == int(merged.valid_mask().sum())
+    assert total == sum(int(b.valid_mask().sum()) for b in batches)
     print("OK")
     return 0
 

@@ -4,4 +4,6 @@ Kernels fall back to interpreter mode off-TPU (tests run them on the CPU
 mesh), and to the plain-XLA ops/ implementations when Pallas is unavailable.
 """
 
-from .histogram import quality_histogram  # noqa: F401
+from .histogram import quality_histogram, quality_histogram_auto  # noqa: F401
+from .overlap import overlap_mask, overlap_mask_auto  # noqa: F401
+from .unpack import unpack_nibbles, unpack_nibbles_auto  # noqa: F401

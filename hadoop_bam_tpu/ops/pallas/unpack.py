@@ -1,0 +1,62 @@
+"""Pallas TPU kernel: BAM 4-bit sequence unpack (two bases per byte).
+
+BAM packs bases as nibbles, high nibble first (SAM spec §4.2.3; the
+reference defers to htsjdk's per-record decode).  Batched on device: the
+kernel shifts/masks a [TILE, W] packed byte tile into high- and low-nibble
+planes on the VPU; the final lane interleave ([T, W, 2] → [T, 2W]) happens
+*outside* the kernel in XLA, which fuses it — Mosaic rejects lane-doubling
+reshapes in-kernel (tpu.reshape vector<..x64x2> → <..x128> is unsupported),
+so emitting two planes is the TPU-native formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_TILE = 256  # rows per grid step
+
+
+def _kernel(packed_ref, hi_ref, lo_ref):
+    packed = packed_ref[:].astype(jnp.int32)  # [TILE, W]
+    hi_ref[:] = (packed >> 4) & 0xF
+    lo_ref[:] = packed & 0xF
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def unpack_nibbles(packed: jax.Array, interpret: bool = False) -> jax.Array:
+    """uint8/int32[B, W] packed → int32[B, 2W] base codes (0-15)."""
+    B, W = packed.shape
+    if W == 0:
+        return jnp.zeros((B, 0), jnp.int32)
+    pad = (-B) % _TILE
+    if pad:
+        packed = jnp.pad(packed, ((0, pad), (0, 0)))
+    hi, lo = pl.pallas_call(
+        _kernel,
+        grid=((B + pad) // _TILE,),
+        in_specs=[pl.BlockSpec((_TILE, W), lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((_TILE, W), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE, W), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B + pad, W), jnp.int32),
+            jax.ShapeDtypeStruct((B + pad, W), jnp.int32),
+        ),
+        interpret=interpret,
+    )(packed)
+    out = jnp.stack([hi, lo], axis=-1).reshape(B + pad, 2 * W)
+    return out[:B]
+
+
+def unpack_nibbles_auto(packed) -> jax.Array:
+    """Pallas on TPU, interpreter elsewhere (CPU tests)."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    return unpack_nibbles(jnp.asarray(packed), interpret=not on_tpu)
+
+
+SEQ_CODE_TO_BASE = "=ACMGRSVTWYHKDBN"  # SAM spec nibble alphabet
