@@ -63,33 +63,48 @@ def device_histogram(batch: FragmentBatch, n_devices: int = 0):
             histogram_u8(jnp.asarray(qual), jnp.asarray(valid), nbins=nbins)
         )
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    f = _sharded_histogram(n_devices, nbins)
+    # Pad rows to the next power-of-two multiple of the mesh so repeated
+    # batches hit the jit cache instead of recompiling per split shape.
+    rows = qual.shape[0]
+    target = n_devices
+    while target < rows:
+        target *= 2
+    pad = target - rows
+    qual = np.pad(qual, ((0, pad), (0, 0)))
+    valid = np.pad(valid, ((0, pad), (0, 0)))
+    return np.asarray(f(qual, valid))
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def _sharded_histogram(n_devices: int, nbins: int):
+    """One jitted shard_map per (mesh size, nbins) — compiled once."""
+    key = (n_devices, nbins)
+    if key in _SHARDED_CACHE:
+        return _SHARDED_CACHE[key]
+    import jax
+    from jax.sharding import PartitionSpec as P
 
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    from hadoop_bam_tpu.ops.quality import histogram_u8
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(n_devices)
-    rows = qual.shape[0]
-    pad = (-rows) % n_devices
-    qual = np.pad(qual, ((0, pad), (0, 0)))
-    valid = np.pad(valid, ((0, pad), (0, 0)))
 
     def shard_fn(q, v):
         return jax.lax.psum(histogram_u8(q, v, nbins=nbins), "d")
 
     f = jax.jit(
-        shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=(P("d"), P("d")),
-            out_specs=P(),
-        )
+        shard_map(shard_fn, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=P())
     )
-    return np.asarray(f(qual, valid))
+    _SHARDED_CACHE[key] = f
+    return f
 
 
 def main() -> int:
@@ -112,7 +127,10 @@ def main() -> int:
     print(f"{n} fragments from {len(splits)} splits")
 
     # Histograms are additive: reduce per batch, no re-materialized merge.
-    hist = sum(device_histogram(b, args.devices) for b in batches)
+    hist = sum(
+        (device_histogram(b, args.devices) for b in batches),
+        start=np.zeros(94, dtype=np.int64),
+    )
     total = int(hist.sum())
     mean_q = float((hist * np.arange(len(hist))).sum() / max(total, 1))
     print(f"bases: {total}, mean Phred: {mean_q:.2f}")

@@ -461,7 +461,7 @@ def read_virtual_range(
         )
 
     out, offs = inflate(co_l, cs_l, us_l)
-    payload = bytearray(out.tobytes())
+    payload = out  # np.uint8 — stays zero-copy unless spill blocks extend it
     # Per-block tables, extended in place when spill blocks are pulled in.
     uoffs_l: List[int] = [int(x) for x in offs[:-1]]  # payload offsets
     voffs_l: List[int] = list(co_l)  # compressed offsets
@@ -473,7 +473,7 @@ def read_virtual_range(
         raise bgzf.BgzfError("vstart uoffset beyond block payload")
 
     def spill_one() -> bool:
-        nonlocal spill_pos
+        nonlocal spill_pos, payload
         if spill_pos >= file_end:
             return False
         csize, usize = bgzf.read_block_at(data, spill_pos)
@@ -486,47 +486,58 @@ def read_virtual_range(
         uoffs_l.append(len(payload))
         voffs_l.append(spill_pos)
         usize_l.append(usize)
-        payload.extend(sp_out.tobytes())
+        payload = np.concatenate([payload, sp_out])
         spill_pos += csize
         return True
 
-    # Walk the record chain from vstart, stopping at the first record whose
-    # start voffset reaches vend.
-    rec_offs: List[int] = []
+    # Payload-offset cutoff equivalent to "record voffset >= vend" under the
+    # exact-block-end normalization rule: monotone in payload position, so
+    # the voffset comparison of the per-record walk becomes one searchsorted.
+    vc = vend >> 16
+    if vc >= file_end or not voffs_l:
+        vend_off = None  # …|0xffff last-split contract: take everything
+    else:
+        bi = max(0, int(np.searchsorted(voffs_l, vc, side="right")) - 1)
+        if voffs_l[bi] == vc:
+            vend_off = uoffs_l[bi] + min(vend & 0xFFFF, usize_l[bi])
+        else:
+            # vend falls inside block bi's compressed extent: every record
+            # of block bi precedes it, the next block's records don't.
+            vend_off = uoffs_l[bi] + usize_l[bi]
+
+    # Walk the record chain natively from vstart; a truncated tail record
+    # (spanning past the loaded window) pulls in spill blocks and resumes.
+    rec_parts: List[np.ndarray] = []
     p = uoffs_l[0] + up0 if uoffs_l else 0
-    bi = 0
-    while p + 4 <= len(payload) or spill_pos < file_end:
-        while bi + 1 < len(uoffs_l) and p >= uoffs_l[bi + 1]:
-            bi += 1
-        in_block = p - uoffs_l[bi]
-        # Normalize an exact-block-end start onto the next block.
-        if in_block >= usize_l[bi]:
-            if bi + 1 < len(uoffs_l):
-                bi += 1
-                in_block = p - uoffs_l[bi]
-            elif spill_pos < file_end:
-                spill_one()
-                continue
-            else:
-                break
-        voff = (voffs_l[bi] << 16) | in_block
-        if voff >= vend:
+    while True:
+        offs, resume = native.record_chain_partial(payload, p, len(payload))
+        if vend_off is not None:
+            k = int(np.searchsorted(offs, vend_off, side="left"))
+        else:
+            k = len(offs)
+        rec_parts.append(offs[:k])
+        if k < len(offs):
+            break  # saw a record at/after vend: done
+        if vend_off is not None and resume >= vend_off:
             break
-        while p + 4 > len(payload):
-            if not spill_one():
-                break
-        if p + 4 > len(payload):
-            break
-        (bs,) = struct.unpack_from("<I", payload, p)
-        while p + 4 + bs > len(payload):
+        if resume + 4 <= len(payload):
+            # chain stopped on a truncated body inside the window
             if not spill_one():
                 raise bam.BamError("truncated record at end of file")
-        rec_offs.append(p)
-        p += 4 + bs
+        elif spill_pos < file_end:
+            spill_one()
+        else:
+            # ≤3 trailing bytes at EOF: lenient, like the iterator stopping
+            # when no full size word remains.
+            break
+        p = resume
 
-    payload = bytes(payload)
-    arr = np.frombuffer(payload, dtype=np.uint8)
-    offsets = np.asarray(rec_offs, dtype=np.int64)
+    arr = payload
+    offsets = (
+        np.concatenate(rec_parts)
+        if rec_parts
+        else np.empty(0, dtype=np.int64)
+    )
     soa = bam.soa_decode(payload, offsets) if len(offsets) else _empty_soa()
     if interval_chunks is not None and len(offsets):
         keep = _voffset_mask(

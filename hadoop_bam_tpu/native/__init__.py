@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import tempfile
 import threading
@@ -27,7 +28,7 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed: Optional[str] = None
 
 MAX_BLOCK = 0x10000
-_ABI = 2
+_ABI = 3
 
 
 def _build(lib_path: str) -> None:
@@ -59,6 +60,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hbam_record_chain.restype = i64
     lib.hbam_record_chain.argtypes = [u8p, i64, i64, i64p, i64]
+    lib.hbam_record_chain_partial.restype = i64
+    lib.hbam_record_chain_partial.argtypes = [u8p, i64, i64, i64p, i64, i64p]
     lib.hbam_gather_records.restype = i64
     lib.hbam_gather_records.argtypes = [u8p, i64p, i64p, i64p, i64, u8p]
     return lib
@@ -271,6 +274,41 @@ def record_chain(data, start: int, end: Optional[int] = None) -> np.ndarray:
 
             raise BamError(f"record chain misaligned in [{start},{end})")
         return offs[:n].copy()
+
+
+def record_chain_partial(
+    data, start: int, end: Optional[int] = None
+) -> Tuple[np.ndarray, int]:
+    """Record-boundary offsets over ``[start, end)`` plus the resume point.
+
+    Unlike :func:`record_chain` a truncated tail record is not an error:
+    the walk stops before it and ``resume`` is where it (or the next
+    record) starts, so callers can inflate spill blocks and continue."""
+    a = _as_u8(data)
+    end = len(a) if end is None else end
+    lib = _get()
+    if lib is None:
+        offs = []
+        pos = start
+        while pos + 4 <= end:
+            (bs,) = struct.unpack_from("<I", a, pos)
+            if pos + 4 + bs > end:
+                break
+            offs.append(pos)
+            pos += 4 + bs
+        return np.asarray(offs, dtype=np.int64), pos
+    cap = max(16, (end - start) // 36 + 2)
+    resume = np.zeros(1, dtype=np.int64)
+    while True:
+        offs = np.empty(cap, dtype=np.int64)
+        n = lib.hbam_record_chain_partial(
+            _ptr(a, ctypes.c_uint8), start, end,
+            _ptr(offs, ctypes.c_int64), cap, _ptr(resume, ctypes.c_int64),
+        )
+        if n == -2:
+            cap *= 2
+            continue
+        return offs[:n].copy(), int(resume[0])
 
 
 def gather_records(

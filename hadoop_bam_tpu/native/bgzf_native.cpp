@@ -230,6 +230,25 @@ int64_t hbam_record_chain(const uint8_t* data, int64_t start, int64_t end,
   return n;
 }
 
+// Like hbam_record_chain but tolerates a truncated tail: stops before a
+// record whose size word or body would run past `end`, and reports where
+// the next (possibly incomplete) record starts via *resume so the caller
+// can inflate spill blocks and continue the walk from there.
+int64_t hbam_record_chain_partial(const uint8_t* data, int64_t start,
+                                  int64_t end, int64_t* offs,
+                                  int64_t max_records, int64_t* resume) {
+  int64_t pos = start, n = 0;
+  while (pos + 4 <= end) {
+    const int64_t bs = u32(data + pos);
+    if (pos + 4 + bs > end) break;
+    if (n >= max_records) { *resume = pos; return -2; }
+    offs[n++] = pos;
+    pos += 4 + bs;
+  }
+  *resume = pos;
+  return n;
+}
+
 // Gather records (block_size word + body) in permuted order into `out`.
 // rec_off points at record *bodies* (the u32 size word sits 4 bytes before).
 // Returns total bytes written.
@@ -246,6 +265,6 @@ int64_t hbam_gather_records(const uint8_t* data, const int64_t* rec_off,
   return w;
 }
 
-int hbam_abi_version() { return 2; }
+int hbam_abi_version() { return 3; }
 
 }  // extern "C"

@@ -83,12 +83,23 @@ def sort_bam(
     max_attempts: int = 3,
     part_dir: Optional[str] = None,
     write_workers: Optional[int] = None,
+    backend: str = "device",
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
+
+    ``backend``: "device" (single-chip sort with host↔device transfers
+    overlapped against split reads and part writes), or "host" (NumPy
+    argsort oracle — the samtools-class single-core baseline, also the
+    CPU-only fallback).  A ``mesh``/``distributed`` argument overrides
+    ``backend`` with the multi-chip all_to_all shuffle sort.
 
     ``hadoopbam.bam.write-splitting-bai`` in ``conf`` enables the per-part
     splitting index like the kwarg does (the reference's config-driven
     WRITE_SPLITTING_BAI, BAMOutputFormat.java)."""
+    if backend not in ("device", "host"):
+        raise ValueError(
+            f"backend must be 'device' or 'host', got {backend!r}"
+        )
     if isinstance(in_paths, str):
         in_paths = [in_paths]
     fmt = BamInputFormat(conf)
@@ -99,8 +110,26 @@ def sort_bam(
     header = read_header(in_paths[0]).with_sort_order("coordinate")
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
+
+    use_device = (
+        backend == "device" and distributed is None and mesh is None
+    )
+    batches: List[RecordBatch] = []
+    dev_hi: List = []
+    dev_lo: List = []
     with span("sort_bam.read"):
-        batches: List[RecordBatch] = [fmt.read_split(s) for s in splits]
+        from .ops.keys import split_keys_np
+
+        for s in splits:
+            b = fmt.read_split(s)
+            batches.append(b)
+            if use_device:
+                # Dispatch this split's key columns to the device NOW —
+                # the transfer rides under the next split's host-side
+                # inflate+decode instead of serializing after the read.
+                hi_i, lo_i = split_keys_np(b.keys)
+                dev_hi.append(jnp.asarray(hi_i))
+                dev_lo.append(jnp.asarray(lo_i))
     all_keys = (
         np.concatenate([b.keys for b in batches])
         if batches
@@ -110,6 +139,7 @@ def sort_bam(
     METRICS.count("sort_bam.records", n)
     METRICS.count("sort_bam.splits", len(splits))
 
+    perm_chunks = None  # device path: per-part async-fetched perm slices
     if distributed is not None or mesh is not None:
         ds = distributed
         if ds is None:
@@ -126,14 +156,19 @@ def sort_bam(
                     ds.mesh, ds.rows, capacity_per_pair=ds.rows
                 )
                 _, perm, _ = ds.sort_global(all_keys)
-    else:
+    elif use_device and n:
         backend = "single-device"
-        from .ops.keys import split_keys_np
-
         with span("sort_bam.device_sort"):
-            hi, lo = split_keys_np(all_keys)
-            _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
-            perm = np.asarray(perm)
+            hi = dev_hi[0] if len(dev_hi) == 1 else jnp.concatenate(dev_hi)
+            lo = dev_lo[0] if len(dev_lo) == 1 else jnp.concatenate(dev_lo)
+            dev_hi.clear()  # release the per-split duplicates of the key
+            dev_lo.clear()  # columns so HBM holds one copy, not two
+            _, _, perm_dev = sort_keys(hi, lo)
+            perm = perm_dev  # sliced per part below; fetched lazily
+    else:
+        backend = "host"
+        with span("sort_bam.host_sort"):
+            perm = np.argsort(all_keys, kind="stable")
 
     # Concatenate batches into one global batch view, then write permuted
     # parts with the vectorized gather + batched native deflate.
@@ -161,10 +196,21 @@ def sort_bam(
             1, (os.cpu_count() or 4) // executor.max_workers
         )
         n_parts = max(1, len(batches))
-        bounds = [len(perm) * i // n_parts for i in range(n_parts + 1)]
+        bounds = [n * i // n_parts for i in range(n_parts + 1)]
+        if perm_chunks is None and not isinstance(perm, np.ndarray):
+            # Device permutation: slice per part and start all host copies
+            # now — part pi's download overlaps parts 0..pi-1's deflate.
+            perm_chunks = [
+                perm[bounds[i] : bounds[i + 1]] for i in range(n_parts)
+            ]
+            for c in perm_chunks:
+                c.copy_to_host_async()
 
         def write_one(pi: int, tmp: str) -> None:
-            order = perm[bounds[pi] : bounds[pi + 1]]
+            if perm_chunks is not None:
+                order = np.asarray(perm_chunks[pi])
+            else:
+                order = perm[bounds[pi] : bounds[pi + 1]]
             sb_stream = None
             try:
                 if write_splitting_bai:

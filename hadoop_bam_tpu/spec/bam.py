@@ -473,7 +473,11 @@ def soa_decode(data: bytes, offsets: np.ndarray) -> dict:
     block_size-word offsets.  Variable-length tails stay in ``data`` (the
     ragged sideband), addressed by ``rec_off``/``rec_len``.
     """
-    a = np.frombuffer(data, dtype=np.uint8)
+    a = (
+        data
+        if isinstance(data, np.ndarray)
+        else np.frombuffer(data, dtype=np.uint8)
+    )
     offs = offsets.astype(np.int64)
 
     def u32(at: np.ndarray) -> np.ndarray:
@@ -530,7 +534,10 @@ def soa_keys(soa: dict, data: bytes) -> np.ndarray:
         for i in idx:
             off = int(soa["rec_off"][i])
             ln = int(soa["rec_len"][i])
-            h = murmurhash3_bytes(data[off + 32 : off + ln], 0)
+            blob = data[off + 32 : off + ln]
+            if isinstance(blob, np.ndarray):
+                blob = blob.tobytes()
+            h = murmurhash3_bytes(blob, 0)
             h32 = h & 0xFFFFFFFF
             h32s = h32 - (1 << 32) if h32 >= 1 << 31 else h32
             keys[i] = key0(INT_MAX, h32s)
