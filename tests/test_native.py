@@ -65,6 +65,41 @@ def test_record_chain_matches_oracle(reference_resources):
         native.record_chain(data, p + 1)
 
 
+def test_record_chain_partial_truncated_tail(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    data = native.decompress_all(raw)
+    _, p = bam.BamHeader.decode(data.tobytes())
+    full = native.record_chain(data, p)
+    # Full window: same chain, resume lands exactly at the end.
+    offs, resume = native.record_chain_partial(data, p)
+    assert np.array_equal(offs, full) and resume == len(data)
+    # Cut mid-record: the truncated record is excluded and resume points
+    # at its size word so the walk can continue after a spill.
+    cut = int(full[10]) + 7
+    offs2, resume2 = native.record_chain_partial(data, p, cut)
+    assert np.array_equal(offs2, full[:10]) and resume2 == full[10]
+    # Cut leaving <4 bytes: no size word readable, same contract.
+    cut3 = int(full[5]) + 3
+    offs3, resume3 = native.record_chain_partial(data, p, cut3)
+    assert np.array_equal(offs3, full[:5]) and resume3 == full[5]
+
+
+def test_record_chain_partial_python_fallback_parity(reference_resources):
+    raw = (reference_resources / "test.bam").read_bytes()
+    data = native.decompress_all(raw)
+    _, p = bam.BamHeader.decode(data.tobytes())
+    cut = int(native.record_chain(data, p)[20]) + 1
+    offs_c, res_c = native.record_chain_partial(data, p, cut)
+    # Force the pure-Python path by simulating a failed native load.
+    saved_lib, saved_err = native._lib, native._load_failed
+    try:
+        native._lib, native._load_failed = None, "forced"
+        offs_py, res_py = native.record_chain_partial(data, p, cut)
+    finally:
+        native._lib, native._load_failed = saved_lib, saved_err
+    assert np.array_equal(offs_c, offs_py) and res_c == res_py
+
+
 def test_find_next_block_guessing():
     payload = os.urandom(150_000)
     blob = _bgzf_bytes(payload, level=1)
