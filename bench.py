@@ -111,7 +111,7 @@ def run_sort(src: str, out: str, backend: str) -> float:
     return time.time() - t0
 
 
-def main() -> None:
+def _measure(platform: str) -> dict:
     tmp = tempfile.mkdtemp(prefix="hbam_bench_")
     src = os.path.join(tmp, "bench.bam")
     synth_bam(src, N_RECORDS)
@@ -141,17 +141,100 @@ def main() -> None:
     ), "device sort wrong"
 
     reads_per_sec = N_RECORDS / t_device
-    print(
-        json.dumps(
-            {
-                "metric": "bam_sort_reads_per_sec",
-                "value": round(reads_per_sec),
-                "unit": "reads/s",
-                "vs_baseline": round(t_host / t_device, 3),
-            }
-        )
-    )
+    return {
+        "metric": "bam_sort_reads_per_sec",
+        "value": round(reads_per_sec),
+        "unit": "reads/s",
+        "vs_baseline": round(t_host / t_device, 3),
+        "platform": platform,
+        "n_records": N_RECORDS,
+    }
+
+
+def _child(platform: str) -> None:
+    """Measurement process: pin the platform, run, print ONE JSON line."""
+    if platform == "cpu":
+        from hadoop_bam_tpu.utils import backend as _backend
+
+        _backend.force_cpu()
+    print(json.dumps(_measure(platform)), flush=True)
+
+
+def main() -> None:
+    """Watchdog harness (VERDICT r1 weak #1): always prints one JSON line.
+
+    Probes the ambient backend in a killable subprocess, runs the
+    measurement in a second subprocess under a wall-clock timeout, and falls
+    back to a CPU measurement (with an explicit ``error`` field) if the
+    device path fails or wedges. Never exits nonzero, never hangs.
+    """
+    import subprocess
+
+    from hadoop_bam_tpu.utils import backend as _backend
+
+    want = os.environ.get("HBAM_BENCH_PLATFORM", "auto")
+    probe_timeout = float(os.environ.get("HBAM_BENCH_PROBE_TIMEOUT", "300"))
+    run_timeout = float(os.environ.get("HBAM_BENCH_TIMEOUT", "3000"))
+    error = None
+
+    if want == "auto":
+        platform = _backend.probe_platform(timeout_s=probe_timeout)
+        if platform is None:
+            error = (
+                "ambient backend init failed or timed out after "
+                f"{probe_timeout:.0f}s; falling back to CPU"
+            )
+            platform = "cpu"
+    else:
+        platform = want
+
+    def run_child(plat: str):
+        env = dict(os.environ)
+        if plat == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        else:
+            # Match the probe's view (probe_platform drops JAX_PLATFORMS):
+            # otherwise an exported JAX_PLATFORMS=cpu would make the child
+            # measure CPU while the JSON reports the probed accelerator.
+            env.pop("JAX_PLATFORMS", None)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", plat],
+                capture_output=True,
+                text=True,
+                timeout=run_timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return None, f"measurement timed out after {run_timeout:.0f}s"
+        if res.returncode != 0:
+            tail = (res.stderr or "").strip().splitlines()[-3:]
+            return None, f"rc={res.returncode}: " + " | ".join(tail)
+        for line in reversed(res.stdout.splitlines()):
+            if line.startswith("{"):
+                return json.loads(line), None
+        return None, "child produced no JSON line"
+
+    result, err = run_child(platform)
+    if result is None and platform != "cpu":
+        error = f"{platform} run failed ({err}); CPU fallback"
+        result, err = run_child("cpu")
+    if result is None:
+        result = {
+            "metric": "bam_sort_reads_per_sec",
+            "value": 0,
+            "unit": "reads/s",
+            "vs_baseline": 0.0,
+            "platform": platform,
+        }
+        error = (error + "; " if error else "") + (err or "unknown failure")
+    if error:
+        result["error"] = error
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        main()

@@ -461,7 +461,12 @@ def read_virtual_range(
         )
 
     out, offs = inflate(co_l, cs_l, us_l)
-    payload = out  # np.uint8 — stays zero-copy unless spill blocks extend it
+    # ``buf[:plen]`` is the live payload.  The no-spill fast path keeps the
+    # native output zero-copy; spills grow the buffer geometrically so a
+    # tail record spanning K blocks costs O(window + spill) amortized, not
+    # O(K·window) (ADVICE r1: per-block whole-array concat was quadratic).
+    buf = out
+    plen = len(out)
     # Per-block tables, extended in place when spill blocks are pulled in.
     uoffs_l: List[int] = [int(x) for x in offs[:-1]]  # payload offsets
     voffs_l: List[int] = list(co_l)  # compressed offsets
@@ -473,7 +478,7 @@ def read_virtual_range(
         raise bgzf.BgzfError("vstart uoffset beyond block payload")
 
     def spill_one() -> bool:
-        nonlocal spill_pos, payload
+        nonlocal spill_pos, buf, plen
         if spill_pos >= file_end:
             return False
         csize, usize = bgzf.read_block_at(data, spill_pos)
@@ -483,10 +488,17 @@ def read_virtual_range(
             np.asarray([csize], dtype=np.int32),
             np.asarray([usize], dtype=np.int32),
         )
-        uoffs_l.append(len(payload))
+        if plen + usize > len(buf):
+            grown = np.empty(
+                max(2 * len(buf), plen + usize), dtype=np.uint8
+            )
+            grown[:plen] = buf[:plen]
+            buf = grown
+        buf[plen : plen + usize] = sp_out
+        uoffs_l.append(plen)
         voffs_l.append(spill_pos)
         usize_l.append(usize)
-        payload = np.concatenate([payload, sp_out])
+        plen += usize
         spill_pos += csize
         return True
 
@@ -510,7 +522,7 @@ def read_virtual_range(
     rec_parts: List[np.ndarray] = []
     p = uoffs_l[0] + up0 if uoffs_l else 0
     while True:
-        offs, resume = native.record_chain_partial(payload, p, len(payload))
+        offs, resume = native.record_chain_partial(buf[:plen], p, plen)
         if vend_off is not None:
             k = int(np.searchsorted(offs, vend_off, side="left"))
         else:
@@ -520,7 +532,7 @@ def read_virtual_range(
             break  # saw a record at/after vend: done
         if vend_off is not None and resume >= vend_off:
             break
-        if resume + 4 <= len(payload):
+        if resume + 4 <= plen:
             # chain stopped on a truncated body inside the window
             if not spill_one():
                 raise bam.BamError("truncated record at end of file")
@@ -532,13 +544,13 @@ def read_virtual_range(
             break
         p = resume
 
-    arr = payload
+    arr = buf[:plen]
     offsets = (
         np.concatenate(rec_parts)
         if rec_parts
         else np.empty(0, dtype=np.int64)
     )
-    soa = bam.soa_decode(payload, offsets) if len(offsets) else _empty_soa()
+    soa = bam.soa_decode(arr, offsets) if len(offsets) else _empty_soa()
     if interval_chunks is not None and len(offsets):
         keep = _voffset_mask(
             offsets,
@@ -549,12 +561,12 @@ def read_virtual_range(
         )
         soa = {k: v[keep] for k, v in soa.items()}
     keys = (
-        bam.soa_keys(soa, payload)
+        bam.soa_keys(soa, arr)
         if with_keys and len(soa["refid"])
         else np.empty(0, dtype=np.int64)
     )
     METRICS.count("bam.blocks_inflated", len(voffs_l))
-    METRICS.count("bam.bytes_inflated", len(payload))
+    METRICS.count("bam.bytes_inflated", plen)
     METRICS.count("bam.records_decoded", len(offsets))
     if interval_chunks is not None:
         METRICS.count("bam.records_kept", len(soa["refid"]))

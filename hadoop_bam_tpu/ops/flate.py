@@ -364,7 +364,11 @@ def inflate_fixed(
     # while doubling the jump map — jump composition along a chain is
     # additive, so bits can be applied in any order.  The terminal EOB is
     # a self-loop, so slots past the end of the chain stall there (emit 0).
-    T = out_bytes + 64  # ≥ emitting tokens (≤ out_bytes) + EOBs + slack
+    # Slot budget: every emitting token produces ≥1 byte (≤ out_bytes of
+    # them) and every extra block costs ≥10 bits of stream (3-bit header +
+    # 7-bit EOB), so the EOB count is bounded by NB//10 — no fixed 64-block
+    # cap (ADVICE r1: many tiny blocks previously overflowed the walk).
+    T = out_bytes + NB // 10 + 8
     t = jnp.arange(T, dtype=jnp.int32)
     cur = jnp.full((B, T), 3, dtype=jnp.int32)
     jump = nxt
@@ -559,12 +563,21 @@ def bgzf_decompress_device(
     co, cs, us = native.scan_blocks(raw)
     nblk = len(co)
     outs: List[Optional[bytes]] = [None] * nblk
+    # Per-member XLEN (u16 at header offset 10): BGZF requires the BC
+    # subfield but permits additional extra subfields, so the DEFLATE
+    # payload starts at co+12+xlen, not a hardcoded co+18 (ADVICE r1).
+    xlen = np.empty(nblk, dtype=np.int32)
+    for i in range(nblk):
+        o = int(co[i])
+        xlen[i] = int(raw[o + 10]) | (int(raw[o + 11]) << 8)
     groups: dict = {"stored": [], "fixed": [], "host": []}
     for i in range(nblk):
-        if us[i] == 0 and cs[i] <= 28:
+        # Empty member (e.g. the 28-byte EOF terminator): an empty DEFLATE
+        # payload is ≤2 bytes, so cs ≤ 22+xlen — short-circuit, no kernel.
+        if us[i] == 0 and cs[i] <= 22 + xlen[i]:
             outs[i] = b""
             continue
-        first = int(raw[int(co[i]) + 12 + 6])  # after header+BC subfield
+        first = int(raw[int(co[i]) + 12 + int(xlen[i])])  # first payload byte
         hdr3 = first & 7
         if hdr3 in (0, 1):  # stored, possibly a non-final chain (zlib lvl 0)
             groups["stored"].append(i)
@@ -589,9 +602,11 @@ def bgzf_decompress_device(
         idx = groups[kind]
         if not idx:
             continue
-        # Payload = member bytes between the 18-byte header and 8-byte
-        # footer; bucket the compressed width to bound recompiles.
-        clens = np.asarray([cs[i] - 26 for i in idx], dtype=np.int32)
+        # Payload = member bytes between the (12+xlen)-byte header and
+        # 8-byte footer; bucket the compressed width to bound recompiles.
+        clens = np.asarray(
+            [cs[i] - 20 - xlen[i] for i in idx], dtype=np.int32
+        )
         isz = np.asarray([us[i] for i in idx], dtype=np.int32)
         C = _pow2_at_least(int(clens.max()), 512)
         OUT = _pow2_at_least(int(isz.max()) if len(isz) else 1, 1024)
@@ -607,7 +622,7 @@ def bgzf_decompress_device(
             gz = isz[g0 : g0 + step]
             comp = np.zeros((len(gi), C), dtype=np.uint8)
             for k, i in enumerate(gi):
-                s = int(co[i]) + 18
+                s = int(co[i]) + 12 + int(xlen[i])
                 comp[k, : gc[k]] = raw[s : s + gc[k]]
             out_d, ok = fn(
                 jnp.asarray(comp), jnp.asarray(gc), jnp.asarray(gz), OUT

@@ -1,0 +1,122 @@
+"""JAX backend guards: never hang, never wedge on a broken TPU plugin.
+
+The container environment registers a TPU PJRT plugin ("axon") at interpreter
+start; when the chip or tunnel is wedged, even ``jax.devices()`` blocks
+forever, and the ``JAX_PLATFORMS=cpu`` *environment variable* alone does not
+stop the plugin from initializing.  The only reliable in-process switch is
+``jax.config.update("jax_platforms", "cpu")`` executed before the first
+backend touch.  These helpers centralize that dance for ``bench.py``,
+``__graft_entry__.dryrun_multichip`` and the TPU e2e test:
+
+- :func:`backend_initialized` — has this process already created backends?
+- :func:`force_cpu` — point an *uninitialized* process at the virtual CPU
+  platform with ``n`` host devices.
+- :func:`probe_platform` — discover the default platform in a *subprocess*
+  under a wall-clock watchdog, so a wedged plugin costs a timeout, not a hang.
+
+Reference role: the Hadoop runtime owns executor liveness for Hadoop-BAM
+(task retry; SURVEY §5 "failure detection"); here the framework must defend
+its own entry points.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def backend_initialized() -> bool:
+    """True if this process has already initialized any JAX backend.
+
+    Uses internal API with a conservative fallback: if we cannot tell,
+    assume initialized (callers then fall back to a fresh subprocess, which
+    is always safe).
+    """
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return bool(xla_bridge.backends_are_initialized())
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return True
+
+
+def _merge_host_device_flag(flags: str, n_devices: int) -> str:
+    """Return XLA_FLAGS with ``--xla_force_host_platform_device_count`` set
+    to at least ``n_devices`` (replacing a smaller existing value)."""
+    key = "--xla_force_host_platform_device_count"
+    parts = [p for p in flags.split() if p]
+    out = []
+    current = 0
+    for p in parts:
+        if p.startswith(key + "="):
+            try:
+                current = int(p.split("=", 1)[1])
+            except ValueError:
+                current = 0
+        else:
+            out.append(p)
+    out.append(f"{key}={max(current, n_devices)}")
+    return " ".join(out)
+
+
+def force_cpu(n_devices: Optional[int] = None) -> None:
+    """Point this (not-yet-initialized) process at the CPU platform.
+
+    Must run before the first backend touch; raises if the backend is
+    already up on a different platform.
+    """
+    if n_devices is not None:
+        os.environ["XLA_FLAGS"] = _merge_host_device_flag(
+            os.environ.get("XLA_FLAGS", ""), n_devices
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"  # belt: helps fresh subprocesses
+    import jax
+
+    if backend_initialized():
+        if jax.default_backend() != "cpu":
+            raise RuntimeError(
+                "JAX backend already initialized on "
+                f"{jax.default_backend()!r}; cannot force CPU in-process"
+            )
+        return
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_platform(timeout_s: float = 300.0) -> Optional[str]:
+    """Default-platform discovery in a watchdogged subprocess.
+
+    Returns the platform string (e.g. ``"tpu"``/``"cpu"``) of
+    ``jax.devices()[0]`` under the *ambient* configuration, or ``None`` if
+    initialization failed or timed out (wedged plugin).  The subprocess is
+    killed on timeout, so the caller never hangs.
+    """
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print('PLATFORM=' + d[0].platform)\n"
+    )
+    env = dict(os.environ)
+    # Probe the *default* stack: drop any CPU forcing we may have added.
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    for line in res.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None

@@ -23,6 +23,14 @@ try:
 except Exception:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: exercises the real accelerator in a subprocess "
+        "(skips cleanly when none is reachable)",
+    )
+
+
 REFERENCE_RESOURCES = pathlib.Path("/root/reference/src/test/resources")
 
 
