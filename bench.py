@@ -157,6 +157,18 @@ def _child(platform: str) -> None:
         from hadoop_bam_tpu.utils import backend as _backend
 
         _backend.force_cpu()
+    else:
+        # Refuse to mislabel: if jax quietly fell back to CPU (plugin
+        # missing, forced env), fail here so the parent reports the error
+        # instead of recording a CPU number under an accelerator label.
+        import jax
+
+        actual = jax.devices()[0].platform
+        if actual != platform:
+            raise RuntimeError(
+                f"requested platform {platform!r} but jax initialized "
+                f"{actual!r}"
+            )
     print(json.dumps(_measure(platform)), flush=True)
 
 

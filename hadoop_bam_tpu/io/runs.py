@@ -1,0 +1,209 @@
+"""Sorted spill runs for the bounded-memory (out-of-core) coordinate sort.
+
+The reference never materializes a file: records stream through an iterator
+(BAMRecordReader.java:223-232) and Hadoop's shuffle spills sorted segments
+to local disk before the reduce-side merge.  This module is the TPU build's
+spill layer (SURVEY §7 hard part #3):
+
+- **Run** — one sorted chunk spilled to disk: the raw record stream
+  (size-word + body per record, already in key order) plus two memmappable
+  sidebands, the sorted ``int64`` keys and the ``int64`` record byte
+  offsets.  Slicing a key range out of a run is two ``searchsorted`` calls
+  on the memmapped keys plus one contiguous disk read — no inflate, no
+  record walk.
+- **plan_ranges** — exact global key-range partitioning over a set of
+  sorted runs such that every range's record-byte total fits a budget.
+  Because every run is sorted, range sizes are computed *exactly* (no
+  sampling skew) by binary-searching the 64-bit key space with
+  ``searchsorted`` sums over the memmapped key arrays; a tie bigger than
+  the budget degrades to an in-tie index split that preserves run order
+  (and therefore overall stability).
+
+The merge phase concatenates per-run slices in run order and stable-sorts,
+which reproduces exactly the single-pass stable sort's output order.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import native
+
+RUN_DATA_EXT = ".run"
+RUN_KEYS_EXT = ".run.keys.npy"
+RUN_OFFS_EXT = ".run.offs.npy"
+
+
+def run_paths(directory: str, idx: int) -> Tuple[str, str, str]:
+    base = os.path.join(directory, f"run-{idx:05d}")
+    return base + RUN_DATA_EXT, base + RUN_KEYS_EXT, base + RUN_OFFS_EXT
+
+
+def write_run(
+    directory: str,
+    idx: int,
+    batch,
+    perm: np.ndarray,
+) -> None:
+    """Spill a sorted chunk: permuted raw record stream + key/offset sidebands.
+
+    ``batch`` is a RecordBatch (or anything with ``.data``, ``.keys`` and
+    ``soa['rec_off']/['rec_len']``); ``perm`` is the sort permutation.
+    Writes are atomic (tmp + rename) so a crashed spill never leaves a
+    half-run behind.
+    """
+    data_p, keys_p, offs_p = run_paths(directory, idx)
+    stream = native.gather_records(
+        batch.data, batch.soa["rec_off"], batch.soa["rec_len"], perm
+    )
+    keys_sorted = np.ascontiguousarray(batch.keys[perm], dtype=np.int64)
+    lens = batch.soa["rec_len"].astype(np.int64)[perm] + 4
+    offs = np.empty(len(lens) + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    for path, writer in (
+        (data_p, lambda f: f.write(stream.tobytes())),
+        (keys_p, lambda f: np.save(f, keys_sorted)),
+        (offs_p, lambda f: np.save(f, offs)),
+    ):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            writer(f)
+        os.replace(tmp, path)
+
+
+@dataclass
+class Run:
+    """A spilled sorted run.
+
+    Key/offset sidebands are memmapped (binary searches touch O(log n)
+    pages); the record stream is read with ``pread`` into fresh buffers so
+    spilled bytes never stay mapped into the process — peak RSS tracks the
+    working set, not the spill size.
+    """
+
+    data_path: str
+    keys: np.ndarray  # int64, sorted (memmap)
+    offs: np.ndarray  # int64, len n+1, byte offset of each record (memmap)
+
+    @classmethod
+    def open(cls, directory: str, idx: int) -> "Run":
+        data_p, keys_p, offs_p = run_paths(directory, idx)
+        keys = np.load(keys_p, mmap_mode="r")
+        offs = np.load(offs_p, mmap_mode="r")
+        return cls(data_path=data_p, keys=keys, offs=offs)
+
+    @property
+    def n(self) -> int:
+        return len(self.keys)
+
+    def bytes_between(self, i0: int, i1: int) -> int:
+        return int(self.offs[i1]) - int(self.offs[i0])
+
+    def slice_stream(self, i0: int, i1: int) -> np.ndarray:
+        """Raw bytes of records [i0, i1) — one contiguous pread."""
+        start = int(self.offs[i0])
+        size = int(self.offs[i1]) - start
+        if size == 0:
+            return np.empty(0, dtype=np.uint8)
+        out = np.empty(size, dtype=np.uint8)
+        with open(self.data_path, "rb") as f:
+            f.seek(start)
+            got = f.readinto(memoryview(out))
+        if got != size:
+            raise IOError(
+                f"short read from spill run {self.data_path}: "
+                f"{got} of {size} bytes at {start}"
+            )
+        return out
+
+
+# Per-run (start, stop) record-index cuts defining one key range.
+RangeCut = List[Tuple[int, int]]
+
+
+def plan_ranges(runs: Sequence[Run], budget: int) -> List[RangeCut]:
+    """Partition the union of sorted runs into key ranges of ≤ ``budget``
+    record-stream bytes each (best effort: a single record larger than the
+    budget still forms a 1-record range so progress is guaranteed).
+
+    Ranges are disjoint, cover everything, and are emitted in ascending key
+    order; ties are never reordered across ranges (in-tie splits cut in run
+    order, matching the stable merge's tie order).
+    """
+    R = len(runs)
+    i = [0] * R
+    out: List[RangeCut] = []
+
+    def remaining() -> bool:
+        return any(i[r] < runs[r].n for r in range(R))
+
+    def cut_at_value(v: int) -> List[int]:
+        """Per-run index of the first key > v (take everything ≤ v).
+
+        Clamped to the current position: after an in-tie split, part of a
+        tie is already consumed, and an unclamped searchsorted would point
+        *before* ``i[r]`` (negative byte counts, non-termination).
+        """
+        return [
+            max(
+                i[r],
+                int(np.searchsorted(runs[r].keys, v, side="right")),
+            )
+            for r in range(R)
+        ]
+
+    def nbytes(j: List[int]) -> int:
+        return sum(runs[r].bytes_between(i[r], j[r]) for r in range(R))
+
+    while remaining():
+        lo_v = min(
+            int(runs[r].keys[i[r]]) for r in range(R) if i[r] < runs[r].n
+        )
+        hi_v = max(
+            int(runs[r].keys[runs[r].n - 1])
+            for r in range(R)
+            if i[r] < runs[r].n
+        )
+        if nbytes([runs[r].n for r in range(R)]) <= budget:
+            out.append([(i[r], runs[r].n) for r in range(R)])
+            break
+        # Largest v with bytes(keys ≤ v) ≤ budget, by value bisection.
+        lo, hi = lo_v - 1, hi_v
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if nbytes(cut_at_value(mid)) <= budget:
+                lo = mid
+            else:
+                hi = mid - 1
+        j = cut_at_value(lo)
+        if nbytes(j) == 0:
+            # The single smallest remaining key's tie exceeds the budget:
+            # split inside the tie, consuming runs in order (stability).
+            j = list(i)
+            rem = budget
+            progressed = False
+            for r in range(R):
+                if i[r] >= runs[r].n or int(runs[r].keys[i[r]]) != lo_v:
+                    continue
+                stop = int(
+                    np.searchsorted(runs[r].keys, lo_v, side="right")
+                )
+                k = i[r]
+                while k < stop:
+                    rec = runs[r].bytes_between(k, k + 1)
+                    if rec > rem and progressed:
+                        break
+                    rem -= rec
+                    k += 1
+                    progressed = True
+                j[r] = k
+                if k < stop:
+                    break  # budget exhausted mid-tie in run order
+        out.append([(i[r], j[r]) for r in range(R)])
+        i = j
+    return out

@@ -288,14 +288,21 @@ def _token_tables():
     )
 
 
-@partial(jax.jit, static_argnums=(3,))
+@partial(jax.jit, static_argnums=(3, 4))
 def inflate_fixed(
-    comp: jax.Array, clens: jax.Array, isizes: jax.Array, out_bytes: int
+    comp: jax.Array,
+    clens: jax.Array,
+    isizes: jax.Array,
+    out_bytes: int,
+    max_cbits: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched inflate of all-fixed-Huffman DEFLATE members.
 
     ``comp``: uint8 [B, C]; ``clens``/``isizes``: int32 [B];
-    ``out_bytes``: static output width (≥ max isize).
+    ``out_bytes``: static output width (≥ max isize); ``max_cbits``: static
+    bound on real compressed bits per member (defaults to the padded C*8 —
+    callers pass the batch max so the chain-walk slot budget tracks real
+    stream size, not the pow2 bucket).
     Returns (out uint8 [B, out_bytes], ok bool [B]).
     """
     B, C = comp.shape
@@ -366,9 +373,11 @@ def inflate_fixed(
     # a self-loop, so slots past the end of the chain stall there (emit 0).
     # Slot budget: every emitting token produces ≥1 byte (≤ out_bytes of
     # them) and every extra block costs ≥10 bits of stream (3-bit header +
-    # 7-bit EOB), so the EOB count is bounded by NB//10 — no fixed 64-block
-    # cap (ADVICE r1: many tiny blocks previously overflowed the walk).
-    T = out_bytes + NB // 10 + 8
+    # 7-bit EOB), so the EOB count is bounded by real-bits//10 — no fixed
+    # 64-block cap (ADVICE r1: many tiny blocks previously overflowed the
+    # walk).
+    real_bits = NB if max_cbits is None else min(NB, max_cbits)
+    T = out_bytes + real_bits // 10 + 8
     t = jnp.arange(T, dtype=jnp.int32)
     cur = jnp.full((B, T), 3, dtype=jnp.int32)
     jump = nxt
@@ -566,10 +575,10 @@ def bgzf_decompress_device(
     # Per-member XLEN (u16 at header offset 10): BGZF requires the BC
     # subfield but permits additional extra subfields, so the DEFLATE
     # payload starts at co+12+xlen, not a hardcoded co+18 (ADVICE r1).
-    xlen = np.empty(nblk, dtype=np.int32)
-    for i in range(nblk):
-        o = int(co[i])
-        xlen[i] = int(raw[o + 10]) | (int(raw[o + 11]) << 8)
+    co64 = np.asarray(co, dtype=np.int64)
+    xlen = raw[co64 + 10].astype(np.int32) | (
+        raw[co64 + 11].astype(np.int32) << 8
+    )
     groups: dict = {"stored": [], "fixed": [], "host": []}
     for i in range(nblk):
         # Empty member (e.g. the 28-byte EOF terminator): an empty DEFLATE
@@ -624,9 +633,20 @@ def bgzf_decompress_device(
             for k, i in enumerate(gi):
                 s = int(co[i]) + 12 + int(xlen[i])
                 comp[k, : gc[k]] = raw[s : s + gc[k]]
-            out_d, ok = fn(
-                jnp.asarray(comp), jnp.asarray(gc), jnp.asarray(gz), OUT
-            )
+            if kind == "fixed":
+                # pow2-bucketed like C so distinct jit signatures stay few.
+                cbits = _pow2_at_least(int(gc.max()) * 8, 4096)
+                out_d, ok = fn(
+                    jnp.asarray(comp),
+                    jnp.asarray(gc),
+                    jnp.asarray(gz),
+                    OUT,
+                    cbits,
+                )
+            else:
+                out_d, ok = fn(
+                    jnp.asarray(comp), jnp.asarray(gc), jnp.asarray(gz), OUT
+                )
             out_d = np.asarray(out_d)
             ok = np.asarray(ok)
             for k, i in enumerate(gi):

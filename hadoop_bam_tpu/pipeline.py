@@ -46,6 +46,9 @@ class SortStats:
     n_records: int
     n_splits: int
     backend: str
+    n_runs: int = 0  # out-of-core path: sorted spill runs written
+    n_ranges: int = 0  # out-of-core path: merge key ranges
+    peak_bytes: int = 0  # out-of-core path: largest materialized chunk
 
 
 def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
@@ -84,6 +87,7 @@ def sort_bam(
     part_dir: Optional[str] = None,
     write_workers: Optional[int] = None,
     backend: str = "device",
+    memory_budget: Optional[int] = None,
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
 
@@ -95,7 +99,13 @@ def sort_bam(
 
     ``hadoopbam.bam.write-splitting-bai`` in ``conf`` enables the per-part
     splitting index like the kwarg does (the reference's config-driven
-    WRITE_SPLITTING_BAI, BAMOutputFormat.java)."""
+    WRITE_SPLITTING_BAI, BAMOutputFormat.java).
+
+    ``memory_budget`` (bytes of uncompressed record stream) switches to the
+    bounded-memory out-of-core path: splits stream through sorted spill
+    runs on disk and a key-range merge, so files far larger than host RAM
+    sort with a flat peak (the Hadoop shuffle's spill+merge, SURVEY §7
+    hard part #3).  Not combinable with ``mesh``/``distributed``."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -108,6 +118,32 @@ def sort_bam(
             BAM_WRITE_SPLITTING_BAI
         )
     header = read_header(in_paths[0]).with_sort_order("coordinate")
+    if memory_budget is not None:
+        if mesh is not None or distributed is not None:
+            raise ValueError(
+                "memory_budget is single-host; use the multi-host runner "
+                "for distributed out-of-core sorts"
+            )
+        # A split is the memory floor (it inflates as one batch): keep its
+        # compressed size well under the budget.  BGZF inflation is
+        # typically 3-5x but can exceed 10x on low-entropy data, so clamp
+        # to budget/16 (peak_bytes reports honestly if a pathological
+        # split still overshoots).
+        split_size = max(64 << 10, min(split_size, memory_budget // 16))
+        splits = fmt.get_splits(in_paths, split_size=split_size)
+        return _sort_bam_external(
+            fmt,
+            splits,
+            header,
+            out_path,
+            memory_budget=memory_budget,
+            level=level,
+            backend=backend,
+            write_splitting_bai=write_splitting_bai,
+            max_attempts=max_attempts,
+            part_dir=part_dir,
+            write_workers=write_workers,
+        )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
 
@@ -244,3 +280,195 @@ def sort_bam(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )
     return SortStats(n_records=n, n_splits=len(splits), backend=backend)
+
+
+def _sort_perm(keys: np.ndarray, backend: str) -> np.ndarray:
+    """Stable sort permutation of a key column — on-chip or NumPy oracle."""
+    if backend == "device" and len(keys):
+        from .ops.keys import split_keys_np
+
+        hi, lo = split_keys_np(keys)
+        _, _, perm = sort_keys(jnp.asarray(hi), jnp.asarray(lo))
+        return np.asarray(perm).astype(np.int64)
+    return np.argsort(keys, kind="stable")
+
+
+def _sort_bam_external(
+    fmt: BamInputFormat,
+    splits,
+    header,
+    out_path: str,
+    memory_budget: int,
+    level: int,
+    backend: str,
+    write_splitting_bai: bool,
+    max_attempts: int,
+    part_dir: Optional[str],
+    write_workers: Optional[int],
+) -> SortStats:
+    """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
+
+    Phase 1 streams splits in file order, accumulating decoded batches until
+    the uncompressed budget fills, then sorts the chunk (device or host) and
+    spills it as a :mod:`io.runs` run — raw sorted record stream plus
+    memmappable key/offset sidebands.  Phase 2 partitions the global key
+    space into ranges of ≤ budget bytes (exact, via the sorted sidebands —
+    no sampling skew), loads each range's per-run slices, stable-sorts, and
+    writes one part per range; parts concatenate in key order so the merge
+    is the ordinary header + parts + terminator assembly.
+
+    Peak materialized record bytes ≈ one budget's worth in each phase
+    (reported in ``SortStats.peak_bytes``); everything else stays on disk
+    behind memmaps.  Reference contract: the streaming record iterator
+    (BAMRecordReader.java:223-232) + Hadoop's sort-spill-merge shuffle.
+    """
+    from .io.bam import write_part_fast
+    from .io.runs import Run, plan_ranges, write_run
+
+    with contextlib.ExitStack() as stack:
+        out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
+        if part_dir is not None:
+            td = part_dir
+            os.makedirs(td, exist_ok=True)
+        else:
+            td = stack.enter_context(
+                tempfile.TemporaryDirectory(dir=out_dir)
+            )
+        spill_dir = os.path.join(td, "spill")
+        os.makedirs(spill_dir, exist_ok=True)
+
+        # ---- Phase 1: stream splits → sorted runs ------------------------
+        n = 0
+        peak = 0
+        run_count = 0
+        acc: List[RecordBatch] = []
+        acc_bytes = 0
+
+        def flush() -> None:
+            nonlocal run_count, acc, acc_bytes, peak
+            if not acc:
+                return
+            merged = _concat_batches(acc)
+            peak = max(peak, len(merged.data))
+            perm = _sort_perm(merged.keys, backend)
+            write_run(spill_dir, run_count, merged, perm)
+            run_count += 1
+            acc = []
+            acc_bytes = 0
+
+        with span("sort_bam.spill"):
+            for s in splits:
+                b = fmt.read_split(s)
+                n += b.n_records
+                if acc and acc_bytes + len(b.data) > memory_budget:
+                    flush()
+                acc.append(b)
+                acc_bytes += len(b.data)
+                if acc_bytes >= memory_budget:
+                    flush()
+            flush()
+        METRICS.count("sort_bam.records", n)
+        METRICS.count("sort_bam.splits", len(splits))
+        METRICS.count("sort_bam.runs", run_count)
+
+        # ---- Phase 2: exact key-range merge ------------------------------
+        runs = [Run.open(spill_dir, k) for k in range(run_count)]
+        with span("sort_bam.plan_ranges"):
+            ranges = plan_ranges(runs, memory_budget) if runs else []
+        METRICS.count("sort_bam.ranges", len(ranges))
+
+        # One range in flight at a time: each materializes up to a budget's
+        # worth of record bytes, so any concurrency would multiply the peak
+        # past the contract (write_workers is deliberately not honored
+        # here; deflate threads provide the parallelism instead).
+        executor = ElasticExecutor(
+            td, max_attempts=max_attempts, max_workers=1
+        )
+        deflate_threads = max(
+            1, (os.cpu_count() or 4) // executor.max_workers
+        )
+
+        def write_one(pi: int, tmp: str) -> None:
+            nonlocal peak
+            cuts = ranges[pi]
+            datas: List[np.ndarray] = []
+            keys_l: List[np.ndarray] = []
+            off_l: List[np.ndarray] = []
+            len_l: List[np.ndarray] = []
+            base = 0
+            for r, (i0, i1) in enumerate(cuts):
+                if i1 <= i0:
+                    continue
+                sl = runs[r].slice_stream(i0, i1)
+                offs = np.asarray(
+                    runs[r].offs[i0 : i1 + 1], dtype=np.int64
+                )
+                local = offs - offs[0]
+                off_l.append(base + local[:-1] + 4)  # body starts
+                len_l.append(np.diff(offs) - 4)
+                keys_l.append(
+                    np.asarray(runs[r].keys[i0:i1], dtype=np.int64)
+                )
+                datas.append(sl)
+                base += len(sl)
+            if not datas:
+                data = np.empty(0, np.uint8)
+                keys = np.empty(0, np.int64)
+                soa = {
+                    "rec_off": np.empty(0, np.int64),
+                    "rec_len": np.empty(0, np.int64),
+                }
+            else:
+                data = np.concatenate(datas)
+                keys = np.concatenate(keys_l)
+                soa = {
+                    "rec_off": np.concatenate(off_l),
+                    "rec_len": np.concatenate(len_l),
+                }
+            peak = max(peak, len(data))
+            batch = RecordBatch(soa=soa, data=data, keys=keys)
+            # Slices are each sorted; the stable sort merges them, keeping
+            # run order on ties — identical output to the one-shot sort.
+            perm = _sort_perm(keys, backend)
+            sb_stream = None
+            try:
+                if write_splitting_bai:
+                    sb_stream = open(tmp + ".sb", "wb")
+                with open(tmp, "wb") as f:
+                    write_part_fast(
+                        f,
+                        batch,
+                        order=perm,
+                        level=level,
+                        splitting_bai_stream=sb_stream,
+                        threads=deflate_threads,
+                    )
+            finally:
+                if sb_stream is not None:
+                    sb_stream.close()
+            if write_splitting_bai:
+                os.replace(
+                    tmp + ".sb",
+                    os.path.join(td, f"part-r-{pi:05d}.splitting-bai"),
+                )
+
+        with span("sort_bam.range_merge"):
+            executor.run(list(range(max(1, len(ranges)))), write_one
+                         if ranges else _write_empty_part)
+            merge_bam_parts(
+                td, out_path, header,
+                write_splitting_bai=write_splitting_bai,
+            )
+    return SortStats(
+        n_records=n,
+        n_splits=len(splits),
+        backend=f"external[{backend}]",
+        n_runs=run_count,
+        n_ranges=len(ranges),
+        peak_bytes=peak,
+    )
+
+
+def _write_empty_part(pi: int, tmp: str) -> None:
+    with open(tmp, "wb"):
+        pass
