@@ -1,0 +1,456 @@
+"""Multi-host orchestration: one process per host, SPMD over the global mesh.
+
+The reference's scale-out runtime is the Hadoop cluster — one mapper per
+split on whatever host owns it, record bytes moving through the MapReduce
+shuffle's spill/fetch data plane (pom.xml:296-300 hadoop-client;
+BAMInputFormat.java:216-260 assigns splits, SURVEY §2.7 the shuffle).  The
+TPU-native equivalent here:
+
+- **control plane**: ``jax.distributed.initialize`` (one process per host)
+  — the global device mesh spans every process; split planning is
+  deterministic, so every process plans identically and takes ownership of
+  ``split_idx % num_processes == process_id`` (no coordinator needed).
+- **key plane**: the existing range-partitioned ``all_to_all`` shuffle sort
+  (parallel/shuffle.py) runs unchanged over the *global* mesh — XLA routes
+  the collective over ICI within a host and DCN across hosts.  The shuffle
+  additionally returns each input row's destination device (the sender-side
+  routing table).
+- **byte plane**: ragged record payloads move host-to-host through spill
+  files on the shared filesystem (the moral equivalent of Hadoop's
+  map-output spill + HTTP fetch — and of a GCS-backed shuffle on a TPU
+  pod): each process writes one run of raw records per destination process,
+  sorted by global source row with a memmappable row/offset sidecar;
+  after a global barrier every process fetches and gathers exactly the
+  bytes its devices' key ranges own.
+
+``sort_bam_multihost`` is the end-to-end driver: it produces a part file
+per *global device* and process 0 performs the ordinary header+parts+
+terminator merge, so the output is byte-identical to the single-process
+sort of the same input.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from .. import native
+from ..utils import nio
+from ..utils.tracing import METRICS, span
+from .mesh import DATA_AXIS, make_mesh
+from .shuffle import DistributedSort
+
+
+@dataclass
+class MultihostContext:
+    """Process identity + the global mesh."""
+
+    process_id: int
+    num_processes: int
+    mesh: "jax.sharding.Mesh"
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def global_device_count(self) -> int:
+        return self.mesh.devices.size
+
+    def owned(self, items: Sequence) -> List:
+        """Round-robin ownership — deterministic, planner-free
+        (every process computes the same global plan)."""
+        return [
+            it
+            for k, it in enumerate(items)
+            if k % self.num_processes == self.process_id
+        ]
+
+    def barrier(self, name: str) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+    def allgather_counts(self, n: int) -> np.ndarray:
+        """[num_processes] int64 — one scalar contributed per process."""
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.int64(n))
+        ).reshape(-1)
+
+    def allgather_array(self, a: np.ndarray) -> np.ndarray:
+        """[num_processes, *a.shape] — same-shape array from every process."""
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(multihost_utils.process_allgather(a))
+        return out.reshape((self.num_processes,) + a.shape)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> MultihostContext:
+    """Join (or create) the multi-process JAX runtime and build the global
+    1-D data mesh.
+
+    With no arguments in a single-process setting this degrades to a local
+    mesh over the visible devices — the same code path runs on one host or
+    sixteen.  On CPU the cross-process collectives use the gloo transport;
+    on TPU pods the PJRT plugin provides ICI/DCN natively.
+    """
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return MultihostContext(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        mesh=make_mesh(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The byte plane: shared-filesystem record shuffle.
+# ---------------------------------------------------------------------------
+
+
+def _bytes_file(d: str, src: int, dst: int) -> str:
+    return os.path.join(d, f"shufbytes-s{src:03d}-d{dst:03d}")
+
+
+def _write_byte_runs(
+    shuffle_dir: str,
+    ctx: MultihostContext,
+    batch,
+    dest_dev: np.ndarray,
+    row_of_record: np.ndarray,
+    rows_per_device: int,
+) -> None:
+    """Ship this process's records to their destination processes.
+
+    One file per destination process, containing raw records (size word +
+    body) ascending by *global source row*, plus ``.rows``/``.offs``
+    sidecars so receivers can binary-search any (src_dev, src_row)
+    reference the key shuffle hands them.
+    """
+    L = ctx.local_device_count
+    first_global_dev = ctx.process_id * L
+    # Global row id of each local record (row_of_record is the local slot).
+    g_row = (
+        (first_global_dev + row_of_record // rows_per_device).astype(np.int64)
+        * rows_per_device
+        + (row_of_record % rows_per_device).astype(np.int64)
+    )
+    dest_proc = dest_dev // L
+    lens = batch.soa["rec_len"].astype(np.int64) + 4
+    for q in range(ctx.num_processes):
+        sel = np.nonzero(dest_proc == q)[0]
+        order = sel[np.argsort(g_row[sel], kind="stable")]
+        stream = native.gather_records(
+            batch.data,
+            batch.soa["rec_off"],
+            batch.soa["rec_len"],
+            order,
+        )
+        offs = np.empty(len(order) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens[order], out=offs[1:])
+        base = _bytes_file(shuffle_dir, ctx.process_id, q)
+        for path, payload, rawbytes in (
+            (base + ".bin", stream, True),
+            (base + ".rows", g_row[order], False),
+            (base + ".offs", offs, False),
+        ):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                if rawbytes:
+                    f.write(memoryview(payload))  # no tobytes() copy
+                else:
+                    np.save(f, payload)
+            os.replace(tmp, path)
+
+
+class _ByteFetcher:
+    """Receiver side: resolve (src_dev, src_row) → record bytes across the
+    per-source spill files addressed to this process."""
+
+    def __init__(self, shuffle_dir: str, ctx: MultihostContext,
+                 rows_per_device: int):
+        self.rows = rows_per_device
+        self.ctx = ctx
+        self.rows_tab: List[np.ndarray] = []
+        self.offs_tab: List[np.ndarray] = []
+        bufs: List[np.ndarray] = []
+        for s in range(ctx.num_processes):
+            base = _bytes_file(shuffle_dir, s, ctx.process_id)
+            with open(base + ".bin", "rb") as f:
+                bufs.append(np.frombuffer(f.read(), dtype=np.uint8))
+            self.rows_tab.append(np.load(base + ".rows"))
+            self.offs_tab.append(np.load(base + ".offs"))
+        # One concatenated buffer built once (gather() runs per local
+        # device; re-concatenating there would copy the whole received
+        # shard L times).
+        self.base = np.zeros(ctx.num_processes + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bufs], out=self.base[1:])
+        self.big = (
+            np.concatenate(bufs) if bufs else np.empty(0, np.uint8)
+        )
+        del bufs
+
+    def gather(self, src_dev: np.ndarray, src_row: np.ndarray):
+        """Concatenated raw records for the given (src_dev, src_row) refs,
+        in the given order.  Returns (data uint8, rec_off, rec_len).
+
+        Buffers are concatenated once and the ragged copy is a single
+        ``native.gather_records`` call — no per-record Python loop.
+        """
+        L = self.ctx.local_device_count
+        g = src_dev.astype(np.int64) * self.rows + src_row.astype(np.int64)
+        src_proc = src_dev // L
+        n = len(g)
+        out_len = np.zeros(n, dtype=np.int64)
+        src_off = np.zeros(n, dtype=np.int64)
+        for s in range(self.ctx.num_processes):
+            m = src_proc == s
+            if not m.any():
+                continue
+            idx = np.searchsorted(self.rows_tab[s], g[m])
+            if np.any(idx >= len(self.rows_tab[s])) or np.any(
+                self.rows_tab[s][idx] != g[m]
+            ):
+                raise RuntimeError(
+                    f"byte shuffle missing rows from process {s}"
+                )
+            src_off[m] = self.offs_tab[s][idx] + self.base[s]
+            out_len[m] = self.offs_tab[s][idx + 1] - self.offs_tab[s][idx]
+        data = native.gather_records(
+            self.big, src_off + 4, out_len - 4, order=None
+        )
+        out_off = np.empty(n + 1, dtype=np.int64)
+        out_off[0] = 0
+        np.cumsum(out_len, out=out_off[1:])
+        return data, out_off[:-1] + 4, out_len - 4
+
+
+# ---------------------------------------------------------------------------
+# End-to-end multi-host coordinate sort.
+# ---------------------------------------------------------------------------
+
+
+def sort_bam_multihost(
+    in_paths: Sequence[str] | str,
+    out_path: str,
+    ctx: Optional[MultihostContext] = None,
+    conf=None,
+    split_size: int = 32 << 20,
+    level: int = 6,
+    samples_per_device: int = 64,
+) -> int:
+    """Coordinate-sort BAM(s) across every process of the JAX runtime.
+
+    All paths (input, output, and the shuffle directory derived from the
+    output path) must be on a filesystem visible to every process — the
+    same contract HDFS gives the reference.  Returns the global record
+    count (identical on every process); the merged output is written by
+    process 0.
+    """
+    from ..io.bam import BamInputFormat, read_header, write_part_fast
+    from ..io.merger import merge_bam_parts
+    from ..ops.keys import split_keys_np
+    from ..pipeline import RecordBatch, _concat_batches
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if isinstance(in_paths, str):
+        in_paths = [in_paths]
+    if ctx is None:
+        ctx = initialize()
+    fmt = BamInputFormat(conf)
+    header = read_header(in_paths[0]).with_sort_order("coordinate")
+    with span("mh.plan"):
+        splits = fmt.get_splits(in_paths, split_size=split_size)
+    mine = ctx.owned(splits)
+
+    with span("mh.read"):
+        batches = [fmt.read_split(s) for s in mine]
+        own_counts = [b.n_records for b in batches]
+        local = _concat_batches(batches)
+        del batches
+    n_local = local.n_records
+
+    # Global record ordinals: allgather per-split record counts (padded to
+    # the round-robin width) so every process derives the same exclusive
+    # scan over splits in plan order.  Ordinals are the shuffle's
+    # tie-breaker — output tie order matches the single-process stable
+    # sort's exactly.
+    P_ = ctx.num_processes
+    max_owned = max(1, -(-len(splits) // P_))
+    cm = np.zeros(max_owned, dtype=np.int64)
+    cm[: len(own_counts)] = own_counts
+    M = ctx.allgather_array(cm)  # [P, max_owned]
+    counts_by_split = np.zeros(max(1, len(splits)), dtype=np.int64)
+    for k in range(len(splits)):
+        counts_by_split[k] = M[k % P_][k // P_]
+    split_base = np.concatenate(
+        [[0], np.cumsum(counts_by_split)]
+    ).astype(np.int64)
+    n_total = int(split_base[len(splits)])
+    if n_total >= (1 << 31):
+        raise ValueError(
+            "record ordinals exceed int32; shard the input further"
+        )
+    orig_local = (
+        np.concatenate(
+            [
+                split_base[ctx.process_id + j * P_] + np.arange(c)
+                for j, c in enumerate(own_counts)
+            ]
+        ).astype(np.int32)
+        if own_counts
+        else np.empty(0, np.int32)
+    )
+
+    counts = M.sum(axis=1)
+    L = ctx.local_device_count
+    D = ctx.global_device_count
+    rows = max(1, -(-int(counts.max()) // L))
+
+    # Place local records into local device slots.  A deterministic
+    # per-process permutation spreads any key-ordered input across slots so
+    # no (src,dst) capacity bucket is hit by a monotone run.
+    rng = np.random.default_rng(0x5EED + ctx.process_id)
+    slots = rng.permutation(L * rows)[:n_local]
+    hi_l = np.full(L * rows, 0x7FFFFFFF, np.int32)
+    lo_l = np.full(L * rows, 0xFFFFFFFF, np.uint32)
+    val_l = np.zeros(L * rows, dtype=bool)
+    org_l = np.full(L * rows, 0x7FFFFFFF, np.int32)
+    k_hi, k_lo = split_keys_np(local.keys)
+    hi_l[slots] = k_hi
+    lo_l[slots] = k_lo
+    val_l[slots] = True
+    org_l[slots] = orig_local
+    # record index -> its local slot (for the byte plane)
+    row_of_record = slots.astype(np.int64)
+
+    sharding = NamedSharding(ctx.mesh, P(DATA_AXIS))
+
+    def gshard(arr):
+        return jax.make_array_from_process_local_data(
+            sharding, arr, (D * rows,) + arr.shape[1:]
+        )
+
+    overflow = -1
+    cap = None
+    with span("mh.key_shuffle"):
+        while True:
+            ds = DistributedSort(
+                ctx.mesh,
+                rows_per_device=rows,
+                capacity_per_pair=cap,
+                samples_per_device=samples_per_device,
+            )
+            res = ds(
+                gshard(hi_l), gshard(lo_l), gshard(val_l), gshard(org_l)
+            )
+            overflow = int(res.overflow)
+            if overflow == 0:
+                break
+            if cap == rows:
+                raise RuntimeError(
+                    "shuffle overflow even at full capacity"
+                )
+            cap = min(rows, ds.capacity * 2)
+    METRICS.count("mh.records", n_total)
+
+    # Sender-side routing table: destination device of each local record.
+    # Addressable-shard order is not guaranteed — order by global offset.
+    def _local_view(arr, per_shard: int) -> List[np.ndarray]:
+        got = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        views = [np.asarray(s.data) for s in got]
+        assert all(len(v) == per_shard for v in views), "shard shape drift"
+        return views
+
+    # The byte plane labels global rows as pid*L*rows + slot, which is
+    # only correct if this process's devices occupy the contiguous mesh
+    # range [pid*L, (pid+1)*L).  True for the default jax.devices()
+    # ordering; verify rather than assume (a reordered mesh would
+    # otherwise silently swap record bytes between processes).
+    starts = sorted(
+        (s.index[0].start or 0) for s in res.dest.addressable_shards
+    )
+    expect = [(ctx.process_id * L + k) * rows for k in range(L)]
+    if starts != expect:
+        raise RuntimeError(
+            "process devices are not mesh-contiguous: shard starts "
+            f"{starts} != {expect}; build the mesh from jax.devices() "
+            "order (parallel.mesh.make_mesh)"
+        )
+
+    dest_l = np.concatenate(_local_view(res.dest, rows))
+    dest_of_record = dest_l[row_of_record]
+
+    out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
+    td = os.path.join(
+        out_dir, f"_mh_{os.path.basename(out_path)}.parts"
+    )
+    shuffle_dir = os.path.join(td, "shuffle")
+    if ctx.process_id == 0:
+        os.makedirs(shuffle_dir, exist_ok=True)
+    ctx.barrier("mkdirs")
+    os.makedirs(shuffle_dir, exist_ok=True)
+
+    with span("mh.byte_shuffle.write"):
+        _write_byte_runs(
+            shuffle_dir, ctx, local, dest_of_record, row_of_record, rows
+        )
+    # The input shard is on disk in destination-keyed runs now; release it
+    # so fetch-side peak is ~received-shard, not input+received.
+    del local, dest_of_record, row_of_record, dest_l
+    ctx.barrier("byte_shuffle_written")
+
+    # Receiver: each local device's sorted rows → one part file each.
+    with span("mh.byte_shuffle.fetch"):
+        fetcher = _ByteFetcher(shuffle_dir, ctx, rows)
+        cap_rows = res.hi.shape[0] // D
+        v_sh = _local_view(res.valid, cap_rows)
+        sd_sh = _local_view(res.src_dev, cap_rows)
+        sr_sh = _local_view(res.src_row, cap_rows)
+        # Which global devices do this process's shards correspond to?
+        g_devs = sorted(
+            (s.index[0].start or 0) // cap_rows
+            for s in res.valid.addressable_shards
+        )
+        for k, g_dev in enumerate(g_devs):
+            v = v_sh[k]
+            sd = sd_sh[k][v]
+            sr = sr_sh[k][v]
+            data, rec_off, rec_len = fetcher.gather(sd, sr)
+            keys = np.zeros(len(sd), dtype=np.int64)  # unused by writer
+            batch = RecordBatch(
+                soa={"rec_off": rec_off, "rec_len": rec_len},
+                data=data,
+                keys=keys,
+            )
+            tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
+            with open(tmp, "wb") as f:
+                write_part_fast(f, batch, order=None, level=level)
+            os.replace(tmp, os.path.join(td, f"part-r-{g_dev:05d}"))
+    ctx.barrier("parts_written")
+
+    if ctx.process_id == 0:
+        with span("mh.merge"):
+            nio.write_success(td)
+            merge_bam_parts(td, out_path, header)
+            nio.delete_recursive(td)
+    ctx.barrier("merged")
+    return n_total

@@ -54,6 +54,9 @@ class ShuffleResult(NamedTuple):
     src_dev: jax.Array  # int32[D*C]
     src_row: jax.Array  # int32[D*C]
     overflow: jax.Array  # int32[] — rows that did not fit (must be 0)
+    dest: jax.Array  # int32[D*rows] — destination device of each INPUT row
+    # (the sender-side routing table: what the multi-host byte shuffle
+    # needs to ship record payloads to their owners)
 
 
 class DistributedSort:
@@ -84,8 +87,12 @@ class DistributedSort:
         rows, cap, S = self.rows, self.capacity, self.samples
         axis = DATA_AXIS
 
-        def local(hi, lo, valid):
-            # [rows] per device.
+        def local(hi, lo, valid, orig):
+            # [rows] per device.  ``orig`` is the caller's global input
+            # ordinal — the tie-breaking third sort key, so equal keys come
+            # out in input order exactly like a stable single-chip sort
+            # (the reference's shuffle has the same property: Hadoop's
+            # merge-sort is stable in (key, input) order).
             dev = lax.axis_index(axis).astype(jnp.int32)
 
             # 1. local sort (invalid rows sink) + sample election.  Samples
@@ -143,6 +150,7 @@ class DistributedSort:
             b_val = scatter(valid, False)
             b_dev = scatter(jnp.full((rows,), 0, jnp.int32) + dev, -1)
             b_row = scatter(jnp.arange(rows, dtype=jnp.int32), -1)
+            b_org = scatter(orig, jnp.int32(0x7FFFFFFF))
 
             # 4. the shuffle data plane.
             def exchange(b):
@@ -155,21 +163,24 @@ class DistributedSort:
             r_val = exchange(b_val)
             r_dev = exchange(b_dev)
             r_row = exchange(b_row)
+            r_org = exchange(b_org)
 
-            # 5. local sort of the received rows.
+            # 5. local sort of the received rows; ``orig`` is the third
+            # key, so tie order equals input order deterministically.
             r_inv = (~r_val).astype(jnp.uint8)
-            _, s_hi, s_lo, s_val, s_dev, s_row = lax.sort(
-                (r_inv, r_hi, r_lo, r_val, r_dev, r_row), num_keys=3
+            _, s_hi, s_lo, _, s_val, s_dev, s_row = lax.sort(
+                (r_inv, r_hi, r_lo, r_org, r_val, r_dev, r_row), num_keys=4
             )
             total_overflow = lax.psum(overflow, axis)
-            return s_hi, s_lo, s_val, s_dev, s_row, total_overflow
+            dest_out = jnp.where(valid, dest, -1)
+            return s_hi, s_lo, s_val, s_dev, s_row, total_overflow, dest_out
 
         spec = P(DATA_AXIS)
         fn = shard_map(
             local,
             mesh=self.mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=(spec, spec, spec, spec, spec, P()),
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec, spec, spec, spec, P(), spec),
         )
         return jax.jit(fn)
 
@@ -179,11 +190,24 @@ class DistributedSort:
         return NamedSharding(self.mesh, P(DATA_AXIS))
 
     def __call__(
-        self, hi: jax.Array, lo: jax.Array, valid: jax.Array
+        self,
+        hi: jax.Array,
+        lo: jax.Array,
+        valid: jax.Array,
+        orig: Optional[jax.Array] = None,
     ) -> ShuffleResult:
-        """Inputs are [D*rows] arrays (sharded or host-resident)."""
-        s_hi, s_lo, s_val, s_dev, s_row, ovf = self._step(hi, lo, valid)
-        return ShuffleResult(s_hi, s_lo, s_val, s_dev, s_row, ovf)
+        """Inputs are [D*rows] arrays (sharded or host-resident).
+
+        ``orig`` (int32 global input ordinals) makes tie order
+        deterministic (input order); omitted → arbitrary tie order."""
+        if orig is None:
+            orig = jnp.zeros(hi.shape, jnp.int32)
+            if hasattr(hi, "sharding"):
+                orig = jax.device_put(orig, hi.sharding)
+        s_hi, s_lo, s_val, s_dev, s_row, ovf, dest = self._step(
+            hi, lo, valid, orig
+        )
+        return ShuffleResult(s_hi, s_lo, s_val, s_dev, s_row, ovf, dest)
 
     def sort_global(
         self,
@@ -212,7 +236,12 @@ class DistributedSort:
         inv = np.empty(total, dtype=np.int64)
         inv[scatter] = np.arange(total)
         hi, lo = split_keys_np(pad_keys)
-        res = self(jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(v))
+        res = self(
+            jnp.asarray(hi),
+            jnp.asarray(lo),
+            jnp.asarray(v),
+            jnp.asarray(inv.astype(np.int32)),
+        )
         if int(res.overflow) > 0:
             raise RuntimeError(
                 f"shuffle capacity exceeded by {int(res.overflow)} rows; "

@@ -1,0 +1,124 @@
+"""Multi-host orchestration tests (VERDICT r1 item 4).
+
+The acceptance bar: a 2-process CPU "multihost" run (real
+``jax.distributed`` runtime, gloo collectives, 4 virtual devices per
+process) produces a sorted BAM *byte-identical* to the single-process
+sort of the same input.
+
+The in-process single-host path of the same driver is also exercised
+directly on the 8-device test mesh (one process, eight devices — the same
+SPMD program).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from bench import synth_bam  # noqa: E402
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; out = sys.argv[5]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.parallel import multihost
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+n = multihost.sort_bam_multihost([src], out, ctx=ctx,
+                                 split_size=1 << 20, level=1)
+print(f"MH_OK pid={{pid}} n={{n}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def bam_80k(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("mh") / "in.bam")
+    synth_bam(p, 80_000)
+    return p
+
+
+def test_single_process_multidevice_driver(bam_80k, tmp_path):
+    """Same driver, one process, the 8-device test mesh."""
+    from hadoop_bam_tpu.parallel import multihost
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    out_mh = str(tmp_path / "mh.bam")
+    out_ref = str(tmp_path / "ref.bam")
+    ctx = multihost.initialize()
+    assert ctx.num_processes == 1 and ctx.global_device_count == 8
+    n = multihost.sort_bam_multihost(
+        [bam_80k], out_mh, ctx=ctx, split_size=1 << 20, level=1
+    )
+    assert n == 80_000
+    sort_bam([bam_80k], out_ref, level=1, backend="host", split_size=1 << 20)
+    from hadoop_bam_tpu import native
+
+    d1 = native.decompress_all(open(out_mh, "rb").read())
+    d2 = native.decompress_all(open(out_ref, "rb").read())
+    assert np.array_equal(d1, d2), "record stream differs from oracle"
+
+
+def test_two_process_multihost_byte_identical(bam_80k, tmp_path):
+    """Two real OS processes, jax.distributed + gloo, shared tmp dir."""
+    out = str(tmp_path / "mh2.bam")
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    worker = _WORKER.format(repo=REPO)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                worker,
+                str(pid),
+                "2",
+                str(port),
+                bam_80k,
+                out,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"MH_OK pid={pid} n=80000" in o, o[-2000:]
+
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu import native
+
+    out_ref = str(tmp_path / "ref.bam")
+    sort_bam([bam_80k], out_ref, level=1, backend="host", split_size=1 << 20)
+    d1 = native.decompress_all(open(out, "rb").read())
+    d2 = native.decompress_all(open(out_ref, "rb").read())
+    assert np.array_equal(d1, d2), "2-process output differs from oracle"
