@@ -17,6 +17,8 @@ from __future__ import annotations
 import re
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from ..conf import (
     Configuration,
     FASTQ_BASE_QUALITY_ENCODING,
@@ -26,6 +28,10 @@ from ..conf import (
     INPUT_FILTER_FAILED_QC,
 )
 from ..spec.fragment import (
+    ILLUMINA_MAX,
+    ILLUMINA_OFFSET,
+    SANGER_MAX,
+    SANGER_OFFSET,
     FormatException,
     FragmentBatch,
     SequencedFragment,
@@ -33,7 +39,14 @@ from ..spec.fragment import (
     verify_quality,
 )
 from .splits import ByteSplit
-from .text import SplitLineReader, plan_byte_splits, read_decompressed
+from .text import (
+    SplitLineReader,
+    decode_slices,
+    gather_padded,
+    line_table,
+    plan_byte_splits,
+    read_decompressed,
+)
 
 # Casava 1.8: instrument:run:flowcell:lane:tile:x:y read:filtered:control:index
 ILLUMINA_PATTERN = re.compile(
@@ -63,6 +76,31 @@ def scan_read_number(name: str, frag: SequencedFragment) -> None:
     """``/N`` suffix fallback (FastqInputFormat.java:349-360)."""
     if len(name) >= 2 and name[-2] == "/" and name[-1].isdigit():
         frag.read = int(name[-1])
+
+
+def _fastq_materializer(qual_lens):
+    """Lazy per-record view builder: replays the reference's stateful
+    id-parse chain (Illumina regex until first failure, then ``/N``)."""
+
+    def build(batch):
+        out = []
+        look_for_illumina = True
+        for i, name in enumerate(batch.names):
+            sl = int(batch.lengths[i])
+            ql = int(qual_lens[i])
+            frag = SequencedFragment(
+                sequence=batch.seq[i, :sl].tobytes(),
+                quality=batch.qual[i, :ql].tobytes(),
+            )
+            look_for_illumina = look_for_illumina and scan_illumina_id(
+                name, frag
+            )
+            if not look_for_illumina:
+                scan_read_number(name, frag)
+            out.append(frag)
+        return out
+
+    return build
 
 
 class FastqInputFormat:
@@ -119,6 +157,11 @@ class FastqInputFormat:
     def read_split(
         self, split: ByteSplit, data: Optional[bytes] = None
     ) -> FragmentBatch:
+        """Vectorized split read (SURVEY §7 stage 8): one newline scan
+        builds the line table, one batched gather builds the padded SoA
+        seq/qual tensors, quality verify/convert run as masked array ops.
+        Per-record ``SequencedFragment`` objects materialize lazily, with
+        the reference's stateful Illumina-then-``/N`` id-parse rule."""
         if data is None:
             import os
 
@@ -129,59 +172,143 @@ class FastqInputFormat:
                 # whole decompressed payload
                 split = ByteSplit(split.path, 0, len(data))
         start = self.position_at_first_record(data, split.start, split.end)
-        r = SplitLineReader(data, 0, split.end)
-        r.pos = start
         encoding = self._encoding()
         filter_failed = self._filter_failed()
-        names: List[str] = []
-        frags: List[SequencedFragment] = []
-        look_for_illumina = True
-        while r.pos < split.end:
-            id_line = r.read_line()
-            if id_line is None:
-                break
-            if not id_line.startswith(b"@"):
-                raise FormatException(
-                    f"unexpected fastq record start at {split.path}: {id_line!r}"
-                )
-            name = id_line[1:].decode()
-            seq = r.read_line()
-            plus = r.read_line()
-            qual = r.read_line()
-            if seq is None or plus is None or qual is None:
-                raise FormatException(
-                    f"unexpected end of file in fastq record. Id: {name}"
-                )
-            if not plus.startswith(b"+"):
-                raise FormatException(
-                    "unexpected fastq line separating sequence and quality: "
-                    f"{plus!r}. Sequence ID: {name}"
-                )
-            frag = SequencedFragment(sequence=bytes(seq), quality=bytes(qual))
-            look_for_illumina = look_for_illumina and scan_illumina_id(
-                name, frag
+
+        a = np.frombuffer(data, dtype=np.uint8)
+        # Keep lines up to the end of a record straddling the split end
+        # (3 continuation lines at most) — but never scan to EOF: the scan
+        # window is O(split), not O(file).
+        from .text import MAX_LINE_LENGTH
+
+        line_stop = min(len(a), split.end + 4 * (MAX_LINE_LENGTH + 1))
+        starts, lens = line_table(a, start, line_stop)
+        # Records = consecutive 4-line groups whose id line starts before
+        # the split end (the read-past-end protocol finishes the tail).
+        id_idx = np.arange(0, len(starts), 4)
+        id_idx = id_idx[starts[id_idx] < split.end]
+        n = len(id_idx)
+        if n == 0:
+            return FragmentBatch(
+                seq=np.zeros((0, 0), np.uint8),
+                qual=np.zeros((0, 0), np.uint8),
+                lengths=np.zeros(0, np.int32),
+                _names=[],
             )
-            if not look_for_illumina:
-                scan_read_number(name, frag)
-            if filter_failed and frag.filter_passed is False:
-                continue
-            if encoding == "illumina":
-                frag.quality = convert_quality(
-                    frag.quality, "illumina", "sanger"
+        if id_idx[-1] + 3 >= len(starts):
+            name = bytes(
+                a[starts[id_idx[-1]] + 1 :][: 200]
+            ).split(b"\n")[0].decode(errors="replace")
+            raise FormatException(
+                f"unexpected end of file in fastq record. Id: {name}"
+            )
+        bad_at = a[starts[id_idx]] != 0x40  # '@'
+        if bad_at.any():
+            k = int(id_idx[np.argmax(bad_at)])
+            line = bytes(a[starts[k] : starts[k] + lens[k]])
+            raise FormatException(
+                f"unexpected fastq record start at {split.path}: {line!r}"
+            )
+        plus_idx = id_idx + 2
+        bad_plus = (lens[plus_idx] < 1) | (a[starts[plus_idx]] != 0x2B)
+        if bad_plus.any():
+            j = int(np.argmax(bad_plus))
+            k = int(plus_idx[j])
+            line = bytes(a[starts[k] : starts[k] + lens[k]])
+            name = bytes(
+                a[starts[id_idx[j]] + 1 : starts[id_idx[j]] + lens[id_idx[j]]]
+            ).decode()
+            raise FormatException(
+                "unexpected fastq line separating sequence and quality: "
+                f"{line!r}. Sequence ID: {name}"
+            )
+
+        name_starts = starts[id_idx] + 1
+        name_lens = lens[id_idx] - 1
+        names: Optional[List[str]] = None  # decoded only when needed
+        seq_lens = lens[id_idx + 1]
+        qual_lens = lens[id_idx + 3]
+        W = int(max(seq_lens.max(), qual_lens.max()))
+        seq = gather_padded(a, starts[id_idx + 1], seq_lens, W)
+        qual = gather_padded(a, starts[id_idx + 3], qual_lens, W)
+
+        def qmask_of():
+            return np.arange(W)[None, :] < qual_lens[:, None]
+
+        if filter_failed:
+            # filter-failed-qc needs the Casava filter flag — parse ids
+            # with the same stateful rule the record loop used.
+            names = decode_slices(a, name_starts, name_lens)
+            keep = np.ones(n, dtype=bool)
+            probing = True
+            for i, nm in enumerate(names):
+                if not probing:
+                    break
+                m = ILLUMINA_PATTERN.fullmatch(nm)
+                if m is None:
+                    probing = False
+                elif m.group(9) == "Y":
+                    keep[i] = False
+            if not keep.all():
+                sel = np.nonzero(keep)[0]
+                names = [names[i] for i in sel]
+                seq, qual = seq[sel], qual[sel]
+                seq_lens, qual_lens = seq_lens[sel], qual_lens[sel]
+                name_starts, name_lens = name_starts[sel], name_lens[sel]
+                n = len(sel)
+
+        if encoding == "illumina":
+            qmask = qmask_of()
+            q16 = qual.astype(np.int16)
+            inr = (q16 >= ILLUMINA_OFFSET) & (
+                q16 <= ILLUMINA_OFFSET + ILLUMINA_MAX
+            )
+            if bool((qmask & ~inr).any()):
+                r, c = np.argwhere(qmask & ~inr)[0]
+                raise FormatException(
+                    "base quality score out of range for Illumina Phred+64 "
+                    f"format (found {int(qual[r, c]) - ILLUMINA_OFFSET} but "
+                    f"acceptable range is [0,{ILLUMINA_MAX}]).\n"
+                    "Maybe qualities are encoded in Sanger format?\n"
                 )
-            else:
-                bad = verify_quality(frag.quality, "sanger")
-                if bad >= 0:
-                    raise FormatException(
-                        "fastq base quality score out of range for Sanger "
-                        f"Phred+33 format (found {frag.quality[bad] - 33}).\n"
-                        "Although Sanger format has been requested, maybe "
-                        "qualities are in Illumina Phred+64 format?\n"
-                        f"Sequence ID: {name}"
-                    )
-            names.append(name)
-            frags.append(frag)
-        return FragmentBatch.from_fragments(names, frags)
+            qual = np.where(
+                qmask, (q16 - (ILLUMINA_OFFSET - SANGER_OFFSET)), 0
+            ).astype(np.uint8)
+        else:
+            # One-pass check: (q - 33) wraps below 33 in uint8, so a single
+            # compare flags both bounds; padding zeros wrap too, so the
+            # expected violation count is exactly the padding count.
+            n_bad = int(
+                np.count_nonzero((qual - SANGER_OFFSET) > SANGER_MAX)
+            )
+            n_pad = int(qual.shape[0] * qual.shape[1] - qual_lens.sum())
+            if n_bad != n_pad:
+                inr = (qual >= SANGER_OFFSET) & (
+                    qual <= SANGER_OFFSET + SANGER_MAX
+                )
+                r, c = np.argwhere(qmask_of() & ~inr)[0]
+                bad_name = str(
+                    memoryview(a)[
+                        int(name_starts[r]) : int(name_starts[r] + name_lens[r])
+                    ],
+                    "utf-8",
+                )
+                raise FormatException(
+                    "fastq base quality score out of range for Sanger "
+                    f"Phred+33 format (found {int(qual[r, c]) - 33}).\n"
+                    "Although Sanger format has been requested, maybe "
+                    "qualities are in Illumina Phred+64 format?\n"
+                    f"Sequence ID: {bad_name}"
+                )
+
+        return FragmentBatch(
+            seq=seq,
+            qual=qual,
+            lengths=seq_lens.astype(np.int32),
+            _names=names,
+            name_source=(a, name_starts, name_lens),
+            materializer=_fastq_materializer(qual_lens.astype(np.int32)),
+        )
 
 
 class FastqOutputFormat:

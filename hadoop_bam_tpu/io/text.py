@@ -17,10 +17,110 @@ import gzip
 import os
 from typing import Iterator, List, Optional, Tuple
 
+import numpy as np
+
 from ..spec import bgzf
 from .splits import ByteSplit
 
 MAX_LINE_LENGTH = 20000  # reference FastqInputFormat.java MAX_LINE_LENGTH
+
+
+# ---------------------------------------------------------------------------
+# Vectorized tokenization (SURVEY §7 stage 8: "newline scans are trivially
+# vectorizable").  These replace per-record Python line loops in the
+# FASTQ/QSEQ/VCF hot paths: one pass finds every line, one batched gather
+# builds the padded SoA tensors.
+# ---------------------------------------------------------------------------
+
+
+def line_table(
+    a: np.ndarray,
+    start: int,
+    stop: int,
+    tail: int = 4 * (MAX_LINE_LENGTH + 1),
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts, lens) of every line beginning in ``[start, stop)`` of the
+    uint8 buffer ``a``.
+
+    Lines may end past ``stop`` — the read-past-the-split-end protocol —
+    so the scan window extends ``tail`` bytes beyond ``stop`` (enough for
+    a full trailing FASTQ record at the reference's MAX_LINE_LENGTH), NOT
+    to EOF: per-split cost is O(split), independent of file size.  CR/LF
+    terminators are excluded from ``lens``.
+    """
+    window_end = min(len(a), stop + tail)
+    stop = min(stop, window_end)
+    nl = start + np.nonzero(a[start:window_end] == 0x0A)[0]
+    starts = np.concatenate(([start], nl + 1)).astype(np.int64)
+    ends = np.concatenate((nl, [window_end])).astype(np.int64)
+    if len(starts) > 1 and starts[-1] >= window_end:
+        starts = starts[:-1]
+        ends = ends[:-1]
+    keep = starts < stop
+    starts, ends = starts[keep], ends[keep]
+    lens = ends - starts
+    # Strip a trailing CR (CRLF files).
+    has_cr = (lens > 0) & (a[np.maximum(ends - 1, 0)] == 0x0D)
+    lens = lens - has_cr.astype(np.int64)
+    return starts, lens
+
+
+def gather_padded(
+    a: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    width: Optional[int] = None,
+    chunk_rows: int = 1 << 16,
+) -> np.ndarray:
+    """Ragged byte slices → 0-padded uint8[N, width] matrix.
+
+    Chunked fancy-index gather: peak temp is ``chunk_rows*width`` indices,
+    not ``N*width`` — 1M-read batches stay cache/RAM friendly.
+    """
+    n = len(starts)
+    W = int(width if width is not None else (lens.max() if n else 0))
+    if n and W:
+        from .. import native
+
+        # Clamp to EOF (read-past-split protocol can point the final row
+        # past the buffer when the file lacks a trailing newline).
+        ln_c = np.minimum(lens, len(a) - starts)
+        rows = native.gather_rows(a, starts, ln_c, W)
+        if rows is not None:
+            return rows
+    out = np.empty((n, W), dtype=np.uint8)
+    if n == 0 or W == 0:
+        return out.reshape(n, W)
+    col = np.arange(W, dtype=np.int64)[None, :]
+    amax = len(a) - 1
+    uniform = bool((lens == W).all())
+    for r0 in range(0, n, chunk_rows):
+        r1 = min(n, r0 + chunk_rows)
+        idx = starts[r0:r1, None] + col
+        # Only the final rows can index past EOF; everything else skips the
+        # clip+mask entirely (the uniform-length fast path is the common
+        # case: fixed-length reads).
+        tail = int(idx[-1, -1]) > amax
+        if tail:
+            np.clip(idx, 0, amax, out=idx)
+        chunk = a[idx]
+        if not uniform:
+            chunk[col >= lens[r0:r1, None]] = 0
+        elif tail:
+            chunk[(starts[r0:r1, None] + col) > amax] = 0
+        out[r0:r1] = chunk
+    return out
+
+
+def decode_slices(
+    data, starts: np.ndarray, lens: np.ndarray
+) -> List[str]:
+    """Per-row substrings as Python strs (names/keys stay host-side)."""
+    mv = memoryview(data)
+    return [
+        str(mv[int(s) : int(s + l)], "utf-8")
+        for s, l in zip(starts, lens)
+    ]
 
 
 def is_gzip(path: str) -> bool:

@@ -28,7 +28,7 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed: Optional[str] = None
 
 MAX_BLOCK = 0x10000
-_ABI = 3
+_ABI = 4
 
 
 def _build(lib_path: str) -> None:
@@ -64,6 +64,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hbam_record_chain_partial.argtypes = [u8p, i64, i64, i64p, i64, i64p]
     lib.hbam_gather_records.restype = i64
     lib.hbam_gather_records.argtypes = [u8p, i64p, i64p, i64p, i64, u8p]
+    lib.hbam_gather_rows.restype = None
+    lib.hbam_gather_rows.argtypes = [u8p, i64p, i64p, i64, i64, u8p, ctypes.c_int]
     return lib
 
 
@@ -361,4 +363,39 @@ def decompress_all(data, check_crc: bool = True, threads: Optional[int] = None) 
     """Whole-file batched BGZF decompress → uint8 array."""
     co, cs, us = scan_blocks(data)
     out, _ = inflate_blocks(data, co, cs, us, check_crc=check_crc, threads=threads)
+    return out
+
+
+def gather_rows(
+    data,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    width: int,
+    threads: Optional[int] = None,
+) -> Optional[np.ndarray]:
+    """Ragged byte rows → 0-padded uint8[n, width] matrix (threaded memcpy).
+
+    Returns None when the native library is unavailable (callers fall back
+    to the NumPy gather)."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(data)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    n = len(st)
+    if n and (
+        st.min() < 0
+        or ln.min() < 0
+        or int((st + np.minimum(ln, width)).max()) > len(a)
+    ):
+        raise IndexError("row extents out of bounds for data buffer")
+    out = np.empty((n, width), dtype=np.uint8)
+    if n == 0 or width == 0:
+        return out
+    lib.hbam_gather_rows(
+        _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64), n, width, _ptr(out, ctypes.c_uint8),
+        threads or default_threads(),
+    )
     return out

@@ -12,8 +12,8 @@ ops/quality histograms and base counters.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Callable, List, Optional
 
 import numpy as np
 
@@ -97,17 +97,60 @@ class FragmentBatch:
 
     ``seq``/``qual``: uint8[N, Lmax] 0-padded; ``lengths``: int32[N];
     metadata columns are host lists (ragged strings stay host-side).
+
+    ``fragments`` is **lazy**: the vectorized tokenizers build only the SoA
+    tensors (the device path never touches record objects); the per-record
+    ``SequencedFragment`` view materializes on first access via the
+    ``materializer`` the reader installed.
     """
 
-    names: List[str]
     seq: np.ndarray
     qual: np.ndarray
     lengths: np.ndarray
-    fragments: List[SequencedFragment] = field(default_factory=list)
+    _names: Optional[List[str]] = None
+    # (buffer, starts, lens) — decode names only when someone asks.
+    name_source: Optional[tuple] = None
+    _fragments: Optional[List[SequencedFragment]] = None
+    materializer: Optional[Callable[["FragmentBatch"], List[SequencedFragment]]] = None
+
+    @property
+    def names(self) -> List[str]:
+        if self._names is None:
+            if self.name_source is None:
+                self._names = [""] * self.n_records
+            else:
+                buf, starts, lens = self.name_source
+                mv = memoryview(buf)
+                self._names = [
+                    str(mv[int(s) : int(s + l)], "utf-8")
+                    for s, l in zip(starts, lens)
+                ]
+        return self._names
+
+    @property
+    def fragments(self) -> List[SequencedFragment]:
+        if self._fragments is None:
+            if self.materializer is not None:
+                self._fragments = self.materializer(self)
+            else:
+                self._fragments = self._default_fragments()
+        return self._fragments
+
+    def _default_fragments(self) -> List[SequencedFragment]:
+        out = []
+        for i in range(self.n_records):
+            ln = int(self.lengths[i])
+            out.append(
+                SequencedFragment(
+                    sequence=self.seq[i, :ln].tobytes(),
+                    quality=self.qual[i, :ln].tobytes(),
+                )
+            )
+        return out
 
     @property
     def n_records(self) -> int:
-        return len(self.names)
+        return len(self.lengths)
 
     def valid_mask(self) -> np.ndarray:
         L = self.seq.shape[1] if self.seq.ndim == 2 else 0
@@ -126,6 +169,6 @@ class FragmentBatch:
             seq[i, : len(f.sequence)] = np.frombuffer(f.sequence, np.uint8)
             qual[i, : len(f.quality)] = np.frombuffer(f.quality, np.uint8)
         return FragmentBatch(
-            names=list(names), seq=seq, qual=qual, lengths=lengths,
-            fragments=list(frags),
+            seq=seq, qual=qual, lengths=lengths,
+            _names=list(names), _fragments=list(frags),
         )

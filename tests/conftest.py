@@ -29,6 +29,9 @@ def pytest_configure(config):
         "tpu: exercises the real accelerator in a subprocess "
         "(skips cleanly when none is reachable)",
     )
+    config.addinivalue_line(
+        "markers", "slow: multi-second perf/scale tests"
+    )
 
 
 REFERENCE_RESOURCES = pathlib.Path("/root/reference/src/test/resources")
