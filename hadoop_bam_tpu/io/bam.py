@@ -74,6 +74,71 @@ class RecordBatch:
             yield self.record(i)
 
 
+#: The column subset the sort pipeline needs: key inputs + record extents.
+SORT_FIELDS = ("refid", "pos", "flag", "rec_off", "rec_len")
+
+
+@dataclass
+class ChunkedRecords:
+    """A zero-copy view over several RecordBatches as one logical batch.
+
+    Where :func:`~hadoop_bam_tpu.pipeline._concat_batches` copies every
+    split's payload into one buffer, this keeps the per-split buffers and
+    addresses records by ``(chunk_id, rec_off)``; the permuted write gather
+    reads straight from the original buffers (native
+    ``hbam_gather_records_chunked``).  ``soa`` carries only
+    ``rec_off``/``rec_len`` — by the time a chunked view exists the keys are
+    computed and the other fixed fields are dead."""
+
+    chunks: List[np.ndarray]  # per-split uint8 payloads
+    chunk_id: np.ndarray  # int32 per record
+    soa: dict  # {"rec_off": int64 (chunk-local body offs), "rec_len": int64}
+    keys: Optional[np.ndarray] = None  # int64; None when keys live on-device
+    _validated: bool = False  # extent bounds checked once, then trusted
+
+    @property
+    def n_records(self) -> int:
+        return len(self.soa["rec_off"])
+
+    @classmethod
+    def from_batches(
+        cls, batches: Sequence[RecordBatch], with_keys: bool = True
+    ) -> "ChunkedRecords":
+        if not batches:
+            return cls(
+                chunks=[],
+                chunk_id=np.empty(0, np.int32),
+                soa={
+                    "rec_off": np.empty(0, np.int64),
+                    "rec_len": np.empty(0, np.int64),
+                },
+                keys=np.empty(0, np.int64) if with_keys else None,
+            )
+        chunk_id = np.concatenate(
+            [
+                np.full(b.n_records, i, dtype=np.int32)
+                for i, b in enumerate(batches)
+            ]
+        )
+        return cls(
+            chunks=[b.data for b in batches],
+            chunk_id=chunk_id,
+            soa={
+                "rec_off": np.concatenate(
+                    [b.soa["rec_off"] for b in batches]
+                ),
+                "rec_len": np.concatenate(
+                    [b.soa["rec_len"] for b in batches]
+                ),
+            },
+            keys=(
+                np.concatenate([b.keys for b in batches])
+                if with_keys
+                else None
+            ),
+        )
+
+
 def splitting_bai_path(path: str) -> str:
     return path + SPLITTING_BAI_EXT
 
@@ -322,12 +387,15 @@ class BamInputFormat:
         data: Optional[bytes] = None,
         with_keys: bool = True,
         threads: Optional[int] = None,
+        fields: Optional[Sequence[str]] = None,
     ) -> RecordBatch:
         """Inflate the split's blocks and decode all its records as one batch.
 
         Without preloaded ``data``, only the split's byte window (plus a
         spill margin for straddling records) is read from disk — a 100GB BAM
-        costs each split only its own bytes."""
+        costs each split only its own bytes.  ``fields`` restricts the SoA
+        decode (see :func:`spec.bam.soa_decode`); pass
+        :data:`SORT_FIELDS` when only keys + record extents are needed."""
         if data is not None:
             return read_virtual_range(
                 data,
@@ -336,6 +404,7 @@ class BamInputFormat:
                 with_keys=with_keys,
                 threads=threads,
                 interval_chunks=split.interval_chunks,
+                fields=fields,
             )
         size = os.path.getsize(split.path)
         cstart = min(split.vstart >> 16, size)
@@ -362,6 +431,7 @@ class BamInputFormat:
                     with_keys=with_keys,
                     threads=threads,
                     interval_chunks=chunks,
+                    fields=fields,
                 )
             except (bam.BamError, bgzf.BgzfError):
                 if at_eof:
@@ -415,6 +485,7 @@ def read_virtual_range(
     with_keys: bool = True,
     threads: Optional[int] = None,
     interval_chunks: Optional[List[Tuple[int, int]]] = None,
+    fields: Optional[Sequence[str]] = None,
 ) -> RecordBatch:
     """Decode all records whose start voffset lies in ``[vstart, vend)``.
 
@@ -426,12 +497,18 @@ def read_virtual_range(
     inflating spill blocks (the ``…|0xffff`` contract guarantees the next
     split will skip them via its own vstart).
     """
+    if fields is not None and with_keys:
+        # Keys need refid/pos/flag + record extents even if the caller's
+        # subset omits them.
+        fields = tuple(
+            dict.fromkeys(tuple(fields) + SORT_FIELDS)
+        )
     if vstart >= vend:
         # Degenerate split (e.g. header larger than the first byte split:
         # BAMInputFormat.java:497-516's FIXME case) — an empty iterator in
         # the reference, an empty batch here.
         return RecordBatch(
-            soa=_empty_soa(), data=np.empty(0, np.uint8),
+            soa=_empty_soa(fields), data=np.empty(0, np.uint8),
             keys=np.empty(0, np.int64),
         )
     file_end = len(data)
@@ -550,7 +627,11 @@ def read_virtual_range(
         if rec_parts
         else np.empty(0, dtype=np.int64)
     )
-    soa = bam.soa_decode(arr, offsets) if len(offsets) else _empty_soa()
+    soa = (
+        bam.soa_decode(arr, offsets, fields=fields)
+        if len(offsets)
+        else _empty_soa(fields)
+    )
     if interval_chunks is not None and len(offsets):
         keep = _voffset_mask(
             offsets,
@@ -562,14 +643,14 @@ def read_virtual_range(
         soa = {k: v[keep] for k, v in soa.items()}
     keys = (
         bam.soa_keys(soa, arr)
-        if with_keys and len(soa["refid"])
+        if with_keys and len(soa["rec_off"])
         else np.empty(0, dtype=np.int64)
     )
     METRICS.count("bam.blocks_inflated", len(voffs_l))
     METRICS.count("bam.bytes_inflated", plen)
     METRICS.count("bam.records_decoded", len(offsets))
     if interval_chunks is not None:
-        METRICS.count("bam.records_kept", len(soa["refid"]))
+        METRICS.count("bam.records_kept", len(soa["rec_off"]))
     return RecordBatch(soa=soa, data=arr, keys=keys)
 
 
@@ -591,22 +672,40 @@ def _voffset_mask(offsets, block_uoffs, block_voffs, us_l, chunks):
     return keep
 
 
-def _empty_soa() -> dict:
-    return {k: np.empty(0, dtype=np.int64) for k in bam.SOA_FIELDS}
+def _empty_soa(fields: Optional[Sequence[str]] = None) -> dict:
+    return {
+        k: np.empty(0, dtype=np.int64)
+        for k in (bam.SOA_FIELDS if fields is None else fields)
+    }
+
+
+def gather_record_array(
+    batch, order: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Concatenate (block_size word + body) of every record, permuted by
+    ``order`` — one native memcpy per record; the write-side analog of the
+    SoA decode.  Accepts a :class:`RecordBatch` (one contiguous payload) or
+    a :class:`ChunkedRecords` (per-split payloads, gathered in place; the
+    O(n) extent validation runs on the first gather only)."""
+    soa = batch.soa
+    if len(soa["rec_off"]) == 0:
+        return np.empty(0, np.uint8)
+    if isinstance(batch, ChunkedRecords):
+        out = native.gather_records_chunked(
+            batch.chunks, batch.chunk_id, soa["rec_off"], soa["rec_len"],
+            order, check=not batch._validated,
+        )
+        batch._validated = True
+        return out
+    return native.gather_records(
+        batch.data, soa["rec_off"], soa["rec_len"], order
+    )
 
 
 def gather_record_bytes(
-    batch: "RecordBatch", order: Optional[np.ndarray] = None
+    batch, order: Optional[np.ndarray] = None
 ) -> bytes:
-    """Concatenate (block_size word + body) of every record, permuted by
-    ``order`` — one native memcpy per record (native.gather_records); the
-    write-side analog of the SoA decode."""
-    soa = batch.soa
-    if len(soa["rec_off"]) == 0:
-        return b""
-    return native.gather_records(
-        batch.data, soa["rec_off"], soa["rec_len"], order
-    ).tobytes()
+    return gather_record_array(batch, order).tobytes()
 
 
 def write_part_fast(

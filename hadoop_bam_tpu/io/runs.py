@@ -31,8 +31,6 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import native
-
 RUN_DATA_EXT = ".run"
 RUN_KEYS_EXT = ".run.keys.npy"
 RUN_OFFS_EXT = ".run.offs.npy"
@@ -56,10 +54,10 @@ def write_run(
     Writes are atomic (tmp + rename) so a crashed spill never leaves a
     half-run behind.
     """
+    from .bam import gather_record_array
+
     data_p, keys_p, offs_p = run_paths(directory, idx)
-    stream = native.gather_records(
-        batch.data, batch.soa["rec_off"], batch.soa["rec_len"], perm
-    )
+    stream = gather_record_array(batch, perm)
     keys_sorted = np.ascontiguousarray(batch.keys[perm], dtype=np.int64)
     lens = batch.soa["rec_len"].astype(np.int64)[perm] + 4
     offs = np.empty(len(lens) + 1, dtype=np.int64)

@@ -28,7 +28,7 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed: Optional[str] = None
 
 MAX_BLOCK = 0x10000
-_ABI = 4
+_ABI = 5
 
 
 def _build(lib_path: str) -> None:
@@ -64,6 +64,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hbam_record_chain_partial.argtypes = [u8p, i64, i64, i64p, i64, i64p]
     lib.hbam_gather_records.restype = i64
     lib.hbam_gather_records.argtypes = [u8p, i64p, i64p, i64p, i64, u8p]
+    lib.hbam_gather_records_chunked.restype = i64
+    lib.hbam_gather_records_chunked.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), i32p, i64p, i64p, i64p, i64, u8p,
+    ]
     lib.hbam_gather_rows.restype = None
     lib.hbam_gather_rows.argtypes = [u8p, i64p, i64p, i64, i64, u8p, ctypes.c_int]
     return lib
@@ -352,6 +356,70 @@ def gather_records(
         return out
     lib.hbam_gather_records(
         _ptr(a, ctypes.c_uint8), _ptr(off, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64),
+        _ptr(order, ctypes.c_int64) if order is not None else None,
+        n, _ptr(out, ctypes.c_uint8),
+    )
+    return out
+
+
+def gather_records_chunked(
+    chunks,
+    chunk_id: np.ndarray,
+    rec_off: np.ndarray,
+    rec_len: np.ndarray,
+    order: Optional[np.ndarray] = None,
+    check: bool = True,
+) -> np.ndarray:
+    """Permuted concat of records scattered across several byte buffers.
+
+    ``chunks`` is a sequence of uint8 arrays (one per file split);
+    ``chunk_id[r]``/``rec_off[r]`` address record ``r``'s body inside its
+    chunk.  Equivalent to :func:`gather_records` over the concatenation of
+    the chunks — without ever building that concatenation.
+
+    ``check=False`` skips the O(n) extent validation — callers that gather
+    the same batch repeatedly (one call per output part) validate once and
+    reuse (the bounds feed raw memcpys, so unvalidated extents must come
+    from a trusted decode)."""
+    arrs = [_as_u8(c) for c in chunks]
+    cid = np.ascontiguousarray(chunk_id, dtype=np.int32)
+    off = np.ascontiguousarray(rec_off, dtype=np.int64)
+    ln = np.ascontiguousarray(rec_len, dtype=np.int64)
+    if check and len(off):
+        if cid.min() < 0 or cid.max() >= len(arrs):
+            raise IndexError("chunk_id out of range")
+        if off.min() < 4 or ln.min() < 0:
+            raise IndexError("record extents out of bounds")
+        sizes = np.asarray([len(a) for a in arrs], dtype=np.int64)
+        if np.any(off + ln > sizes[cid]):
+            raise IndexError("record extents out of bounds for chunk")
+    if order is not None:
+        order = np.ascontiguousarray(order, dtype=np.int64)
+        if len(order) and (order.min() < 0 or order.max() >= len(off)):
+            raise IndexError("order indices out of range")
+        n = len(order)
+        total = int((ln[order] + 4).sum())
+    else:
+        n = len(off)
+        total = int((ln + 4).sum())
+    out = np.empty(total, dtype=np.uint8)
+    lib = _get()
+    if lib is None:
+        w = 0
+        idx = order if order is not None else np.arange(n)
+        for r in idx:
+            l = int(ln[r]) + 4
+            s = int(off[r]) - 4
+            a = arrs[int(cid[r])]
+            out[w : w + l] = a[s : s + l]
+            w += l
+        return out
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data for a in arrs]
+    )
+    lib.hbam_gather_records_chunked(
+        ptrs, _ptr(cid, ctypes.c_int32), _ptr(off, ctypes.c_int64),
         _ptr(ln, ctypes.c_int64),
         _ptr(order, ctypes.c_int64) if order is not None else None,
         n, _ptr(out, ctypes.c_uint8),

@@ -265,6 +265,26 @@ int64_t hbam_gather_records(const uint8_t* data, const int64_t* rec_off,
   return w;
 }
 
+// Chunked variant: records live in several separate buffers (one per file
+// split), addressed by (chunk_id, rec_off).  Lets the sort pipeline write
+// permuted parts without ever concatenating the per-split payloads into one
+// host buffer — on a 1-core host that concat was the single largest cost.
+int64_t hbam_gather_records_chunked(const uint8_t* const* chunks,
+                                    const int32_t* chunk_id,
+                                    const int64_t* rec_off,
+                                    const int64_t* rec_len,
+                                    const int64_t* order, int64_t n,
+                                    uint8_t* out) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = order ? order[i] : i;
+    const int64_t len = rec_len[r] + 4;
+    std::memcpy(out + w, chunks[chunk_id[r]] + rec_off[r] - 4, len);
+    w += len;
+  }
+  return w;
+}
+
 // Ragged byte rows → 0-padded [n, width] matrix (the text tokenizers' SoA
 // builder: FASTQ/QSEQ seq+qual lines).  One memcpy + memset per row,
 // threaded; ~memory bandwidth instead of NumPy's fancy-index gather.
@@ -280,6 +300,6 @@ void hbam_gather_rows(const uint8_t* data, const int64_t* starts,
   });
 }
 
-int hbam_abi_version() { return 4; }
+int hbam_abi_version() { return 5; }
 
 }  // extern "C"

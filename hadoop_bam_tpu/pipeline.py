@@ -17,6 +17,7 @@ import contextlib
 import io
 import os
 import tempfile
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -27,8 +28,10 @@ import jax.numpy as jnp
 from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
 from .utils.tracing import METRICS, span
 from .io.bam import (
+    SORT_FIELDS,
     BamInputFormat,
     BamOutputWriter,
+    ChunkedRecords,
     RecordBatch,
     read_header,
 )
@@ -153,19 +156,39 @@ def sort_bam(
     batches: List[RecordBatch] = []
     dev_hi: List = []
     dev_lo: List = []
-    with span("sort_bam.read"):
-        from .ops.keys import split_keys_np
+    pending: List[np.ndarray] = []
 
-        for s in splits:
-            b = fmt.read_split(s)
+    def _upload_pending() -> None:
+        # Batched key upload: one device RPC per ~quarter of the file,
+        # dispatched mid-read so the transfer rides under the next splits'
+        # native inflate (which releases the GIL).  Per-split uploads pay
+        # a tunnel round trip each; one big upload at sort time overlaps
+        # with nothing.
+        if pending:
+            from .ops.keys import split_keys_np
+
+            hi_i, lo_i = split_keys_np(
+                pending[0] if len(pending) == 1 else np.concatenate(pending)
+            )
+            dev_hi.append(jnp.asarray(hi_i))
+            dev_lo.append(jnp.asarray(lo_i))
+            pending.clear()
+
+    upload_every = max(1, -(-len(splits) // 4))  # ceil: ≤4 upload RPCs
+    with span("sort_bam.read"):
+        for si, s in enumerate(splits):
+            b = fmt.read_split(s, fields=SORT_FIELDS)
+            # Keys are computed; only the record extents stay live (the
+            # other fixed-field columns would just inflate host peak).
+            b.soa = {
+                "rec_off": b.soa["rec_off"],
+                "rec_len": b.soa["rec_len"],
+            }
             batches.append(b)
             if use_device:
-                # Dispatch this split's key columns to the device NOW —
-                # the transfer rides under the next split's host-side
-                # inflate+decode instead of serializing after the read.
-                hi_i, lo_i = split_keys_np(b.keys)
-                dev_hi.append(jnp.asarray(hi_i))
-                dev_lo.append(jnp.asarray(lo_i))
+                pending.append(b.keys)
+                if (si + 1) % upload_every == 0:
+                    _upload_pending()
     n = sum(b.n_records for b in batches)
     METRICS.count("sort_bam.records", n)
     METRICS.count("sort_bam.splits", len(splits))
@@ -180,7 +203,6 @@ def sort_bam(
             else np.empty(0, np.int64)
         )
 
-    perm_chunks = None  # device path: per-part async-fetched perm slices
     if distributed is not None or mesh is not None:
         ds = distributed
         if ds is None:
@@ -201,22 +223,32 @@ def sort_bam(
     elif use_device and n:
         backend = "single-device"
         with span("sort_bam.device_sort"):
+            # Key columns were uploaded in batches during the read; the
+            # permutation comes back in a few async group downloads that
+            # are awaited lazily: group g's transfer rides under the
+            # (CPU-bound, GIL-releasing) gather+deflate of the parts
+            # covered by groups < g.  Remote chip links have high
+            # per-transfer latency, so a handful of big groups beats both
+            # one blocking fetch (no overlap left) and per-part slices (28
+            # latencies).
+            _upload_pending()
             hi = dev_hi[0] if len(dev_hi) == 1 else jnp.concatenate(dev_hi)
             lo = dev_lo[0] if len(dev_lo) == 1 else jnp.concatenate(dev_lo)
-            dev_hi.clear()  # release the per-split duplicates of the key
-            dev_lo.clear()  # columns so HBM holds one copy, not two
+            dev_hi.clear()
+            dev_lo.clear()
             _, _, perm_dev = sort_keys(hi, lo)
-            perm = perm_dev  # sliced per part below; fetched lazily
+            perm = _LazyPermFetch(perm_dev, n)
     else:
         backend = "host"
         with span("sort_bam.host_sort"):
             perm = np.argsort(_all_keys(), kind="stable")
 
-    # Concatenate batches into one global batch view, then write permuted
-    # parts with the vectorized gather + batched native deflate.
+    # A zero-copy chunked view over the per-split batches — the permuted
+    # part writes gather straight from the split payloads (no global
+    # concatenation; on a 1-core host that copy dominated the pipeline).
     from .io.bam import write_part_fast
 
-    merged = _concat_batches(batches)
+    merged = ChunkedRecords.from_batches(batches, with_keys=False)
     with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
         if part_dir is not None:
             # Persistent part dir: the parts are crash-restart units — a
@@ -239,20 +271,9 @@ def sort_bam(
         )
         n_parts = max(1, len(batches))
         bounds = [n * i // n_parts for i in range(n_parts + 1)]
-        if perm_chunks is None and not isinstance(perm, np.ndarray):
-            # Device permutation: slice per part and start all host copies
-            # now — part pi's download overlaps parts 0..pi-1's deflate.
-            perm_chunks = [
-                perm[bounds[i] : bounds[i + 1]] for i in range(n_parts)
-            ]
-            for c in perm_chunks:
-                c.copy_to_host_async()
 
         def write_one(pi: int, tmp: str) -> None:
-            if perm_chunks is not None:
-                order = np.asarray(perm_chunks[pi])
-            else:
-                order = perm[bounds[pi] : bounds[pi + 1]]
+            order = perm[bounds[pi] : bounds[pi + 1]]
             sb_stream = None
             try:
                 if write_splitting_bai:
@@ -280,6 +301,56 @@ def sort_bam(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )
     return SortStats(n_records=n, n_splits=len(splits), backend=backend)
+
+
+class _LazyPermFetch:
+    """Device→host permutation download in lazily-awaited async groups.
+
+    Slicing ``[lo:hi)`` materializes only the groups that cover the range
+    (all groups' downloads are launched up front), so a part writer waiting
+    on group g overlaps groups g+1.. with its own CPU work."""
+
+    GROUPS = 4
+
+    def __init__(self, perm_dev, n: int, groups: Optional[int] = None):
+        k = max(1, min(groups or self.GROUPS, n))
+        # Geometric group sizes (n/2^k, n/2^(k-1), …, n/2): the first wait
+        # — which has had the least CPU work to hide behind — moves the
+        # fewest bytes, and each later group downloads while the parts of
+        # the groups before it deflate.
+        self._bounds = [n >> (k - g) for g in range(k)] + [n]
+        self._bounds[0] = 0
+        self._parts: List = [
+            perm_dev[self._bounds[g] : self._bounds[g + 1]]
+            for g in range(k)
+        ]
+        for p in self._parts:
+            p.copy_to_host_async()
+        self._np: List[Optional[np.ndarray]] = [None] * k
+        self._lock = threading.Lock()
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, sl: slice) -> np.ndarray:
+        lo, hi, step = sl.indices(self.n)
+        assert step == 1
+        g0 = max(0, int(np.searchsorted(self._bounds, lo, "right")) - 1)
+        out: List[np.ndarray] = []
+        for g in range(g0, len(self._parts)):
+            b0, b1 = self._bounds[g], self._bounds[g + 1]
+            if b0 >= hi:
+                break
+            if self._np[g] is None:
+                with self._lock:
+                    if self._np[g] is None:
+                        self._np[g] = np.asarray(self._parts[g])
+                        self._parts[g] = None  # free the device buffer
+            out.append(self._np[g][max(lo - b0, 0) : hi - b0])
+        if not out:
+            return np.empty(0, dtype=np.int64)
+        return out[0] if len(out) == 1 else np.concatenate(out)
 
 
 def _sort_perm(keys: np.ndarray, backend: str) -> np.ndarray:
@@ -348,8 +419,8 @@ def _sort_bam_external(
             nonlocal run_count, acc, acc_bytes, peak
             if not acc:
                 return
-            merged = _concat_batches(acc)
-            peak = max(peak, len(merged.data))
+            merged = ChunkedRecords.from_batches(acc)
+            peak = max(peak, acc_bytes)
             perm = _sort_perm(merged.keys, backend)
             write_run(spill_dir, run_count, merged, perm)
             run_count += 1
@@ -358,7 +429,11 @@ def _sort_bam_external(
 
         with span("sort_bam.spill"):
             for s in splits:
-                b = fmt.read_split(s)
+                b = fmt.read_split(s, fields=SORT_FIELDS)
+                b.soa = {
+                    "rec_off": b.soa["rec_off"],
+                    "rec_len": b.soa["rec_len"],
+                }
                 n += b.n_records
                 if acc and acc_bytes + len(b.data) > memory_budget:
                     flush()

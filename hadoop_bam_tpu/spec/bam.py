@@ -466,12 +466,18 @@ def record_offsets(buf: np.ndarray, pos: int = 0, end: Optional[int] = None) -> 
     return np.asarray(offs, dtype=np.int64)
 
 
-def soa_decode(data: bytes, offsets: np.ndarray) -> dict:
+def soa_decode(
+    data: bytes, offsets: np.ndarray, fields: Optional[Sequence[str]] = None
+) -> dict:
     """Vectorized fixed-field gather → SoA dict of int32/int64 arrays.
 
     ``data`` is the uncompressed BAM record stream, ``offsets`` the
     block_size-word offsets.  Variable-length tails stay in ``data`` (the
     ragged sideband), addressed by ``rec_off``/``rec_len``.
+
+    ``fields`` restricts decoding to a subset of :data:`SOA_FIELDS` — each
+    column is several fancy-index gathers over the whole stream, so hot
+    paths that only need keys + record extents skip the rest.
     """
     a = (
         data
@@ -497,22 +503,23 @@ def soa_decode(data: bytes, offsets: np.ndarray) -> dict:
         ).astype(np.int32)
 
     body = offs + 4
-    rec_len = u32(offs).astype(np.int64)
-    return {
-        "refid": i32(body + 0),
-        "pos": i32(body + 4),
-        "l_read_name": a[body + 8].astype(np.int32),
-        "mapq": a[body + 9].astype(np.int32),
-        "bin": u16(body + 10),
-        "n_cigar_op": u16(body + 12),
-        "flag": u16(body + 14),
-        "l_seq": i32(body + 16),
-        "next_refid": i32(body + 20),
-        "next_pos": i32(body + 24),
-        "tlen": i32(body + 28),
-        "rec_off": body,
-        "rec_len": rec_len,
+    cols = {
+        "refid": lambda: i32(body + 0),
+        "pos": lambda: i32(body + 4),
+        "l_read_name": lambda: a[body + 8].astype(np.int32),
+        "mapq": lambda: a[body + 9].astype(np.int32),
+        "bin": lambda: u16(body + 10),
+        "n_cigar_op": lambda: u16(body + 12),
+        "flag": lambda: u16(body + 14),
+        "l_seq": lambda: i32(body + 16),
+        "next_refid": lambda: i32(body + 20),
+        "next_pos": lambda: i32(body + 24),
+        "tlen": lambda: i32(body + 28),
+        "rec_off": lambda: body,
+        "rec_len": lambda: u32(offs).astype(np.int64),
     }
+    want = SOA_FIELDS if fields is None else tuple(fields)
+    return {k: cols[k]() for k in want}
 
 
 def soa_keys(soa: dict, data: bytes) -> np.ndarray:
