@@ -26,6 +26,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import native
+from . import fs
 from ..conf import (
     BAM_BOUNDED_TRAVERSAL,
     BAM_ENABLE_BAI_SPLITTER,
@@ -175,16 +176,18 @@ class BamInputFormat:
     def _splits_for_file(
         self, path: str, split_size: int
     ) -> List[FileVirtualSplit]:
-        size = os.path.getsize(path)
+        size = fs.get_fs(path).size(path)
         byte_splits = [
             (s, min(s + split_size, size)) for s in range(0, size, split_size)
         ]
         if not byte_splits:
             return []
         idx_path = splitting_bai_path(path)
-        if os.path.exists(idx_path):
+        if fs.get_fs(idx_path).exists(idx_path):
             try:
-                idx = indices.SplittingBai.load(idx_path)
+                idx = indices.SplittingBai.load(
+                    fs.get_fs(idx_path).read_all(idx_path)
+                )
                 # Stale/corrupt index detection beyond the reference's ordering
                 # check: the terminator must encode this file's actual size.
                 if idx.bam_size() != size:
@@ -255,8 +258,7 @@ class BamInputFormat:
             # guesser needs raw bytes — load the file once, lazily.
             if guesser is None:
                 if file_data is None:
-                    with open(path, "rb") as f:
-                        file_data = f.read()
+                    file_data = fs.get_fs(path).read_all(path)
                 hdr, _ = _read_header(file_data)
                 guesser = BamSplitGuesser(file_data, hdr.n_refs)
             g = guesser.guess_next_record_start(start, end)
@@ -305,8 +307,7 @@ class BamInputFormat:
     def _probabilistic_splits(
         self, path: str, byte_splits: List[Tuple[int, int]]
     ) -> List[FileVirtualSplit]:
-        with open(path, "rb") as f:
-            data = f.read()
+        data = fs.get_fs(path).read_all(path)
         hdr, _ = _read_header(data)
         guesser = BamSplitGuesser(data, hdr.n_refs)
         out: List[FileVirtualSplit] = []
@@ -341,10 +342,11 @@ class BamInputFormat:
             hdr = read_header(path)
             if bai_path is None:
                 # Self-reliant fallback: derive the index (needs the bytes).
-                with open(path, "rb") as f:
-                    bai = indices.build_bai(f.read())
+                bai = indices.build_bai(fs.get_fs(path).read_all(path))
             else:
-                bai = indices.Bai.load(bai_path)
+                bai = indices.Bai.load(
+                    fs.get_fs(bai_path).read_all(bai_path)
+                )
             chunks: List[indices.Chunk] = []
             if intervals:
                 for iv in intervals:
@@ -406,15 +408,16 @@ class BamInputFormat:
                 interval_chunks=split.interval_chunks,
                 fields=fields,
             )
-        size = os.path.getsize(split.path)
+        sfs = fs.get_fs(split.path)
+        size = sfs.size(split.path)
         cstart = min(split.vstart >> 16, size)
         cend = min(split.vend >> 16, size)
         margin = 4 << 20
         while True:
             end_byte = min(cend + margin, size)
-            with open(split.path, "rb") as f:
-                f.seek(cstart)
-                window = f.read(end_byte - cstart)
+            window = sfs.read_range(
+                split.path, cstart, end_byte - cstart
+            )
             at_eof = end_byte >= size
             shift = cstart << 16
             chunks = None
@@ -443,7 +446,7 @@ def _find_bai(path: str) -> Optional[str]:
     """Locate the companion `.bai` (htsjdk SamFiles.findIndex convention:
     ``x.bam.bai`` or ``x.bai``)."""
     for cand in (path + ".bai", os.path.splitext(path)[0] + ".bai"):
-        if os.path.exists(cand):
+        if fs.get_fs(cand).exists(cand):
             return cand
     return None
 
@@ -460,18 +463,17 @@ def read_header_voffset(path_or_bytes) -> Tuple[bam.BamHeader, int]:
     (a 100GB BAM must not be slurped to learn its reference dictionary)."""
     if not isinstance(path_or_bytes, str):
         return _read_header(path_or_bytes)
-    size = os.path.getsize(path_or_bytes)
+    hfs = fs.get_fs(path_or_bytes)
+    size = hfs.size(path_or_bytes)
     chunk = 1 << 20
-    with open(path_or_bytes, "rb") as f:
-        while True:
-            f.seek(0)
-            data = f.read(chunk)
-            try:
-                return _read_header(data)
-            except (bgzf.BgzfError, bam.BamError):
-                if chunk >= size:
-                    raise
-                chunk *= 8
+    while True:
+        data = hfs.read_range(path_or_bytes, 0, chunk)
+        try:
+            return _read_header(data)
+        except (bgzf.BgzfError, bam.BamError):
+            if chunk >= size:
+                raise
+            chunk *= 8
 
 
 def read_header(path_or_bytes) -> bam.BamHeader:

@@ -45,7 +45,6 @@ from .text import (
     gather_padded,
     line_table,
     plan_byte_splits,
-    read_decompressed,
 )
 
 # Casava 1.8: instrument:run:flowcell:lane:tile:x:y read:filtered:control:index
@@ -163,14 +162,13 @@ class FastqInputFormat:
         Per-record ``SequencedFragment`` objects materialize lazily, with
         the reference's stateful Illumina-then-``/N`` id-parse rule."""
         if data is None:
-            import os
+            # Split-local window read: O(split) bytes off the filesystem
+            # (a FASTQ record spans 4 lines, so the window keeps 4 complete
+            # lines past the split end); gzip falls back to the whole
+            # (unsplittable) decompressed payload.
+            from .text import read_split_window
 
-            raw_size = os.path.getsize(split.path)
-            data = read_decompressed(split.path)
-            if len(data) != raw_size and split.start == 0:
-                # unsplittable compressed file: the single split covers the
-                # whole decompressed payload
-                split = ByteSplit(split.path, 0, len(data))
+            data, split = read_split_window(split, min_lines_past_end=4)
         start = self.position_at_first_record(data, split.start, split.end)
         encoding = self._encoding()
         filter_failed = self._filter_failed()

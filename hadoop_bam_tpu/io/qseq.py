@@ -40,7 +40,6 @@ from .text import (
     gather_padded,
     line_table,
     plan_byte_splits,
-    read_decompressed,
 )
 
 NUM_QSEQ_COLS = 11
@@ -138,12 +137,11 @@ class QseqInputFormat:
         the global tab index); seq/qual land in padded SoA tensors through
         one batched gather.  Metadata fields materialize lazily."""
         if data is None:
-            import os
+            # Split-local window read: O(split) bytes off the filesystem,
+            # gzip falling back to the whole (unsplittable) payload.
+            from .text import read_split_window
 
-            raw_size = os.path.getsize(split.path)
-            data = read_decompressed(split.path)
-            if len(data) != raw_size and split.start == 0:
-                split = ByteSplit(split.path, 0, len(data))
+            data, split = read_split_window(split)
         encoding = self._encoding()
         filter_failed = self._filter_failed()
         a = np.frombuffer(data, dtype=np.uint8)

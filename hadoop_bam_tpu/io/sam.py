@@ -20,7 +20,12 @@ from ..conf import Configuration
 from ..spec import bam, sam
 from .bam import RecordBatch
 from .splits import ByteSplit
-from .text import SplitLineReader, plan_byte_splits, read_decompressed
+from .text import (
+    SplitLineReader,
+    plan_byte_splits,
+    read_header_prefix,
+    read_split_window,
+)
 
 
 class SamInputFormat:
@@ -35,7 +40,7 @@ class SamInputFormat:
 
     def read_header(self, path: str, data: Optional[bytes] = None) -> bam.BamHeader:
         if data is None:
-            data = read_decompressed(path)
+            data = read_header_prefix(path, b"@")
         lines = []
         pos = 0
         while pos < len(data):
@@ -54,13 +59,19 @@ class SamInputFormat:
         self, split: ByteSplit, data: Optional[bytes] = None
     ) -> RecordBatch:
         if data is None:
-            import os
-
-            raw_size = os.path.getsize(split.path)
-            data = read_decompressed(split.path)
-            if len(data) != raw_size and split.start == 0:
-                split = ByteSplit(split.path, 0, len(data))
-        header = self.read_header(split.path, data=data)
+            # Split-local read: only this split's byte window comes off the
+            # filesystem (SAMRecordReader.java:108-146 protocol); the header
+            # is re-read from the file head and injected — the
+            # WorkaroundingStream role (:183-330).  Gzip falls back to the
+            # whole decompressed payload (unsplittable, single split).
+            data, split = read_split_window(split)
+            header = (
+                self.read_header(split.path, data=data)
+                if split.start == 0  # window starts at the file head
+                else self.read_header(split.path)
+            )
+        else:
+            header = self.read_header(split.path, data=data)
         reader = SplitLineReader(data, split.start, split.end)
         records: List[bam.BamRecord] = []
         for _, line in reader.lines():

@@ -34,11 +34,16 @@ class FileVirtualSplit:
 
 @dataclass
 class ByteSplit:
-    """A plain byte-range split (text formats / uncompressed files)."""
+    """A plain byte-range split (text formats / uncompressed files).
+
+    ``compressed`` caches the planner's gzip-magic probe so per-split
+    readers on remote filesystems skip a head-range round trip; ``None``
+    means unknown (the reader probes)."""
 
     path: str
     start: int
     length: int
+    compressed: Optional[bool] = None
 
     @property
     def end(self) -> int:

@@ -14,12 +14,12 @@ with virtual splits.
 from __future__ import annotations
 
 import gzip
-import os
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..spec import bgzf
+from . import fs
 from .splits import ByteSplit
 
 MAX_LINE_LENGTH = 20000  # reference FastqInputFormat.java MAX_LINE_LENGTH
@@ -124,20 +124,25 @@ def decode_slices(
 
 
 def is_gzip(path: str) -> bool:
-    with open(path, "rb") as f:
-        return f.read(2) == b"\x1f\x8b"
+    return fs.get_fs(path).read_range(path, 0, 2) == b"\x1f\x8b"
 
 
 def plan_byte_splits(
     path: str, split_size: int, splittable: Optional[bool] = None
 ) -> List[ByteSplit]:
-    size = os.path.getsize(path)
+    size = fs.get_fs(path).size(path)
+    compressed = None
     if splittable is None:
-        splittable = not is_gzip(path)
+        compressed = is_gzip(path)
+        splittable = not compressed
     if not splittable:
-        return [ByteSplit(path, 0, size)] if size else []
+        return (
+            [ByteSplit(path, 0, size, compressed=compressed)]
+            if size
+            else []
+        )
     return [
-        ByteSplit(path, s, min(split_size, size - s))
+        ByteSplit(path, s, min(split_size, size - s), compressed=compressed)
         for s in range(0, size, split_size)
     ]
 
@@ -145,13 +150,101 @@ def plan_byte_splits(
 def read_decompressed(path: str) -> bytes:
     """Whole-file read through the gzip/BGZF codec chain (the
     CompressionCodecFactory role, VCFRecordReader.java:121-131)."""
-    with open(path, "rb") as f:
-        raw = f.read()
+    raw = fs.get_fs(path).read_all(path)
     if raw[:2] == b"\x1f\x8b":
         if bgzf.is_bgzf(raw):
             return bgzf.decompress_all(raw)
         return gzip.decompress(raw)
     return raw
+
+
+def read_split_window(
+    split: ByteSplit,
+    min_lines_past_end: int = 1,
+    tail: int = 1 << 16,
+) -> Tuple[bytes, ByteSplit]:
+    """Split-local bytes of an uncompressed text split + the rebased split.
+
+    Reads only ``[start-1, end+tail')`` — the reference's contract that a
+    split costs O(split) bytes, not O(file) (SAMRecordReader.java:108-146
+    seeks to ``start-1`` and reads one line past ``end``).  The window
+    grows geometrically until ``min_lines_past_end`` newlines lie at/after
+    ``end`` (or EOF), so a record that *starts* inside the split always
+    completes inside the window (FASTQ needs 4 lines; single-line formats
+    1).  Returns ``(window_bytes, split_rebased_to_window_offsets)``.
+
+    A gzip-magic file falls back to the whole decompressed payload (such
+    files are unsplittable — the caller holds its single full split).
+
+    Remote-friendly: when the split carries the planner's ``compressed``
+    probe result, the only filesystem traffic is the ranged window reads
+    themselves (EOF is detected from short reads, no ``size()`` call).
+    """
+    f = fs.get_fs(split.path)
+    compressed = split.compressed
+    if compressed is None:
+        compressed = f.read_range(split.path, 0, 2) == b"\x1f\x8b"
+    if compressed:
+        data = read_decompressed(split.path)
+        return data, ByteSplit(
+            split.path, 0, len(data), compressed=False
+        )
+    w0 = max(0, split.start - 1)
+    end = split.end
+    while True:
+        w1 = end + tail
+        data = f.read_range(split.path, w0, w1 - w0)
+        if len(data) < w1 - w0:
+            # Short read: the window reached EOF — nothing left to grow
+            # into, and the split end clamps to the actual file size.
+            end = min(end, w0 + len(data))
+            break
+        # Enough complete lines past the split end?
+        pos = end - w0 - 1  # a terminator exactly at end-1 counts for the
+        found = True  # line *ending* at the boundary
+        for _ in range(min_lines_past_end):
+            at = data.find(b"\n", max(pos, 0))
+            if at < 0:
+                found = False
+                break
+            pos = at + 1
+        if found:
+            break
+        tail *= 4
+    return data, ByteSplit(
+        split.path,
+        split.start - w0,
+        max(0, end - split.start),
+        compressed=False,
+    )
+
+
+def read_header_prefix(path: str, marker: bytes) -> bytes:
+    """The leading ``marker``-prefixed header lines of a text file without
+    reading the whole file: growing prefix reads until a terminated
+    non-header line (or EOF) appears — O(header) bytes.  Gzip input falls
+    back to full decompression (such files are unsplittable anyway).
+
+    The shared header re-injection primitive (SAM ``@`` lines per
+    SAMRecordReader.java:183-330, VCF ``#`` lines per
+    VCFRecordReader.java:111-154)."""
+    f = fs.get_fs(path)
+    size = f.size(path)
+    n = 8 << 10
+    while True:
+        blob = f.read_range(path, 0, min(n, size))
+        if blob[:2] == b"\x1f\x8b":
+            return read_decompressed(path)
+        pos = 0
+        while pos < len(blob) and blob[pos : pos + 1] == marker:
+            nl = blob.find(b"\n", pos)
+            if nl < 0:
+                pos = len(blob)
+                break
+            pos = nl + 1
+        if pos < len(blob) or len(blob) >= size:
+            return blob
+        n *= 4
 
 
 class SplitLineReader:

@@ -34,6 +34,7 @@ from ..conf import (
     VCFRECORDREADER_VALIDATION_STRINGENCY,
 )
 from ..spec import bgzf, indices
+from . import fs
 from ..spec.vcf import (
     FormatException,
     VariantContext,
@@ -54,8 +55,7 @@ def sniff_vcf_format(path: str, trust_exts: bool = True) -> Optional[str]:
             return "vcf"
         if path.endswith(".bcf"):
             return "bcf"
-    with open(path, "rb") as f:
-        head = f.read(1 << 16)
+    head = fs.get_fs(path).read_range(path, 0, 1 << 16)
     if head[:2] == b"\x1f\x8b":
         try:
             head = (
@@ -124,12 +124,12 @@ class VcfInputFormat:
             return mixed
         out: List[ByteSplit] = []
         for path in sorted(paths):
-            size = os.path.getsize(path)
-            with open(path, "rb") as f:
-                head = f.read(18)
+            pfs = fs.get_fs(path)
+            size = pfs.size(path)
+            head = pfs.read_range(path, 0, 18)
             if head[:2] == b"\x1f\x8b":
                 if bgzf.parse_block_header(head + b"\x00" * 64, 0) or bgzf.is_bgzf(
-                    open(path, "rb").read(1 << 16)
+                    pfs.read_range(path, 0, 1 << 16)
                 ):
                     # BGZF: splittable on compressed offsets, snapped to
                     # block boundaries at read time.
@@ -142,7 +142,9 @@ class VcfInputFormat:
                     out.append(ByteSplit(path, 0, size))
             else:
                 out.extend(
-                    ByteSplit(path, s, min(split_size, size - s))
+                    ByteSplit(
+                        path, s, min(split_size, size - s), compressed=False
+                    )
                     for s in range(0, size, split_size)
                 )
         ivs = self._intervals()
@@ -221,10 +223,39 @@ class VcfInputFormat:
     def _split_payload(
         self, split: ByteSplit, data: Optional[bytes]
     ) -> Tuple[str, bytes, int, int]:
-        """(header_text, text_payload, line_scan_start, line_scan_end)."""
+        """(header_text, text_payload, line_scan_start, line_scan_end).
+
+        Without a preloaded buffer the read is split-local: plain text
+        reads only the split's window (+ margins), BGZF reads a bounded
+        raw window and inflates just the blocks overlapping the split
+        (guesser-anchored chain — the BGZFCodec+BGZFSplitGuesser path).
+        Plain gzip is unsplittable and falls back to the whole payload.
+        """
         if data is None:
-            with open(split.path, "rb") as f:
-                data = f.read()
+            f = fs.get_fs(split.path)
+            # Same classification get_splits used (a BGZF BC subfield may
+            # sit beyond byte 18 when other extra fields precede it, so an
+            # 18-byte sniff under-detects BGZF and would misroute a
+            # splittable file to the whole-gzip path).
+            head = f.read_range(split.path, 0, 1 << 16)
+            is_bgzf_file = head[:2] == b"\x1f\x8b" and (
+                bgzf.parse_block_header(head, 0) is not None
+                or bgzf.is_bgzf(head)
+            )
+            if is_bgzf_file:
+                return self._bgzf_split_payload(split, f)
+            if head[:2] == b"\x1f\x8b":
+                data = f.read_all(split.path)  # plain gzip: whole file
+            else:
+                from .text import read_split_window
+
+                window, rsplit = read_split_window(split)
+                return (
+                    _header_prefix_text(split.path),
+                    window,
+                    rsplit.start,
+                    rsplit.end,
+                )
         if data[:2] == b"\x1f\x8b" and not bgzf.is_bgzf(data):
             payload = gzip.decompress(data)
             return _header_text(payload), payload, split.start, len(payload)
@@ -254,10 +285,77 @@ class VcfInputFormat:
             return htext, chunk, len(prev), len(prev) + len(mine)
         return _header_text(data), data, split.start, split.end
 
+    def _bgzf_split_payload(
+        self, split: ByteSplit, f
+    ) -> Tuple[str, bytes, int, int]:
+        """Split-local BGZF VCF: inflate only the blocks overlapping the
+        split, located by walking the block chain from a CRC-verified
+        guessed boundary inside a bounded raw window (blocks are ≤64KiB,
+        so a 2·64KiB back-margin always contains a block start; the
+        forward margin covers the one-extra-block line-completion rule)."""
+        from .guesser import guess_bgzf_block_start
 
-def _bgzf_header_text(data: bytes) -> str:
-    """Header lines of a BGZF VCF, inflating only as many leading blocks as
-    the header occupies."""
+        size = f.size(split.path)
+        end = min(split.end, size)
+        w0 = max(0, split.start - 2 * 0xFFFF)
+        w1 = min(size, end + 4 * 0xFFFF)
+        window = f.read_range(split.path, w0, w1 - w0)
+        # Growing prefix reads until the inflated header is complete — a
+        # *terminated* #CHROM line (an unterminated fragment would silently
+        # drop trailing sample columns on large cohorts) — O(header) bytes.
+        n = 1 << 20
+        while True:
+            prefix = (
+                window if w0 == 0 and size <= len(window)
+                else f.read_range(split.path, 0, min(n, size))
+            )
+            chunk = _bgzf_header_chunk(prefix)
+            i = chunk.find(b"\n#CHROM")
+            if (i >= 0 and chunk.find(b"\n", i + 1) >= 0) or n >= size:
+                htext = _header_text(bytes(chunk))
+                break
+            n *= 4
+        # Walk the chain from the first verified boundary in the window.
+        at = 0 if w0 == 0 else guess_bgzf_block_start(window, 0, len(window))
+        if at is None or w0 + at >= end:
+            return htext, b"", 0, 0
+        prev = b""
+        mine: List[bytes] = []
+        extra = b""
+        pos = at
+        while pos < len(window):
+            try:
+                payload, csize = bgzf.inflate_block(window, pos)
+            except bgzf.BgzfError:
+                break  # window truncated mid-block: chain is complete
+            abs_off = w0 + pos
+            if abs_off < split.start:
+                prev = payload  # only the last pre-split block is kept
+            elif abs_off < end:
+                mine.append(payload)
+            else:
+                extra = payload  # one block past the split end
+                break
+            pos += csize
+        if not mine:
+            return htext, b"", 0, 0
+        body = b"".join(mine)
+        chunk = prev + body + extra
+        return htext, chunk, len(prev), len(prev) + len(body)
+
+
+def _header_prefix_text(path: str) -> str:
+    """Leading ``#`` header lines of a plain-text VCF via growing prefix
+    reads — O(header), not O(file)."""
+    from .text import read_header_prefix
+
+    return _header_text(read_header_prefix(path, b"#"))
+
+
+def _bgzf_header_chunk(data: bytes) -> bytes:
+    """Inflate only as many leading BGZF blocks as the header occupies
+    (stops once a terminated #CHROM line is present, or the available
+    blocks run out)."""
     chunk = bytearray()
     pos = 0
     while pos < len(data):
@@ -269,7 +367,13 @@ def _bgzf_header_text(data: bytes) -> str:
         pos += csize
         if b"\n#CHROM" in chunk and b"\n" in chunk[chunk.find(b"\n#CHROM") + 1 :]:
             break
-    return _header_text(bytes(chunk))
+    return bytes(chunk)
+
+
+def _bgzf_header_text(data: bytes) -> str:
+    """Header lines of a BGZF VCF, inflating only as many leading blocks as
+    the header occupies."""
+    return _header_text(_bgzf_header_chunk(data))
 
 
 def _header_text(payload: bytes) -> str:

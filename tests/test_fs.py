@@ -1,0 +1,230 @@
+"""Filesystem seam + split-local reads.
+
+Covers VERDICT r1 item 8: readers must cost O(split) bytes per split (the
+SAMRecordReader.java:108-146 protocol) and must reach storage only through
+the io.fs seam (util/WrapSeekable.java:56-66 role), proven by round-tripping
+a non-local scheme (mem://) through the ordinary input formats.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.io import fs
+from hadoop_bam_tpu.io.bam import BamInputFormat, read_header
+from hadoop_bam_tpu.io.fastq import FastqInputFormat
+from hadoop_bam_tpu.io.sam import SamInputFormat
+from hadoop_bam_tpu.io.vcf import VcfInputFormat
+from hadoop_bam_tpu.spec import bam, bgzf
+
+
+def make_bam_bytes(n=1000, seed=0) -> bytes:
+    import io as _io
+
+    rng = np.random.default_rng(seed)
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n@SQ\tSN:chr1\tLN:248956422\n"
+        "@SQ\tSN:chr2\tLN:242193529",
+        [("chr1", 248956422), ("chr2", 242193529)],
+    )
+    recs = [
+        bam.build_record(
+            f"r{i:06d}",
+            int(rng.integers(0, 2)),
+            int(rng.integers(0, 1 << 27)),
+            60,
+            0,
+            [(50, "M")],
+            "ACGT" * 12 + "AC",
+            bytes([30] * 50),
+        )
+        for i in range(n)
+    ]
+    buf = _io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    return buf.getvalue()
+
+
+def make_vcf_text(n=1000) -> str:
+    head = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr1,length=248956422>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+    rows = "".join(
+        f"chr1\t{1000 + 7 * i}\t.\tA\tG\t50\tPASS\tDP={i % 97}\n"
+        for i in range(n)
+    )
+    return head + rows
+
+
+class CountingFs(fs.LocalFilesystem):
+    """Local files behind a counting seam (scheme ``cnt://``)."""
+
+    def __init__(self):
+        self.bytes_read = 0
+        self.calls = 0
+
+    @staticmethod
+    def _strip(path):
+        return path[6:] if path.startswith("cnt://") else path
+
+    def read_range(self, path, start, length):
+        out = super().read_range(path, start, length)
+        self.bytes_read += len(out)
+        self.calls += 1
+        return out
+
+    def read_all(self, path):
+        out = super().read_all(path)
+        self.bytes_read += len(out)
+        self.calls += 1
+        return out
+
+
+@pytest.fixture
+def counting_fs():
+    cfs = CountingFs()
+    fs.register_filesystem("cnt", cfs)
+    return cfs
+
+
+def test_scheme_dispatch_and_errors(tmp_path):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"hello world")
+    local = fs.get_fs(str(p))
+    assert local.read_range(str(p), 6, 5) == b"world"
+    assert local.size(f"file://{p}") == 11
+    with pytest.raises(ValueError):
+        fs.get_fs("gs://bucket/x.bam")
+    assert fs.path_scheme("mem://a/b") == "mem"
+    assert fs.path_scheme("/plain/path") == ""
+
+
+def test_mem_roundtrip_bam():
+    """A BAM written to mem:// reads back through the standard input
+    format — no reader knows it isn't on disk."""
+    mem = fs.MemFilesystem()
+    fs.register_filesystem("mem", mem)
+    blob = make_bam_bytes(n=3000, seed=3)
+    with mem.open_write("mem://bams/a.bam") as w:
+        w.write(blob)
+    fmt = BamInputFormat()
+    splits = fmt.get_splits(["mem://bams/a.bam"], split_size=16 << 10)
+    assert len(splits) > 1
+    batches = [fmt.read_split(s) for s in splits]
+    total = sum(b.n_records for b in batches)
+    _, recs = bam.read_bam(blob)
+    assert total == len(recs)
+    hdr = read_header("mem://bams/a.bam")
+    assert hdr.n_refs > 0
+
+
+def test_mem_roundtrip_fastq():
+    mem = fs.MemFilesystem()
+    fs.register_filesystem("mem", mem)
+    text = b"".join(
+        b"@r%05d\nACGTACGT\n+\nIIIIIIII\n" % i for i in range(1000)
+    )
+    with mem.open_write("mem://fq/a.fastq") as w:
+        w.write(text)
+    fmt = FastqInputFormat()
+    splits = fmt.get_splits(["mem://fq/a.fastq"], split_size=4 << 10)
+    assert len(splits) > 1
+    total = sum(fmt.read_split(s).n_records for s in splits)
+    assert total == 1000
+
+
+def test_sam_split_read_is_split_local(tmp_path, counting_fs):
+    """Reading one mid-file SAM split must not read the whole file."""
+    blob = make_bam_bytes(n=4000, seed=1)
+    hdr, recs = bam.read_bam(blob)
+    from hadoop_bam_tpu.spec import sam as spec_sam
+
+    lines = [spec_sam.record_to_sam_line(r, hdr) for r in recs]
+    text = (hdr.text.rstrip("\n") + "\n" + "\n".join(lines) + "\n").encode()
+    p = tmp_path / "big.sam"
+    p.write_bytes(text)
+    path = f"cnt://{p}"
+
+    fmt = SamInputFormat()
+    splits = fmt.get_splits([path], split_size=32 << 10)
+    assert len(splits) >= 8
+    mid = splits[len(splits) // 2]
+    counting_fs.bytes_read = 0
+    batch = fmt.read_split(mid)
+    assert batch.n_records > 0
+    # Window + header prefix, not the whole file.
+    assert counting_fs.bytes_read < len(text) // 2, (
+        counting_fs.bytes_read,
+        len(text),
+    )
+
+    # And the union over splits equals the whole file's records.
+    total = sum(fmt.read_split(s).n_records for s in splits)
+    assert total == len(recs)
+
+
+def test_bam_split_read_is_split_local(tmp_path, counting_fs):
+    blob = make_bam_bytes(n=12000, seed=2)
+    p = tmp_path / "big.bam"
+    p.write_bytes(blob)
+    path = f"cnt://{p}"
+    fmt = BamInputFormat()
+    splits = fmt.get_splits([path], split_size=32 << 10)
+    assert len(splits) >= 4
+    mid = splits[len(splits) // 2]
+    counting_fs.bytes_read = 0
+    batch = fmt.read_split(mid)
+    assert batch.n_records > 0
+    assert counting_fs.bytes_read < len(blob)
+
+
+def test_vcf_plain_split_local(tmp_path, counting_fs):
+    text = make_vcf_text(n=20000)
+    p = tmp_path / "big.vcf"
+    p.write_text(text)
+    path = f"cnt://{p}"
+    fmt = VcfInputFormat()
+    splits = fmt.get_splits([path], split_size=32 << 10)
+    assert len(splits) > 2
+    mid = splits[len(splits) // 2]
+    counting_fs.bytes_read = 0
+    b = fmt.read_split(mid)
+    assert len(b.variants) > 0
+    assert counting_fs.bytes_read < len(text.encode()) // 2
+    total = sum(len(fmt.read_split(s).variants) for s in splits)
+    assert total == 20000
+
+
+def test_vcf_bgzf_split_local_equals_preloaded(tmp_path, counting_fs):
+    import io as _io
+
+    text = make_vcf_text(n=20000)
+    buf = _io.BytesIO()
+    w = bgzf.BgzfWriter(buf, level=5)
+    w.write(text.encode())
+    w.close()
+    raw = buf.getvalue()
+    p = tmp_path / "big.vcf.bgz"
+    p.write_bytes(raw)
+    path = f"cnt://{p}"
+    fmt = VcfInputFormat()
+    splits = fmt.get_splits([path], split_size=16 << 10)
+    assert len(splits) > 1
+    per_split = []
+    for s in splits:
+        counting_fs.bytes_read = 0
+        b = fmt.read_split(s)
+        per_split.append(len(b.variants))
+        assert counting_fs.bytes_read < len(raw) + (1 << 20)
+    assert sum(per_split) == 20000
+    # Equality against the preloaded-buffer path (the old whole-file read).
+    from hadoop_bam_tpu.io.splits import ByteSplit
+
+    for s, n_local in zip(splits, per_split):
+        b2 = fmt.read_split(
+            ByteSplit(s.path, s.start, s.length), data=raw
+        )
+        assert len(b2.variants) == n_local
