@@ -35,11 +35,17 @@ Inflate (decompress), device side
        copies, ``src = o - d + (j - o) mod d``), so log2(out) rounds of
        pointer-jumping materialize all back-references.
 
-    Handles streams whose blocks are all fixed-Huffman (including
-    multi-block and back-references) plus single stored-block members
-    (zlib level 0).  Dynamic-Huffman members route to the host tier
-    (native zlib) by the ``bgzf_decompress_device`` wrapper — the same
-    tiering stance as the split planner's index→guesser fallback.
+    Three kernels share the machinery: ``inflate_fixed`` (all-fixed
+    members, one launch), ``inflate_stored`` (zlib level 0), and
+    ``inflate_dynamic`` — the general decoder that builds canonical
+    Huffman tables ON DEVICE per member per block (code-length RLE via a
+    short ``lax.scan``, counts→first-codes→argsort ranks all dense) and
+    walks any per-member mix of stored/fixed/dynamic blocks in a
+    block-sequential outer loop, so real zlib output (level ≥1 emits
+    dynamic blocks) decodes on device instead of tiering to the host.
+    Members that fail any device check still tier down to native zlib in
+    the ``bgzf_decompress_device`` wrapper — the same fallback stance as
+    the split planner's index→guesser chain.
 
 Host-side helpers assemble/validate the BGZF framing (headers, CRC32,
 ISIZE — spec/bgzf.py owns the layout) around the device payloads.
@@ -130,6 +136,19 @@ def _build_dist_table() -> np.ndarray:
 
 LITLEN_TABLE = _build_litlen_table()
 DIST_TABLE = _build_dist_table()
+
+# Fixed-Huffman code lengths (RFC 1951 §3.2.6) — the btype=01 table is just
+# a particular code-length vector, so the dynamic decoder subsumes it.
+FIXED_LITLEN_LENS = np.array(
+    [8] * 144 + [9] * 112 + [7] * 24 + [8] * 8, dtype=np.int32
+)
+FIXED_DIST_LENS = np.array([5] * 32, dtype=np.int32)
+# Order in which code-length-code lengths appear in a dynamic header.
+CLC_ORDER = np.array(
+    [16, 17, 18, 0, 8, 7, 9, 6, 10, 5, 11, 4, 12, 3, 13, 2, 14, 1, 15],
+    dtype=np.int32,
+)
+REV8 = np.array([_bit_reverse(i, 8) for i in range(256)], dtype=np.int32)
 
 # Worst case the literal-only emit expands 9/8 + header; cap the per-member
 # payload so a device-deflated block always fits the u16 BSIZE field.
@@ -479,6 +498,383 @@ def inflate_stored(
 
 
 # --------------------------------------------------------------------------
+# Dynamic-Huffman device inflate (VERDICT r2: real zlib output must decode
+# on device, not tier straight to the host).
+#
+# Architecture: a block-sequential outer loop (static unroll, lock-step
+# across the batch) whose every iteration decodes ONE DEFLATE block per
+# member — any mix of stored/fixed/dynamic across members and across
+# blocks.  Per iteration:
+#   1. parse the block header; for btype=10 run the code-length RLE section
+#      through a short lax.scan (≤318 steps) and build the member's
+#      canonical litlen/dist decoders ON DEVICE (counts → first codes →
+#      argsort symbol ranks — all dense);
+#   2. speculative token resolve at every bit position using canonical
+#      decode (15 unrolled range compares + one ≤288-entry gather — no
+#      2^15 LUT per member);
+#   3. chain-walk from the block's first data bit (pointer doubling); the
+#      EOB is a self-loop so the walk terminates exactly at block end;
+#   4. merge the block's literal/copy coverage into member-wide val/src
+#      planes, advance the bit cursor past the EOB into the next header.
+# A single member-wide LZ77 pointer-jump pass then materializes all copies
+# (back-references legally span blocks).
+# --------------------------------------------------------------------------
+
+
+def _canonical_decoder(lens: jax.Array, max_len: int):
+    """Canonical-Huffman decode tables from per-symbol code lengths.
+
+    ``lens``: int32 [B, S] (0 = symbol unused).  Returns
+    ``(first, count, symoff, sym_sorted)`` with shapes [B, max_len+1]×3 and
+    [B, S]: a code of length L and MSB-first value c maps to symbol
+    ``sym_sorted[symoff[L] + c - first[L]]`` iff
+    ``first[L] <= c < first[L]+count[L]`` (RFC 1951 §3.2.2).
+    """
+    B, S = lens.shape
+    Lr = jnp.arange(max_len + 1, dtype=jnp.int32)
+    count = jnp.sum(
+        (lens[:, None, :] == Lr[None, :, None]) & (Lr[None, :, None] > 0),
+        axis=2,
+        dtype=jnp.int32,
+    )
+    firsts = [jnp.zeros((B,), jnp.int32)]
+    code = jnp.zeros((B,), jnp.int32)
+    for L in range(1, max_len + 1):
+        code = (code + count[:, L - 1]) << 1
+        firsts.append(code)
+    first = jnp.stack(firsts, axis=1)
+    symoff = jnp.cumsum(count, axis=1) - count
+    key = jnp.where(
+        lens > 0,
+        lens * (2 * S) + jnp.arange(S, dtype=jnp.int32)[None, :],
+        jnp.int32(1 << 24),
+    )
+    sym_sorted = jnp.argsort(key, axis=1).astype(jnp.int32)
+    return first, count, symoff, sym_sorted
+
+
+def _canon_decode(rev: jax.Array, tables, max_len: int):
+    """Decode MSB-first-reversed bit windows against canonical tables.
+
+    ``rev``: int32 [...], the next ``max_len`` stream bits with the first
+    stream bit in the MSB.  Returns (sym, L, matched); garbage positions
+    (speculative) may be unmatched."""
+    first, count, symoff, sym_sorted = tables
+    expand = (1,) * (rev.ndim - 1)
+    Lsel = jnp.full(rev.shape, 99, dtype=jnp.int32)
+    for L in range(max_len, 0, -1):  # downward: smallest L wins last
+        cand = rev >> (max_len - L)
+        f = first[:, L].reshape(-1, *expand)
+        c = count[:, L].reshape(-1, *expand)
+        match = (cand >= f) & (cand < f + c)
+        Lsel = jnp.where(match, L, Lsel)
+    matched = Lsel < 99
+    Ls = jnp.where(matched, Lsel, 1)
+    cand = rev >> (max_len - Ls)
+    f_s = jnp.take_along_axis(
+        first, Ls.reshape(first.shape[0], -1), axis=1
+    ).reshape(Ls.shape)
+    o_s = jnp.take_along_axis(
+        symoff, Ls.reshape(symoff.shape[0], -1), axis=1
+    ).reshape(Ls.shape)
+    idx = jnp.clip(o_s + cand - f_s, 0, sym_sorted.shape[1] - 1)
+    sym = jnp.take_along_axis(
+        sym_sorted, idx.reshape(sym_sorted.shape[0], -1), axis=1
+    ).reshape(Ls.shape)
+    return sym, Ls, matched
+
+
+_MAX_HDR_TOKENS = 318  # ≤286+30+2 RLE tokens fill the code-length section
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def inflate_dynamic(
+    comp: jax.Array,
+    clens: jax.Array,
+    isizes: jax.Array,
+    out_bytes: int,
+    max_blocks: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched inflate of general DEFLATE members (dynamic/fixed/stored
+    blocks in any per-member mix), tables built on device.
+
+    ``comp``: uint8 [B, C]; ``clens``/``isizes``: int32 [B]; ``out_bytes``
+    static ≥ max isize; ``max_blocks`` static bound on DEFLATE blocks per
+    member (zlib's 16K-symbol block buffer means a 64KiB BGZF payload has
+    ≤5; members exceeding the bound fail cleanly → host tier).
+    Returns (out uint8 [B, out_bytes], ok bool [B]).
+    """
+    B, C = comp.shape
+    NB = C * 8
+    OUT = out_bytes
+    _, _, len_base, len_extra, dist_base, dist_extra = _token_tables()
+    rev8 = jnp.asarray(REV8)
+    clc_order = jnp.asarray(CLC_ORDER)
+    fixed_ll = jnp.asarray(FIXED_LITLEN_LENS)
+    fixed_dl = jnp.asarray(FIXED_DIST_LENS)
+
+    data = jnp.pad(comp, ((0, 0), (0, 8))).astype(jnp.uint32)
+    nbits_real = clens * 8
+
+    def window(bitpos):
+        """32 stream bits starting at ``bitpos`` (any shape [B, ...])."""
+        flat = bitpos.reshape(B, -1)
+        bi = flat >> 3
+        s = (flat & 7).astype(jnp.uint32)
+        b0 = jnp.take_along_axis(data, bi, axis=1)
+        b1 = jnp.take_along_axis(data, bi + 1, axis=1)
+        b2 = jnp.take_along_axis(data, bi + 2, axis=1)
+        b3 = jnp.take_along_axis(data, bi + 3, axis=1)
+        w = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        return (w >> s).reshape(bitpos.shape)
+
+    def rev15(w):
+        v = (w & 0x7FFF).astype(jnp.int32)
+        r16 = (rev8[v & 0xFF] << 8) | rev8[v >> 8]
+        return r16 >> 1
+
+    p = jnp.arange(NB, dtype=jnp.int32)[None, :]
+    j = jnp.arange(OUT, dtype=jnp.int32)[None, :]
+
+    # Member-wide output planes, merged block by block.
+    lit_plane = jnp.zeros((B, OUT), bool)
+    val_plane = jnp.zeros((B, OUT), jnp.uint8)
+    dst_plane = jnp.ones((B, OUT), jnp.int32)
+    off_plane = jnp.zeros((B, OUT), jnp.int32)  # token output offset
+
+    bitpos = jnp.zeros((B,), jnp.int32)
+    out_base = jnp.zeros((B,), jnp.int32)
+    ok = jnp.ones((B,), bool)
+    done = jnp.zeros((B,), bool)
+
+    T = OUT + 2  # per-block chain slots: every emitting token emits ≥1 byte
+
+    for _blk in range(max_blocks):
+        live = ok & ~done
+        hdr = window(bitpos[:, None])[:, 0]
+        bfinal = (hdr & 1) == 1
+        btype = ((hdr >> 1) & 3).astype(jnp.int32)
+        ok = ok & (~live | (btype != 3))
+
+        # ---- stored block (btype=00): byte-aligned raw copy ------------
+        st_bit = (bitpos + 3 + 7) & ~7
+        sb = st_bit >> 3
+        ln_w = window((sb << 3)[:, None])[:, 0]
+        s_len = (ln_w & 0xFFFF).astype(jnp.int32)
+        s_nlen = ((ln_w >> 16) & 0xFFFF).astype(jnp.int32)
+        stored = live & (btype == 0)
+        ok = ok & (
+            ~stored
+            | ((s_len == (s_nlen ^ 0xFFFF)) & ((sb + 4) * 8 + s_len * 8 <= nbits_real))
+        )
+        src_byte = (sb + 4)[:, None] + (j - out_base[:, None])
+        s_mask = stored[:, None] & (j >= out_base[:, None]) & (
+            j < (out_base + s_len)[:, None]
+        )
+        s_vals = jnp.take_along_axis(
+            data, jnp.clip(src_byte, 0, C + 7), axis=1
+        ).astype(jnp.uint8)
+        lit_plane = jnp.where(s_mask, True, lit_plane)
+        val_plane = jnp.where(s_mask, s_vals, val_plane)
+
+        # ---- dynamic header parse (btype=10) ---------------------------
+        at = bitpos + 3
+        hlit = (window(at[:, None])[:, 0] & 31).astype(jnp.int32) + 257
+        hdist = (window((at + 5)[:, None])[:, 0] & 31).astype(jnp.int32) + 1
+        hclen = (window((at + 10)[:, None])[:, 0] & 15).astype(jnp.int32) + 4
+        is_dyn = live & (btype == 2)
+        ok = ok & (~is_dyn | ((hlit <= 286) & (hdist <= 30)))
+        # 19 code-length-code lengths at fixed 3-bit slots, CLC order.
+        ci = jnp.arange(19, dtype=jnp.int32)[None, :]
+        cl_raw = (
+            window(at[:, None] + 14 + 3 * ci) & 7
+        ).astype(jnp.int32)
+        cl_raw = jnp.where(ci < hclen[:, None], cl_raw, 0)
+        cl_lens = jnp.zeros((B, 19), jnp.int32).at[
+            jnp.arange(B)[:, None], clc_order[None, :]
+        ].set(cl_raw)
+        cl_tables = _canonical_decoder(cl_lens, 7)
+        total_codes = hlit + hdist
+
+        def hstep(carry, _):
+            pos, cnt, prev, okh = carry
+            w = window(pos[:, None])[:, 0]
+            r7 = rev8[(w & 0x7F).astype(jnp.int32)] >> 1
+            csym, cL, cmatch = _canon_decode(r7, cl_tables, 7)
+            ext = (w >> cL.astype(jnp.uint32)).astype(jnp.int32)
+            rep = jnp.where(
+                csym < 16,
+                1,
+                jnp.where(
+                    csym == 16,
+                    3 + (ext & 3),
+                    jnp.where(csym == 17, 3 + (ext & 7), 11 + (ext & 127)),
+                ),
+            )
+            val = jnp.where(
+                csym < 16, csym, jnp.where(csym == 16, prev, 0)
+            )
+            nb = cL + jnp.where(
+                csym < 16,
+                0,
+                jnp.where(csym == 16, 2, jnp.where(csym == 17, 3, 7)),
+            )
+            act = cnt < total_codes
+            okh = okh & (~act | cmatch)
+            return (
+                pos + jnp.where(act, nb, 0),
+                cnt + jnp.where(act, rep, 0),
+                jnp.where(act, val, prev),
+                okh,
+            ), (jnp.where(act, rep, 0), val)
+
+        (hpos, hcnt, _, hok), (reps, vals) = jax.lax.scan(
+            hstep,
+            (at + 14 + 3 * hclen, jnp.zeros((B,), jnp.int32),
+             jnp.zeros((B,), jnp.int32), jnp.ones((B,), bool)),
+            None,
+            length=_MAX_HDR_TOKENS,
+        )
+        ok = ok & (~is_dyn | (hok & (hcnt == total_codes)))
+        reps_t = reps.T  # [B, 318]
+        vals_t = vals.T
+        cum_rep = jnp.cumsum(reps_t, axis=1)
+        m = jnp.arange(_MAX_HDR_TOKENS, dtype=jnp.int32)[None, :]
+        tok_of_m = jax.vmap(partial(jnp.searchsorted, side="right"))(
+            cum_rep, jnp.broadcast_to(m, (B, _MAX_HDR_TOKENS))
+        ).astype(jnp.int32)
+        lens_all = jnp.take_along_axis(
+            vals_t, jnp.clip(tok_of_m, 0, _MAX_HDR_TOKENS - 1), axis=1
+        )
+        li288 = jnp.arange(288, dtype=jnp.int32)[None, :]
+        dyn_ll = jnp.where(
+            li288 < hlit[:, None],
+            jnp.take_along_axis(
+                lens_all, jnp.minimum(li288, _MAX_HDR_TOKENS - 1), axis=1
+            ),
+            0,
+        )
+        di32 = jnp.arange(32, dtype=jnp.int32)[None, :]
+        dyn_dl = jnp.where(
+            di32 < hdist[:, None],
+            jnp.take_along_axis(
+                lens_all,
+                jnp.clip(hlit[:, None] + di32, 0, _MAX_HDR_TOKENS - 1),
+                axis=1,
+            ),
+            0,
+        )
+
+        use_dyn = (btype == 2)[:, None]
+        ll_lens = jnp.where(use_dyn, dyn_ll, fixed_ll[None, :])
+        dl_lens = jnp.where(use_dyn, dyn_dl, fixed_dl[None, :])
+        ll_tables = _canonical_decoder(ll_lens, 15)
+        dl_tables = _canonical_decoder(dl_lens, 15)
+        data_start = jnp.where(btype == 2, hpos, bitpos + 3)
+
+        # ---- speculative token resolve at every bit position -----------
+        w = window(p | jnp.zeros((B, 1), jnp.int32))
+        sym, L, matched = _canon_decode(rev15(w), ll_tables, 15)
+        islit = matched & (sym < 256)
+        iseob = matched & (sym == 256)
+        islen = matched & (sym > 256) & (sym < 286)
+        bad = ~matched | (matched & (sym >= 286))
+        li = jnp.clip(sym - 257, 0, 28)
+        lext = len_extra[li]
+        lenval = len_base[li] + (
+            (w >> L.astype(jnp.uint32)).astype(jnp.int32) & ((1 << lext) - 1)
+        )
+        pd = p + L + lext
+        wd = window(pd)
+        dsym, Ld, dmatch = _canon_decode(rev15(wd), dl_tables, 15)
+        bad = bad | (islen & (~dmatch | (dsym >= 30)))
+        dsym = jnp.clip(dsym, 0, 29)
+        dext = dist_extra[dsym]
+        dist = dist_base[dsym] + (
+            (wd >> Ld.astype(jnp.uint32)).astype(jnp.int32)
+            & ((1 << dext) - 1)
+        )
+        adv = jnp.where(islit | iseob, L, L + lext + Ld + dext)
+        nxt = jnp.where(iseob, p, jnp.minimum(p + adv, NB - 1))
+        emit = jnp.where(islit, 1, jnp.where(islen, lenval, 0))
+        overrun = (~iseob) & ((p + adv) > nbits_real[:, None])
+        bad = bad | overrun
+        emit = jnp.where(bad, 0, emit)
+
+        # ---- chain walk from the block's first data bit ----------------
+        t = jnp.arange(T, dtype=jnp.int32)
+        cur = jnp.broadcast_to(
+            jnp.clip(data_start, 0, NB - 1)[:, None], (B, T)
+        )
+        jump = nxt
+        for k in range(max(1, int(T - 1).bit_length())):
+            stepped = jnp.take_along_axis(jump, cur, axis=1)
+            cur = jnp.where(((t >> k) & 1)[None, :] == 1, stepped, cur)
+            jump = jnp.take_along_axis(jump, jump, axis=1)
+
+        huff = live & (btype == 1) | live & (btype == 2)
+        bad_t = jnp.take_along_axis(bad, cur, axis=1)
+        term_t = jnp.take_along_axis(iseob, cur, axis=1)
+        reached = term_t[:, -1]
+        ok = ok & (~huff | (~jnp.any(bad_t, axis=1) & reached))
+        emit_t = jnp.take_along_axis(emit, cur, axis=1)
+        emit_t = jnp.where(huff[:, None], emit_t, 0)
+        cum_out = jnp.cumsum(emit_t, axis=1)
+        tok_off = out_base[:, None] + cum_out - emit_t
+        total = jnp.where(huff, cum_out[:, -1], 0)
+
+        # ---- merge this block's coverage into the member planes --------
+        jj = j - out_base[:, None]
+        cov = jax.vmap(partial(jnp.searchsorted, side="right"))(
+            cum_out, jnp.clip(jj, 0, OUT)
+        ).astype(jnp.int32)
+        cov = jnp.clip(cov, 0, T - 1)
+        tp = jnp.take_along_axis(cur, cov, axis=1)
+        in_blk = huff[:, None] & (jj >= 0) & (jj < total[:, None])
+        lit_j = jnp.take_along_axis(islit, tp, axis=1)
+        sym_j = jnp.take_along_axis(sym, tp, axis=1).astype(jnp.uint8)
+        d_j = jnp.maximum(jnp.take_along_axis(dist, tp, axis=1), 1)
+        o_j = jnp.take_along_axis(tok_off, cov, axis=1)
+        lit_plane = jnp.where(in_blk, lit_j, lit_plane)
+        val_plane = jnp.where(in_blk & lit_j, sym_j, val_plane)
+        dst_plane = jnp.where(in_blk, d_j, dst_plane)
+        off_plane = jnp.where(in_blk, o_j, off_plane)
+
+        # ---- advance cursor / bookkeeping ------------------------------
+        eob_pos = cur[:, -1]
+        eob_L = jnp.take_along_axis(L, eob_pos[:, None], axis=1)[:, 0]
+        nxt_bit = jnp.where(
+            btype == 0,
+            (sb + 4) * 8 + s_len * 8,
+            eob_pos + eob_L,
+        )
+        out_base = out_base + jnp.where(
+            live, jnp.where(stored, s_len, total), 0
+        )
+        done = done | (live & bfinal)
+        bitpos = jnp.where(live, nxt_bit, bitpos)
+
+    ok = ok & done & (out_base == isizes) & (isizes <= OUT)
+
+    # ---- member-wide LZ77 copy resolution (spans blocks) ---------------
+    covered = j < out_base[:, None]
+    src = jnp.where(
+        lit_plane | ~covered,
+        j,
+        off_plane - dst_plane + ((j - off_plane) % dst_plane),
+    )
+    ok = ok & ~jnp.any(covered & (src < 0), axis=1)
+    src = jnp.clip(src, 0, OUT - 1)
+    val0 = jnp.where(lit_plane, val_plane, 0).astype(jnp.uint8)
+    ptr = src
+    for _ in range(max(1, int(OUT - 1).bit_length())):
+        ptr = jnp.take_along_axis(ptr, ptr, axis=1)
+    out = jnp.take_along_axis(val0, ptr, axis=1)
+    out = jnp.where(covered, out, 0)
+    return out, ok
+
+
+# --------------------------------------------------------------------------
 # Host wrappers: full BGZF streams ↔ device codec, with framing + CRC here.
 # --------------------------------------------------------------------------
 
@@ -560,10 +956,15 @@ def bgzf_decompress_device(
 ) -> bytes:
     """Decompress a whole BGZF stream, batching members onto the device.
 
-    Members are grouped by DEFLATE flavor: stored and all-fixed members run
-    on device; dynamic-Huffman members (zlib level ≥1 output) fall back to
-    the native host tier — same data, same result, tiered like the split
-    planner (BAMInputFormat.java:244-258)."""
+    Members are grouped by first-block DEFLATE flavor and dispatched to the
+    matching device kernel — ``inflate_stored`` / ``inflate_fixed`` /
+    ``inflate_dynamic`` (the general decoder; real zlib output at level ≥1
+    is dynamic-Huffman and decodes on device).  A member whose specialized
+    kernel rejects it (mixed block flavors) retries through the general
+    decoder, and only a member the device cannot decode at all tiers down
+    to native host zlib — same data, same result, tiered like the split
+    planner (BAMInputFormat.java:244-258).  ``_force_no_host`` turns that
+    last tier into an error (device-only mode, used by tests)."""
     from .. import native
 
     raw = np.frombuffer(data, dtype=np.uint8) if not isinstance(
@@ -579,7 +980,7 @@ def bgzf_decompress_device(
     xlen = raw[co64 + 10].astype(np.int32) | (
         raw[co64 + 11].astype(np.int32) << 8
     )
-    groups: dict = {"stored": [], "fixed": [], "host": []}
+    groups: dict = {"stored": [], "fixed": [], "dyn": []}
     for i in range(nblk):
         # Empty member (e.g. the 28-byte EOF terminator): an empty DEFLATE
         # payload is ≤2 bytes, so cs ≤ 22+xlen — short-circuit, no kernel.
@@ -593,21 +994,11 @@ def bgzf_decompress_device(
         elif hdr3 in (2, 3):
             groups["fixed"].append(i)
         else:
-            groups["host"].append(i)
-    if groups["host"] and _force_no_host:
-        raise bgzf.BgzfError("dynamic-Huffman member in device-only mode")
-    if groups["host"]:
-        idx = groups["host"]
-        out_h, offs = native.inflate_blocks(
-            raw,
-            np.asarray([co[i] for i in idx], dtype=np.int64),
-            np.asarray([cs[i] for i in idx], dtype=np.int32),
-            np.asarray([us[i] for i in idx], dtype=np.int32),
-            check_crc=check_crc,
-        )
-        for k, i in enumerate(idx):
-            outs[i] = out_h[int(offs[k]) : int(offs[k + 1])].tobytes()
-    for kind in ("stored", "fixed"):
+            # Dynamic-Huffman first block (zlib level ≥1, i.e. essentially
+            # every real-world BAM): the device decoder builds the
+            # canonical tables per member/block on chip.
+            groups["dyn"].append(i)
+    for kind in ("stored", "fixed", "dyn"):
         idx = groups[kind]
         if not idx:
             continue
@@ -619,11 +1010,15 @@ def bgzf_decompress_device(
         isz = np.asarray([us[i] for i in idx], dtype=np.int32)
         C = _pow2_at_least(int(clens.max()), 512)
         OUT = _pow2_at_least(int(isz.max()) if len(isz) else 1, 1024)
-        fn = inflate_stored if kind == "stored" else inflate_fixed
+        fn = {
+            "stored": inflate_stored,
+            "fixed": inflate_fixed,
+            "dyn": inflate_dynamic,
+        }[kind]
         # Cap the members per kernel launch: bounded HBM footprint AND the
         # TPU gather-index precision limit, on BOTH the bit-position
         # (C*8) and output-byte (OUT) gather extents.
-        widest = max(C * 8 if kind == "fixed" else C, OUT)
+        widest = max(C * 8 if kind != "stored" else C, OUT)
         step = max(1, _MAX_LAUNCH_ELEMS // widest)
         for g0 in range(0, len(idx), step):
             gi = idx[g0 : g0 + step]
@@ -652,15 +1047,20 @@ def bgzf_decompress_device(
             for k, i in enumerate(gi):
                 if ok[k]:
                     outs[i] = out_d[k, : gz[k]].tobytes()
+                elif kind != "dyn":
+                    # Routing by the first block's btype is best-effort:
+                    # zlib may mix block flavors inside one member (e.g. a
+                    # fixed or stored first block followed by dynamic
+                    # ones).  The general decoder handles any mix — retry
+                    # there, still on device.
+                    groups["dyn"].append(i)
                 elif _force_no_host:
                     raise bgzf.BgzfError(
                         f"device inflate failed for member at offset {co[i]}"
                     )
                 else:
-                    # Routing by the first block's btype is best-effort:
-                    # zlib may mix block flavors inside one member (e.g.
-                    # stored then dynamic).  Tier down to the host codec
-                    # for just this member.
+                    # Device tiers down to the host codec for just this
+                    # member (raises if the data itself is corrupt).
                     member = raw[int(co[i]) : int(co[i]) + int(cs[i])]
                     payload, _ = bgzf.inflate_block(
                         member.tobytes(), 0, check_crc
