@@ -55,6 +55,36 @@ def soa_decode_device(data: jax.Array, offsets: jax.Array) -> Dict[str, jax.Arra
     }
 
 
+def parse_stream_device(data, n_bytes=None, interpret=None):
+    """Full on-device BAM parse: record-boundary scan → fixed-field SoA →
+    64-bit sort keys, with NO host pass over the uncompressed stream
+    (SURVEY §7 stage 4; the host ``hbam_record_chain`` walk replaced by the
+    Pallas chain kernel with cross-chunk carry).
+
+    ``data``: uint8 record stream (device or host array).  Returns
+    ``(soa, hi, lo, valid, ok)`` — SoA columns and key halves are padded to
+    the chain kernel's capacity; ``valid`` masks live rows; ``ok`` is False
+    on a misaligned/truncated chain.  Unmapped-read keys use the murmur3
+    hash column convention of :func:`ops.keys.make_keys` (hash32 = 0 here;
+    callers needing reference-exact unmapped ordering supply the hash
+    column separately — the mapped-key fast path is what the sort needs).
+    """
+    from .keys import make_keys
+    from .pallas.chain import record_chain_device
+
+    a = jnp.asarray(data, dtype=jnp.uint8)
+    offs, count, ok = record_chain_device(a, n_bytes, interpret=interpret)
+    valid = jnp.arange(offs.shape[0], dtype=jnp.int32) < count
+    # Clip padded rows to offset 0 (in bounds, masked by ``valid``).
+    offs = jnp.where(valid, offs, 0)
+    if a.shape[0] < 36:  # minimum one fixed-field record for the gathers
+        a = jnp.pad(a, (0, 36 - a.shape[0]))
+    soa = soa_decode_device(a, offs)
+    hash32 = jnp.zeros(offs.shape, jnp.int32)
+    hi, lo = make_keys(soa["refid"], soa["pos"], soa["flag"], hash32)
+    return soa, hi, lo, valid, ok
+
+
 def pad_offsets(offsets, batch: int):
     """Pad an offsets array to ``batch`` rows; returns (padded, valid mask).
 

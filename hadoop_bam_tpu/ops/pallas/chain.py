@@ -1,0 +1,198 @@
+"""Pallas TPU kernel: BAM record-boundary chain over an uncompressed stream.
+
+SURVEY §7 stage 4: records are ``[u32 block_size][body]`` back to back, so
+boundary discovery is the sequential walk ``pos += 4 + u32(pos)`` — the one
+step the vectorized SoA decode could not do on device (the host C++
+``hbam_record_chain`` filled in).  This kernel runs the walk on-chip:
+
+- the stream is processed in fixed chunks; each chunk is one
+  ``pallas_call`` whose scalar carry (``cursor``) enters/leaves through
+  SMEM, so a record spanning chunks resumes exactly where the previous
+  chunk stopped (the "cross-tile carry" of the survey's prefix-scan
+  formulation — the carry IS the scan state, and chunks pipeline back to
+  back on the sequential TPU grid);
+- inside a chunk the walk is a ``lax.while_loop`` of scalar VMEM loads:
+  the u32 size word at an arbitrary byte offset is two aligned word loads
+  recombined with shifts (TPU VMEM has no byte-granular addressing);
+- offsets of records *starting* in the chunk append to a VMEM output
+  block through a dynamic scalar store.
+
+The walk is latency-bound scalar work (~one dependent VMEM load per
+record), not VPU work — but one record is ~100+ bytes, so at ns-class VMEM
+latency the kernel paces GB/s-of-stream class and the boundary pass never
+leaves the chip.  Oracle: ``spec.bam.record_offsets``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: Bytes of stream walked per pallas_call.  VMEM footprint per call is
+#: CHUNK (words) + CHUNK//9 (offsets) — well under the ~16MiB budget.
+CHUNK = 4 << 20
+#: A record is ≥ 36 bytes (u32 size + 32-byte fixed fields), so a chunk
+#: can start at most CHUNK//36 records — pad to a lane-aligned bound.
+MAX_REC_PER_CHUNK = -(-(CHUNK // 36 + 8) // 128) * 128
+_MIN_BODY = 32  # BAM fixed fields; a smaller size word is corruption
+
+
+def _chain_kernel(
+    cursor_in_ref,  # SMEM (1,) int32: absolute resume cursor
+    base_ref,  # SMEM (1,) int32: absolute byte offset of this chunk
+    limit_ref,  # SMEM (1,) int32: absolute end of record starts (chunk end
+    #             or stream end, whichever is smaller)
+    words_ref,  # VMEM [rows, 128] int32: chunk bytes (+margin) as words
+    offs_ref,  # VMEM [MAX_REC_PER_CHUNK//128, 128] int32 out: starts (abs)
+    count_ref,  # SMEM (1,) int32 out
+    cursor_out_ref,  # SMEM (1,) int32 out: resume cursor (abs)
+    err_ref,  # SMEM (1,) int32 out: 1 on implausible size word
+):
+    """TPU VMEM has no scalar random access, so the walk uses the
+    vector-native moves: the u32 size word at an arbitrary byte offset is
+    a dynamic *row-pair* load from the [rows, 128]-word layout followed by
+    masked lane extraction, and offsets accumulate in a register-carried
+    [1, 128] buffer whose current row is flushed with an aligned full-row
+    store each step (no read-modify-write)."""
+    base = base_ref[0]
+    limit = limit_ref[0]
+    lane2 = lax.broadcasted_iota(jnp.int32, (2, 128), 1)
+    row2 = lax.broadcasted_iota(jnp.int32, (2, 128), 0)
+    lane1 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+
+    def u32_at(abs_off):
+        off = abs_off - base
+        wi = off >> 2
+        r = wi >> 7
+        rows = words_ref[pl.ds(r, 2), :]  # [2, 128]
+
+        def word(widx):
+            rr = (widx >> 7) - r
+            ll = widx & 127
+            return jnp.sum(
+                jnp.where((row2 == rr) & (lane2 == ll), rows, 0)
+            )
+
+        w0 = word(wi).astype(jnp.uint32)
+        w1 = word(wi + 1).astype(jnp.uint32)
+        sh = ((off & 3) << 3).astype(jnp.uint32)
+        lo = w0 >> sh
+        hi = jnp.where(sh == 0, jnp.uint32(0), w1 << (32 - sh))
+        return (lo | hi).astype(jnp.int32)
+
+    def cond(state):
+        cur, n, err, _ = state
+        return (cur < limit) & (err == 0) & (n < MAX_REC_PER_CHUNK)
+
+    def body(state):
+        cur, n, err, buf = state
+        bs = u32_at(cur)
+        bad = (bs < _MIN_BODY) | (bs > (1 << 28))
+        buf = jnp.where(lane1 == (n & 127), cur, buf)
+        offs_ref[pl.ds(n >> 7, 1), :] = buf
+        nxt = jnp.where(bad, limit, cur + 4 + bs)
+        return nxt, n + jnp.where(bad, 0, 1), err | bad.astype(jnp.int32), buf
+
+    cur0 = cursor_in_ref[0]
+    buf0 = jnp.zeros((1, 128), jnp.int32)
+    cur, n, err, _ = lax.while_loop(
+        cond, body, (cur0, jnp.int32(0), jnp.int32(0), buf0)
+    )
+    count_ref[0] = n
+    cursor_out_ref[0] = cur
+    err_ref[0] = err | jnp.int32(n >= MAX_REC_PER_CHUNK)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _chain_chunk(cursor, base, limit, words, interpret: bool = False):
+    return pl.pallas_call(
+        _chain_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((MAX_REC_PER_CHUNK // 128, 128), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+        interpret=interpret,
+    )(cursor, base, limit, words)
+
+
+@functools.partial(jax.jit, static_argnames=("n_chunks", "interpret"))
+def _chain_all(stream_words, n_bytes, n_chunks: int, interpret: bool):
+    """Run the chunk kernel over the whole stream, carrying the cursor."""
+    WPC = CHUNK // 4
+    cursor = jnp.zeros((1,), jnp.int32)
+    offs_parts = []
+    counts = []
+    err_any = jnp.int32(0)
+    for k in range(n_chunks):
+        base = jnp.full((1,), k * CHUNK, jnp.int32)
+        limit = jnp.minimum(jnp.int32((k + 1) * CHUNK), n_bytes)
+        words = lax.dynamic_slice(
+            stream_words, (k * WPC,), (WPC + 256,)
+        ).reshape(-1, 128)
+        offs, count, cursor, err = _chain_chunk(
+            cursor, base, limit[None], words, interpret=interpret
+        )
+        offs_parts.append(offs.reshape(-1))
+        counts.append(count[0])
+        err_any = err_any | err[0]
+    counts = jnp.stack(counts)
+    # Flatten the per-chunk offset blocks into one packed array: output
+    # slot t belongs to chunk k = searchsorted(cum, t), local index
+    # t - cum[k-1] (gather-form compaction, no scatter).
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    stacked = jnp.stack(offs_parts)  # [K, MAXR]
+    t = jnp.arange(n_chunks * MAX_REC_PER_CHUNK, dtype=jnp.int32)
+    k_of_t = jnp.searchsorted(cum, t, side="right").astype(jnp.int32)
+    k_c = jnp.clip(k_of_t, 0, n_chunks - 1)
+    local = t - jnp.where(k_c > 0, cum[k_c - 1], 0)
+    flat = stacked[
+        k_c, jnp.clip(local, 0, MAX_REC_PER_CHUNK - 1)
+    ]
+    flat = jnp.where(t < total, flat, 0)
+    ok = (err_any == 0) & (cursor[0] == n_bytes)
+    return flat, total, ok
+
+
+def record_chain_device(stream, n_bytes=None, interpret=None):
+    """Record-start offsets of a BAM record stream, computed on device.
+
+    ``stream``: uint8 array (device or host) holding ``n_bytes`` of
+    back-to-back records.  Returns ``(offsets int32[cap], count, ok)`` —
+    ``offsets[:count]`` equals ``spec.bam.record_offsets``; ``ok`` is False
+    on a truncated/misaligned chain (caller falls back / raises).
+    """
+    a = jnp.asarray(stream, dtype=jnp.uint8)
+    n = int(a.shape[0]) if n_bytes is None else int(n_bytes)
+    n_chunks = max(1, -(-n // CHUNK))
+    nbytes_pad = n_chunks * CHUNK + 256 * 4
+    pad = nbytes_pad - a.shape[0]
+    if pad > 0:
+        a = jnp.pad(a, (0, pad))
+    words = lax.bitcast_convert_type(
+        a[:nbytes_pad].reshape(-1, 4), jnp.int32
+    ).reshape(-1)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _chain_all(
+        words, jnp.int32(n), n_chunks, bool(interpret)
+    )

@@ -1,0 +1,91 @@
+"""Pallas record-boundary chain kernel vs the spec oracle.
+
+VERDICT r1 weak #4 / SURVEY §7 stage 4: the record chain walk must run on
+device (cross-chunk carry), oracle-equal to ``spec.bam.record_offsets`` —
+so decode→key→sort needs no host pass over the uncompressed stream.
+Runs in interpreter mode on the CPU mesh (conftest forces CPU); the same
+kernel is TPU-verified by ``tests/test_tpu_e2e.py``.
+"""
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.ops.decode import parse_stream_device
+from hadoop_bam_tpu.ops.keys import pack_keys_np
+from hadoop_bam_tpu.ops.pallas import chain
+from hadoop_bam_tpu.spec import bam
+
+
+def _stream(n, seed=0, with_unmapped=False):
+    rng = np.random.default_rng(seed)
+    blob = bytearray()
+    for i in range(n):
+        unmapped = with_unmapped and i % 11 == 0
+        blob += bam.build_record(
+            f"r{i:06d}",
+            -1 if unmapped else int(rng.integers(0, 3)),
+            -1 if unmapped else int(rng.integers(0, 1 << 26)),
+            60,
+            bam.FLAG_UNMAPPED if unmapped else 0,
+            [] if unmapped else [(int(rng.integers(30, 150)), "M")],
+            "ACGT" * 15,
+            bytes([30] * 60),
+        ).encode()
+    return np.frombuffer(bytes(blob), np.uint8)
+
+
+def test_chain_matches_oracle():
+    s = _stream(2500, seed=1)
+    oracle = bam.record_offsets(s, 0)
+    offs, total, ok = chain.record_chain_device(s)
+    assert bool(ok)
+    assert int(total) == len(oracle)
+    assert np.array_equal(np.asarray(offs)[: len(oracle)], oracle)
+
+
+def test_chain_cross_chunk_carry(monkeypatch):
+    # Force tiny chunks so records straddle chunk boundaries and the SMEM
+    # cursor carry is what keeps the walk aligned.
+    monkeypatch.setattr(chain, "CHUNK", 4096)
+    monkeypatch.setattr(chain, "MAX_REC_PER_CHUNK", 256)
+    s = _stream(400, seed=2)
+    oracle = bam.record_offsets(s, 0)
+    offs, total, ok = chain.record_chain_device(s)
+    assert bool(ok) and int(total) == len(oracle)
+    assert np.array_equal(np.asarray(offs)[: len(oracle)], oracle)
+
+
+def test_truncated_and_corrupt_rejected():
+    s = _stream(300, seed=3)
+    _, _, ok = chain.record_chain_device(s[:-5])
+    assert not bool(ok)
+    bad = s.copy()
+    bad[:4] = [1, 0, 0, 0]  # size word < fixed-field minimum
+    _, _, ok = chain.record_chain_device(bad)
+    assert not bool(ok)
+
+
+def test_empty_stream():
+    offs, total, ok = chain.record_chain_device(
+        np.empty(0, np.uint8)
+    )
+    assert bool(ok) and int(total) == 0
+
+
+def test_parse_stream_device_end_to_end():
+    # stream → chain → SoA → keys, all device ops; keys equal the host
+    # oracle for mapped records.
+    s = _stream(1200, seed=4)
+    oracle_offs = bam.record_offsets(s, 0)
+    soa_h = bam.soa_decode(s, oracle_offs)
+    keys_h = bam.soa_keys(soa_h, s)
+    soa, hi, lo, valid, ok = parse_stream_device(s)
+    assert bool(ok)
+    n = int(np.asarray(valid).sum())
+    assert n == len(oracle_offs)
+    for col in ("refid", "pos", "flag", "rec_len"):
+        assert np.array_equal(
+            np.asarray(soa[col])[:n], np.asarray(soa_h[col])
+        ), col
+    got = pack_keys_np(np.asarray(hi)[:n], np.asarray(lo)[:n])
+    assert np.array_equal(got, keys_h)

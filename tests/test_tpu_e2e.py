@@ -50,6 +50,28 @@ keys = np.concatenate(
 assert len(keys) == n, (len(keys), n)
 assert np.all(keys[:-1] <= keys[1:])
 print("TPU_E2E_OK n=%d" % n)
+
+# Pallas record-chain kernel on the real chip (interpret=False), oracle-equal.
+from hadoop_bam_tpu.ops.decode import parse_stream_device
+from hadoop_bam_tpu.ops.keys import pack_keys_np
+from hadoop_bam_tpu.spec import bam
+rng = np.random.default_rng(5)
+blob = bytearray()
+for i in range(3000):
+    blob += bam.build_record(
+        "r%06d" % i, int(rng.integers(0, 3)), int(rng.integers(0, 1 << 26)),
+        60, 0, [(100, "M")], "ACGT" * 25, bytes([30] * 100)
+    ).encode()
+stream = np.frombuffer(bytes(blob), np.uint8)
+oracle = bam.record_offsets(stream, 0)
+soa, hi, lo, valid, ok = parse_stream_device(stream, interpret=False)
+assert bool(np.asarray(ok))
+nv = int(np.asarray(valid).sum())
+assert nv == len(oracle), (nv, len(oracle))
+keys_h = bam.soa_keys(bam.soa_decode(stream, oracle), stream)
+got = pack_keys_np(np.asarray(hi)[:nv], np.asarray(lo)[:nv])
+assert np.array_equal(got, keys_h)
+print("TPU_CHAIN_OK n=%d" % nv)
 """
 
 
