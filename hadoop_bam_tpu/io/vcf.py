@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import gzip
 import os
-from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -72,19 +71,39 @@ def sniff_vcf_format(path: str, trust_exts: bool = True) -> Optional[str]:
     return None
 
 
-@dataclass
 class VariantBatch:
-    """Decoded split: variants + int64 keys (SoA columns for device use)."""
+    """Decoded split: int64 key/pos/end SoA columns for device use, with
+    the per-row ``VariantContext`` objects materialized lazily — the sort
+    and interval paths touch only the columns, so the per-line Python
+    parse never runs for them (the LazyBAMRecord stance applied to VCF)."""
 
-    header: VcfHeader
-    variants: List[VariantContext]
-    keys: np.ndarray  # int64
-    pos: np.ndarray  # int64 1-based starts
-    end: np.ndarray  # int64 inclusive ends
+    def __init__(
+        self,
+        header: VcfHeader,
+        variants: Optional[List[VariantContext]] = None,
+        keys: Optional[np.ndarray] = None,
+        pos: Optional[np.ndarray] = None,
+        end: Optional[np.ndarray] = None,
+        materializer=None,
+    ):
+        self.header = header
+        self.keys = keys if keys is not None else np.empty(0, np.int64)
+        self.pos = pos if pos is not None else np.empty(0, np.int64)
+        self.end = end if end is not None else np.empty(0, np.int64)
+        self._variants = variants
+        self._materializer = materializer
+
+    @property
+    def variants(self) -> List[VariantContext]:
+        if self._variants is None:
+            self._variants = (
+                self._materializer() if self._materializer else []
+            )
+        return self._variants
 
     @property
     def n_records(self) -> int:
-        return len(self.variants)
+        return len(self.keys)
 
 
 class VcfInputFormat:
@@ -195,6 +214,9 @@ class VcfInputFormat:
         header = VcfHeader.parse(header_text)
         stringency = self._stringency()
         intervals = self._intervals()
+        fast = _read_vectorized(header, payload, lo, hi, intervals)
+        if fast is not None:
+            return fast
         reader = SplitLineReader(payload, lo, hi)
         variants: List[VariantContext] = []
         for _, line in reader.lines():
@@ -342,6 +364,251 @@ class VcfInputFormat:
         body = b"".join(mine)
         chunk = prev + body + extra
         return htext, chunk, len(prev), len(prev) + len(body)
+
+
+# Byte classes for the vectorized structural validation (exactly the
+# conditions parse_variant_line raises on; anything murkier bails to the
+# per-line path so error semantics — STRICT raise / LENIENT skip — stay
+# bit-identical).
+_ALT_OK = np.zeros(256, dtype=bool)
+for _c in b"ACGTNacgtn*.0123456789_=-,":
+    _ALT_OK[_c] = True
+# Symbolic-allele / breakend markers: fields containing these fall back to
+# the exact per-token parser (token-level validation doesn't vectorize).
+_ALT_SYM = np.zeros(256, dtype=bool)
+for _c in b"<>[]:":
+    _ALT_SYM[_c] = True
+_QUAL_OK = np.zeros(256, dtype=bool)
+for _c in b"0123456789.":
+    _QUAL_OK[_c] = True
+del _c
+
+
+def _read_vectorized(
+    header: VcfHeader,
+    payload: bytes,
+    lo: int,
+    hi: int,
+    intervals,
+) -> Optional["VariantBatch"]:
+    """One-pass vectorized tokenizer for the VCF hot path (SURVEY §7
+    stage 8): a newline scan builds the line table, one tab scan builds the
+    8-column field table, and CHROM→contig-index, POS, REF-length and the
+    64-bit keys come out as array ops — no per-line Python.
+
+    Returns None when any line needs the exact per-line parser: structural
+    problems (missing tabs, non-digit POS, unusual QUAL/ALT syntax) or a
+    CHROM outside the header dictionary (murmur3 key fallback).  The
+    VariantContext rows themselves stay lazy (materialized from the line
+    table only if a consumer asks)."""
+    from .text import MAX_LINE_LENGTH, gather_padded, line_table
+
+    a = np.frombuffer(payload, np.uint8)
+    if lo > 0:
+        # Split resync: drop the (possibly partial) first line, exactly as
+        # SplitLineReader does — a mid-line fragment can otherwise pass
+        # the structural screen and emit a spurious variant.
+        nl = payload.find(b"\n", lo - 1)
+        lo = len(payload) if nl < 0 else nl + 1
+        if lo >= hi:
+            return VariantBatch(header=header)
+    starts, lens = line_table(a, lo, hi)
+    keep = (lens > 0) & (a[np.minimum(starts, len(a) - 1)] != 0x23)
+    starts, lens = starts[keep], lens[keep]
+    n = len(starts)
+    if n == 0:
+        return VariantBatch(header=header)
+    line_end = starts + lens
+    # A line cut off by line_table's bounded scan window (giant-cohort
+    # rows) must not be materialized half-parsed: bail to the exact path,
+    # whose reader walks to the real newline.
+    window_end = min(len(a), hi + 4 * (MAX_LINE_LENGTH + 1))
+    if window_end < len(a) and bool((line_end >= window_end).any()):
+        return None
+
+    # ---- field table: the k-th tab of line i ---------------------------
+    wlo, whi = int(starts[0]), int(line_end.max())
+    tabs = wlo + np.nonzero(a[wlo:whi] == 0x09)[0]
+    t0 = np.searchsorted(tabs, starts)
+    tk = t0[:, None] + np.arange(7)
+    if len(tabs) == 0:
+        return None
+    exists = tk < len(tabs)
+    T = tabs[np.minimum(tk, len(tabs) - 1)]
+    if not (exists & (T < line_end[:, None])).all():
+        return None  # a line with < 8 fields: exact error text needed
+    fs = np.concatenate([starts[:, None], T + 1], axis=1)  # field starts
+    # INFO ends at the 8th tab when genotype columns follow, else line end.
+    tk7 = t0 + 7
+    has8 = (tk7 < len(tabs)) & (
+        tabs[np.minimum(tk7, len(tabs) - 1)] < line_end
+    )
+    info_end = np.where(
+        has8, tabs[np.minimum(tk7, len(tabs) - 1)], line_end
+    )
+    fe = np.concatenate([T, info_end[:, None]], axis=1)  # field ends
+    flen = fe - fs
+
+    if (flen[:, 0] == 0).any() or (flen[:, 3] == 0).any():
+        return None  # empty CHROM/REF
+
+    # ---- POS: strict [0-9]{1,10} --------------------------------------
+    plen = flen[:, 1]
+    if (plen == 0).any() or (plen > 10).any():
+        return None
+    pmat = gather_padded(a, fs[:, 1], plen, int(plen.max()))
+    pdig = pmat - 48
+    col = np.arange(pmat.shape[1])[None, :]
+    pvalid = col < plen[:, None]
+    if ((pdig < 0) | (pdig > 9))[pvalid].any():
+        return None
+    pos = np.zeros(n, dtype=np.int64)
+    for c in range(pmat.shape[1]):
+        live = pvalid[:, c]
+        pos = np.where(live, pos * 10 + pdig[:, c], pos)
+
+    # ---- QUAL: '.' or empty or [0-9]+(.[0-9]*)? ------------------------
+    qlen = flen[:, 5]
+    W = int(qlen.max()) if n else 0
+    if W:
+        qmat = gather_padded(a, fs[:, 5], qlen, W)
+        qcol = np.arange(W)[None, :]
+        qvalid = qcol < qlen[:, None]
+        is_dot = (qlen == 1) & (qmat[:, 0] == 0x2E)
+        plain = qlen == 0
+        charset = (~qvalid | _QUAL_OK[qmat]).all(axis=1)
+        ndots = ((qmat == 0x2E) & qvalid).sum(axis=1)
+        ndigs = ((qmat >= 48) & (qmat <= 57) & qvalid).sum(axis=1)
+        numeric = charset & (ndots <= 1) & (ndigs >= 1)
+        if not (is_dot | plain | numeric).all():
+            return None
+
+    # ---- ALT charset (incl. ',' separators), no empty tokens -----------
+    alen = flen[:, 4]
+    Wa = int(alen.max()) if n else 0
+    if Wa:
+        amat = gather_padded(a, fs[:, 4], alen, Wa)
+        acol = np.arange(Wa)[None, :]
+        avalid = acol < alen[:, None]
+        if (avalid & _ALT_SYM[amat]).any():
+            return None  # symbolic/breakend alleles: exact token parser
+        if not (~avalid | _ALT_OK[amat]).all():
+            return None
+        comma = (amat == 0x2C) & avalid
+        if comma.any():
+            # reject ',,', leading/trailing comma → exact parser decides
+            nxt = np.pad(comma[:, 1:], ((0, 0), (0, 1)))
+            edge = comma[:, 0:1].any(axis=1) | (
+                comma & (acol == (alen - 1)[:, None])
+            ).any(axis=1)
+            if (comma & nxt).any() or edge.any():
+                return None
+        if (alen == 0).any():
+            return None
+
+    # ---- CHROM → contig index (all must be in the header dict) ---------
+    # A split holds few distinct CHROMs; unique-ify the padded rows once
+    # and do one dict lookup per distinct name (a per-contig matrix
+    # compare would be O(contigs·lines·width) — GRCh38 headers carry
+    # thousands of contig lines).
+    if not header.contigs:
+        return None
+    clen = flen[:, 0]
+    Wc = int(clen.max())
+    cmat = gather_padded(a, fs[:, 0], clen, Wc)
+    if Wc <= 16:
+        # Pack each padded row into 1-2 machine words: scalar np.unique is
+        # an order of magnitude faster than the axis=0 (row-sort) form.
+        packed = np.zeros((n, 16), np.uint8)
+        packed[:, :Wc] = cmat
+        key2 = packed.view(np.uint64).reshape(n, 2)
+        uniq, inv = np.unique(
+            key2[:, 0] ^ (key2[:, 1] * np.uint64(0x9E3779B97F4A7C15)),
+            return_inverse=True,
+        )
+        # The xor-mix is only a bucketing key; recover each bucket's name
+        # from its first row (collisions across distinct names are broken
+        # by re-checking the name text below).
+        first_row = np.zeros(len(uniq), np.int64)
+        first_row[inv[::-1]] = np.arange(n - 1, -1, -1)
+        names = [
+            bytes(cmat[r]).rstrip(b"\x00").decode(errors="replace")
+            for r in first_row
+        ]
+        # Guard against (astronomically unlikely) mix collisions: every
+        # row in a bucket must equal the bucket's representative row.
+        if not (cmat == cmat[first_row[inv]]).all():
+            return None
+    else:
+        uniq_rows, inv = np.unique(cmat, axis=0, return_inverse=True)
+        names = [
+            bytes(u).rstrip(b"\x00").decode(errors="replace")
+            for u in uniq_rows
+        ]
+    lut = np.empty(len(names), dtype=np.int64)
+    for u, name in enumerate(names):
+        idx = header._contig_idx.get(name)
+        if idx is None:
+            return None  # unknown contig: murmur3 key path, exact parser
+        lut[u] = idx
+    cidx = lut[inv]
+
+    # ---- END: pos + len(REF) - 1, with the INFO END= override ----------
+    end = pos + flen[:, 3].astype(np.int64) - 1
+    # Lines whose INFO contains an END= key (at the field start or after
+    # ';') re-derive end through the exact parser — rare (SV records).
+    # Scan only the split's byte window (INFO fields can't point outside).
+    w = a[wlo : int(line_end.max())]
+    if len(w) >= 4:
+        m4 = (
+            (w[:-3] == 0x45) & (w[1:-2] == 0x4E)
+            & (w[2:-1] == 0x44) & (w[3:] == 0x3D)
+        )
+        hits = wlo + np.nonzero(m4)[0]
+    else:
+        hits = np.empty(0, np.int64)
+    if len(hits):
+        i0 = np.searchsorted(hits, fs[:, 7])
+        i1 = np.searchsorted(hits, fe[:, 7] - 3)
+        flagged = np.nonzero(i1 > i0)[0]
+        for r in flagged:
+            line = bytes(a[starts[r] : line_end[r]]).decode()
+            try:
+                end[r] = parse_variant_line(line).end
+            except FormatException:
+                return None
+
+    keys = (cidx << np.int64(32)) | np.int64(1) * (pos - 1)
+
+    if intervals is not None:
+        ivkeep = np.zeros(n, dtype=bool)
+        for iv in intervals:
+            iv_idx = header._contig_idx.get(iv.contig)
+            if iv_idx is None:
+                continue  # known-contig lines can't string-match it
+            ivkeep |= (
+                (cidx == iv_idx) & (pos <= iv.end) & (end >= iv.start)
+            )
+        starts, line_end = starts[ivkeep], line_end[ivkeep]
+        keys, pos, end = keys[ivkeep], pos[ivkeep], end[ivkeep]
+
+    l_starts = starts.copy()
+    l_ends = line_end.copy()
+
+    def materialize() -> List[VariantContext]:
+        mv = memoryview(payload)
+        return [
+            parse_variant_line(str(mv[int(s) : int(e)], "utf-8"))
+            for s, e in zip(l_starts, l_ends)
+        ]
+
+    return VariantBatch(
+        header=header,
+        keys=keys.astype(np.int64),
+        pos=pos.astype(np.int64),
+        end=end.astype(np.int64),
+        materializer=materialize,
+    )
 
 
 def _header_prefix_text(path: str) -> str:

@@ -146,7 +146,14 @@ def parse_variant_line(line: str) -> VariantContext:
             raise FormatException(f"non-numeric QUAL {qual_s!r}")
     alts = [] if alt in (".", "") else alt.split(",")
     for a in alts:
-        if not re.fullmatch(r"[ACGTNacgtn*.<>\[\]:0-9_=-]+", a):
+        # Symbolic alleles (<DEL>, <INS:ME>…) and breakend notation allow
+        # arbitrary letters in their IDs / mate coordinates (VCF 4.2
+        # §1.4.5); plain tokens stay restricted to base strings.
+        if re.search(r"[<>\[\]:]", a):
+            ok = re.fullmatch(r"[A-Za-z0-9_.:<>\[\]=*-]+", a)
+        else:
+            ok = re.fullmatch(r"[ACGTNacgtn*.0-9_=-]+", a)
+        if not ok:
             raise FormatException(f"malformed ALT allele {a!r}")
     filters = [] if filt in (".", "") else filt.split(";")
     genotypes_raw = "\t".join(fields[8:]) if len(fields) > 8 else ""

@@ -220,3 +220,90 @@ class TestFasta:
         fai_line = open(R + "auxf.fa.fai").readline().split("\t")
         assert batch.contig == fai_line[0]
         assert len(batch.bases) == int(fai_line[1])
+
+
+class TestVectorizedVcfTokenizer:
+    """The vectorized line/field tokenizer (SURVEY §7 stage 8) must be
+    invisible: identical keys/pos/end and identical materialized variants
+    to the per-line parser, with exact fallback on anything unusual."""
+
+    HEAD = (
+        "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=1000000>\n"
+        "##contig=<ID=chr2,length=500000>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+
+    def _both(self, text):
+        import hadoop_bam_tpu.io.vcf as vcfmod
+
+        data = text.encode()
+        fmt = VcfInputFormat()
+        sp = ByteSplit("<m>", 0, len(data))
+        fast = fmt.read_split(sp, data=data)
+        orig = vcfmod._read_vectorized
+        vcfmod._read_vectorized = lambda *a, **k: None
+        try:
+            slow = fmt.read_split(sp, data=data)
+        finally:
+            vcfmod._read_vectorized = orig
+        return fast, slow
+
+    def test_equality_with_loop_parser(self):
+        rows = "".join(
+            f"chr{1 + i % 2}\t{100 + 13 * i}\trs{i}\tACGT\tA,G\t"
+            f"{i % 60}.5\tPASS;q10\tDP={i}\tGT\t0/1\n"
+            for i in range(500)
+        )
+        fast, slow = self._both(self.HEAD + rows)
+        assert np.array_equal(fast.keys, slow.keys)
+        assert np.array_equal(fast.pos, slow.pos)
+        assert np.array_equal(fast.end, slow.end)
+        assert [v.format_line() for v in fast.variants] == [
+            v.format_line() for v in slow.variants
+        ]
+
+    def test_info_end_override(self):
+        rows = (
+            "chr1\t100\t.\tA\t<DEL>\t.\tPASS\tSVTYPE=DEL;END=5000\n"
+            "chr1\t200\t.\tACGT\tA\t.\tPASS\tDP=3\n"
+        )
+        fast, slow = self._both(self.HEAD + rows)
+        assert np.array_equal(fast.end, slow.end)
+        assert fast.end[0] == 5000 and fast.end[1] == 203
+
+    def test_unknown_contig_falls_back_to_murmur_path(self):
+        rows = "chrZ\t100\t.\tA\tG\t.\tPASS\t.\n"
+        fast, slow = self._both(self.HEAD + rows)
+        assert np.array_equal(fast.keys, slow.keys)
+        assert fast.keys[0] == slow.keys[0]
+
+    def test_variants_are_lazy(self):
+        rows = "chr1\t100\t.\tA\tG\t50\tPASS\t.\n" * 10
+        fast, _ = self._both(self.HEAD + rows)
+        assert fast._variants is None  # columns built, rows not parsed
+        assert len(fast.variants) == 10  # materializes on demand
+
+    def test_split_boundary_fragment_not_misparsed(self):
+        # '11' and '1' are both contigs; a boundary cutting the line
+        # '11\t...' after its first byte must not let the tail fragment
+        # '1\t...' pass as a spurious variant (the resync protocol).
+        head = (
+            "##fileformat=VCFv4.2\n##contig=<ID=1>\n##contig=<ID=11>\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        rows = "".join(
+            f"11\t{100 + i}\t.\tA\tG\t.\tPASS\t.\n" for i in range(50)
+        )
+        data = (head + rows).encode()
+        fmt = VcfInputFormat()
+        n_head = len(head.encode())
+        # Cut one byte into a mid-file line.
+        cut = data.index(b"\n11\t120", n_head) + 2
+        s1 = ByteSplit("<m>", 0, cut)
+        s2 = ByteSplit("<m>", cut, len(data) - cut)
+        b1 = fmt.read_split(s1, data=data)
+        b2 = fmt.read_split(s2, data=data)
+        whole = fmt.read_split(ByteSplit("<m>", 0, len(data)), data=data)
+        assert b1.n_records + b2.n_records == whole.n_records == 50
+        got = np.concatenate([b1.keys, b2.keys])
+        assert np.array_equal(got, whole.keys)
