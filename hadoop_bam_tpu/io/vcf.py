@@ -451,6 +451,13 @@ def _read_vectorized(
 
     if (flen[:, 0] == 0).any() or (flen[:, 3] == 0).any():
         return None  # empty CHROM/REF
+    # REF length feeds `end` in CHARACTERS (the exact parser's len(str));
+    # any non-ASCII byte would make byte length diverge — exact path.
+    rlen = flen[:, 3]
+    Wr = int(rlen.max())
+    rmat = gather_padded(a, fs[:, 3], rlen, Wr)
+    if (rmat >= 0x80).any():
+        return None
 
     # ---- POS: strict [0-9]{1,10} --------------------------------------
     plen = flen[:, 1]
@@ -578,7 +585,7 @@ def _read_vectorized(
             except FormatException:
                 return None
 
-    keys = (cidx << np.int64(32)) | np.int64(1) * (pos - 1)
+    keys = (cidx << np.int64(32)) | (pos - 1)
 
     if intervals is not None:
         ivkeep = np.zeros(n, dtype=bool)

@@ -176,8 +176,9 @@ def sort_bam(
 
     upload_every = max(1, -(-len(splits) // 4))  # ceil: ≤4 upload RPCs
     with span("sort_bam.read"):
-        for si, s in enumerate(splits):
-            b = fmt.read_split(s, fields=SORT_FIELDS)
+        for si, b in enumerate(
+            _read_splits_pipelined(fmt, splits, fields=SORT_FIELDS)
+        ):
             # Keys are computed; only the record extents stay live (the
             # other fixed-field columns would just inflate host peak).
             b.soa = {
@@ -301,6 +302,43 @@ def sort_bam(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )
     return SortStats(n_records=n, n_splits=len(splits), backend=backend)
+
+
+def _read_splits_pipelined(fmt, splits, fields=None, depth: Optional[int] = None):
+    """Yield decoded split batches in order, reading ahead in a small
+    thread pool — split N+1's file read + native inflate (both release the
+    GIL) overlap split N's downstream processing.  Round-1 weak #6: the
+    serial read loop left the host idle during every disk wait; on 1-core
+    hosts this degrades gracefully to the serial order."""
+    if depth is None:
+        depth = 2 if (os.cpu_count() or 1) > 1 else 1
+    if depth <= 1 or len(splits) <= 1:
+        for s in splits:
+            yield fmt.read_split(s, fields=fields)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=depth)
+    futs = [
+        pool.submit(fmt.read_split, s, fields=fields)
+        for s in splits[: depth + 1]
+    ]
+    nxt = depth + 1
+    try:
+        for i in range(len(splits)):
+            b = futs[i].result()
+            if nxt < len(splits):
+                futs.append(
+                    pool.submit(fmt.read_split, splits[nxt], fields=fields)
+                )
+                nxt += 1
+            yield b
+    finally:
+        # On a decode error (or the consumer abandoning the generator),
+        # don't block on — or keep paying for — reads nobody will use.
+        for f in futs:
+            f.cancel()
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 class _LazyPermFetch:
@@ -428,8 +466,7 @@ def _sort_bam_external(
             acc_bytes = 0
 
         with span("sort_bam.spill"):
-            for s in splits:
-                b = fmt.read_split(s, fields=SORT_FIELDS)
+            for b in _read_splits_pipelined(fmt, splits, fields=SORT_FIELDS):
                 b.soa = {
                     "rec_off": b.soa["rec_off"],
                     "rec_len": b.soa["rec_len"],
