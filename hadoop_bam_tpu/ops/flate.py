@@ -307,6 +307,80 @@ def _token_tables():
     )
 
 
+# --------------------------------------------------------------------------
+# Machinery shared by the inflate kernels (fixed / dynamic): bit-window
+# reads, the pointer-doubling chain walk, token→output coverage, and the
+# member-wide LZ77 copy resolution.
+# --------------------------------------------------------------------------
+
+
+def _bit_window_fn(comp: jax.Array, pad: int = 8):
+    """Returns ``window(bitpos) -> uint32`` reading 32 stream bits at any
+    per-member bit offset (bitpos broadcastable to [B, ...])."""
+    B = comp.shape[0]
+    data = jnp.pad(comp, ((0, 0), (0, pad))).astype(jnp.uint32)
+
+    def window(bitpos):
+        bp = jnp.broadcast_to(bitpos, (B,) + bitpos.shape[1:])
+        flat = bp.reshape(B, -1)
+        bi = flat >> 3
+        s = (flat & 7).astype(jnp.uint32)
+        b0 = jnp.take_along_axis(data, bi, axis=1)
+        b1 = jnp.take_along_axis(data, bi + 1, axis=1)
+        b2 = jnp.take_along_axis(data, bi + 2, axis=1)
+        b3 = jnp.take_along_axis(data, bi + 3, axis=1)
+        w = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        return (w >> s).reshape(bp.shape)
+
+    return window
+
+
+def _chain_walk(nxt: jax.Array, start: jax.Array, T: int) -> jax.Array:
+    """Enumerate ``T`` chain positions from ``start`` through the jump map
+    ``nxt`` (gather-only pointer doubling; terminal tokens self-loop so
+    slots past the chain end stall there).  ``start``: int32 [B]."""
+    B, NB = nxt.shape
+    t = jnp.arange(T, dtype=jnp.int32)
+    cur = jnp.broadcast_to(
+        jnp.clip(start, 0, NB - 1)[:, None], (B, T)
+    )
+    jump = nxt
+    for k in range(max(1, int(T - 1).bit_length())):
+        stepped = jnp.take_along_axis(jump, cur, axis=1)
+        cur = jnp.where(((t >> k) & 1)[None, :] == 1, stepped, cur)
+        jump = jnp.take_along_axis(jump, jump, axis=1)
+    return cur
+
+
+def _coverage(cum_out: jax.Array, jj: jax.Array, T: int) -> jax.Array:
+    """Index of the chain slot covering each output position: output byte
+    ``jj`` belongs to the first token whose cumulative emit exceeds it
+    (cum_out is sorted — a batched binary search)."""
+    B = cum_out.shape[0]
+    cov = jax.vmap(partial(jnp.searchsorted, side="right"))(
+        cum_out, jnp.broadcast_to(jj, (B,) + jj.shape[1:])
+    ).astype(jnp.int32)
+    return jnp.clip(cov, 0, T - 1)
+
+
+def _lz77_resolve(lit_j, val_j, d_j, o_j, covered, j):
+    """Materialize all LZ77 copies with log-rounds pointer jumping.
+    Returns (out uint8, neg_src bool[B]) — ``neg_src`` flags copies
+    reaching before the stream start (invalid)."""
+    OUT = j.shape[1]
+    src = jnp.where(
+        lit_j | ~covered, j, o_j - d_j + ((j - o_j) % d_j)
+    )
+    neg = jnp.any(covered & (src < 0), axis=1)
+    src = jnp.clip(src, 0, OUT - 1)
+    val0 = jnp.where(lit_j, val_j, 0).astype(jnp.uint8)
+    ptr = src
+    for _ in range(max(1, int(OUT - 1).bit_length())):
+        ptr = jnp.take_along_axis(ptr, ptr, axis=1)
+    out = jnp.take_along_axis(val0, ptr, axis=1)
+    return jnp.where(covered, out, 0), neg
+
+
 @partial(jax.jit, static_argnums=(3, 4))
 def inflate_fixed(
     comp: jax.Array,
@@ -329,18 +403,8 @@ def inflate_fixed(
         _token_tables()
     )
     NB = C * 8
-    data = jnp.pad(comp, ((0, 0), (0, 4))).astype(jnp.uint32)
+    window = _bit_window_fn(comp)
     p = jnp.arange(NB, dtype=jnp.int32)[None, :]
-
-    def window(bitpos):
-        bi = bitpos >> 3
-        s = (bitpos & 7).astype(jnp.uint32)
-        b0 = jnp.take_along_axis(data, bi, axis=1)
-        b1 = jnp.take_along_axis(data, bi + 1, axis=1)
-        b2 = jnp.take_along_axis(data, bi + 2, axis=1)
-        b3 = jnp.take_along_axis(data, bi + 3, axis=1)
-        w = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
-        return w >> s
 
     w = window(p)
     t = litlen_t[(w & 511).astype(jnp.int32)]
@@ -397,13 +461,7 @@ def inflate_fixed(
     # walk).
     real_bits = NB if max_cbits is None else min(NB, max_cbits)
     T = out_bytes + real_bits // 10 + 8
-    t = jnp.arange(T, dtype=jnp.int32)
-    cur = jnp.full((B, T), 3, dtype=jnp.int32)
-    jump = nxt
-    for k in range(max(1, int(T - 1).bit_length())):
-        stepped = jnp.take_along_axis(jump, cur, axis=1)
-        cur = jnp.where(((t >> k) & 1)[None, :] == 1, stepped, cur)
-        jump = jnp.take_along_axis(jump, jump, axis=1)
+    cur = _chain_walk(nxt, jnp.full((B,), 3, jnp.int32), T)
 
     bad_t = jnp.take_along_axis(bad, cur, axis=1)
     term_t = jnp.take_along_axis(term, cur, axis=1)
@@ -414,29 +472,18 @@ def inflate_fixed(
     total = cum_out[:, -1]
     ok = ok & (total == isizes) & (total <= out_bytes)
 
-    # Output coverage: byte j belongs to the first token whose cumulative
-    # emit exceeds j (cum_out is sorted — a batched binary search).
+    # Output coverage + member-wide LZ77 resolution (shared machinery).
     OUT = out_bytes
     j = jnp.arange(OUT, dtype=jnp.int32)[None, :]
-    cov = jax.vmap(partial(jnp.searchsorted, side="right"))(
-        cum_out, jnp.broadcast_to(j, (B, OUT))
-    ).astype(jnp.int32)
-    cov = jnp.clip(cov, 0, T - 1)
+    cov = _coverage(cum_out, j, T)
     tp = jnp.take_along_axis(cur, cov, axis=1)  # bit pos of covering token
     covered = j < total[:, None]
     lit_j = jnp.take_along_axis(islit, tp, axis=1) & covered
     sym_j = jnp.take_along_axis(sym, tp, axis=1)
     d_j = jnp.maximum(jnp.take_along_axis(dist, tp, axis=1), 1)
     o_j = jnp.take_along_axis(out_off_t, cov, axis=1)
-    src = jnp.where(lit_j | ~covered, j, o_j - d_j + ((j - o_j) % d_j))
-    ok = ok & ~jnp.any(covered & (src < 0), axis=1)
-    src = jnp.clip(src, 0, OUT - 1)
-    val0 = jnp.where(lit_j, sym_j, 0).astype(jnp.uint8)
-    ptr = src
-    for _ in range(max(1, int(OUT - 1).bit_length())):
-        ptr = jnp.take_along_axis(ptr, ptr, axis=1)
-    out = jnp.take_along_axis(val0, ptr, axis=1)
-    out = jnp.where(covered, out, 0)
+    out, neg = _lz77_resolve(lit_j, sym_j, d_j, o_j, covered, j)
+    ok = ok & ~neg
     return out, ok
 
 
@@ -613,20 +660,9 @@ def inflate_dynamic(
     fixed_ll = jnp.asarray(FIXED_LITLEN_LENS)
     fixed_dl = jnp.asarray(FIXED_DIST_LENS)
 
-    data = jnp.pad(comp, ((0, 0), (0, 8))).astype(jnp.uint32)
     nbits_real = clens * 8
-
-    def window(bitpos):
-        """32 stream bits starting at ``bitpos`` (any shape [B, ...])."""
-        flat = bitpos.reshape(B, -1)
-        bi = flat >> 3
-        s = (flat & 7).astype(jnp.uint32)
-        b0 = jnp.take_along_axis(data, bi, axis=1)
-        b1 = jnp.take_along_axis(data, bi + 1, axis=1)
-        b2 = jnp.take_along_axis(data, bi + 2, axis=1)
-        b3 = jnp.take_along_axis(data, bi + 3, axis=1)
-        w = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
-        return (w >> s).reshape(bitpos.shape)
+    window = _bit_window_fn(comp)
+    bytes_pad = jnp.pad(comp, ((0, 0), (0, 8)))  # stored-block raw copies
 
     def rev15(w):
         v = (w & 0x7FFF).astype(jnp.int32)
@@ -675,8 +711,8 @@ def inflate_dynamic(
             j < (out_base + s_len)[:, None]
         )
         s_vals = jnp.take_along_axis(
-            data, jnp.clip(src_byte, 0, C + 7), axis=1
-        ).astype(jnp.uint8)
+            bytes_pad, jnp.clip(src_byte, 0, C + 7), axis=1
+        )
         lit_plane = jnp.where(s_mask, True, lit_plane)
         val_plane = jnp.where(s_mask, s_vals, val_plane)
 
@@ -776,7 +812,7 @@ def inflate_dynamic(
         data_start = jnp.where(btype == 2, hpos, bitpos + 3)
 
         # ---- speculative token resolve at every bit position -----------
-        w = window(p | jnp.zeros((B, 1), jnp.int32))
+        w = window(p)
         sym, L, matched = _canon_decode(rev15(w), ll_tables, 15)
         islit = matched & (sym < 256)
         iseob = matched & (sym == 256)
@@ -805,15 +841,7 @@ def inflate_dynamic(
         emit = jnp.where(bad, 0, emit)
 
         # ---- chain walk from the block's first data bit ----------------
-        t = jnp.arange(T, dtype=jnp.int32)
-        cur = jnp.broadcast_to(
-            jnp.clip(data_start, 0, NB - 1)[:, None], (B, T)
-        )
-        jump = nxt
-        for k in range(max(1, int(T - 1).bit_length())):
-            stepped = jnp.take_along_axis(jump, cur, axis=1)
-            cur = jnp.where(((t >> k) & 1)[None, :] == 1, stepped, cur)
-            jump = jnp.take_along_axis(jump, jump, axis=1)
+        cur = _chain_walk(nxt, data_start, T)
 
         huff = live & (btype == 1) | live & (btype == 2)
         bad_t = jnp.take_along_axis(bad, cur, axis=1)
@@ -828,10 +856,7 @@ def inflate_dynamic(
 
         # ---- merge this block's coverage into the member planes --------
         jj = j - out_base[:, None]
-        cov = jax.vmap(partial(jnp.searchsorted, side="right"))(
-            cum_out, jnp.clip(jj, 0, OUT)
-        ).astype(jnp.int32)
-        cov = jnp.clip(cov, 0, T - 1)
+        cov = _coverage(cum_out, jnp.clip(jj, 0, OUT), T)
         tp = jnp.take_along_axis(cur, cov, axis=1)
         in_blk = huff[:, None] & (jj >= 0) & (jj < total[:, None])
         lit_j = jnp.take_along_axis(islit, tp, axis=1)
@@ -885,21 +910,12 @@ def inflate_dynamic(
 
     ok = ok & done & (out_base == isizes) & (isizes <= OUT)
 
-    # ---- member-wide LZ77 copy resolution (spans blocks) ---------------
+    # ---- member-wide LZ77 copy resolution (spans blocks, shared) -------
     covered = j < out_base[:, None]
-    src = jnp.where(
-        lit_plane | ~covered,
-        j,
-        off_plane - dst_plane + ((j - off_plane) % dst_plane),
+    out, neg = _lz77_resolve(
+        lit_plane, val_plane, dst_plane, off_plane, covered, j
     )
-    ok = ok & ~jnp.any(covered & (src < 0), axis=1)
-    src = jnp.clip(src, 0, OUT - 1)
-    val0 = jnp.where(lit_plane, val_plane, 0).astype(jnp.uint8)
-    ptr = src
-    for _ in range(max(1, int(OUT - 1).bit_length())):
-        ptr = jnp.take_along_axis(ptr, ptr, axis=1)
-    out = jnp.take_along_axis(val0, ptr, axis=1)
-    out = jnp.where(covered, out, 0)
+    ok = ok & ~neg
     return out, ok
 
 
