@@ -26,6 +26,7 @@ from ..conf import Configuration, VCF_INTERVALS, VCFRECORDREADER_VALIDATION_STRI
 from ..spec import bcf, bgzf
 from ..spec.vcf import VcfHeader, variant_key
 from ..utils.intervals import Interval, parse_intervals
+from . import fs
 from .splits import FileVirtualSplit
 from .vcf import VariantBatch
 
@@ -221,8 +222,10 @@ class BcfInputFormat:
         both (the reference uses FileVirtualSplit the same way)."""
         out: List[FileVirtualSplit] = []
         for path in sorted(paths):
-            with open(path, "rb") as f:
-                data = f.read()
+            # Planning reads the file once through the seam (the guesser
+            # needs verify windows across it — the client-side cost the
+            # reference's BCFSplitGuesser pays too).
+            data = fs.get_fs(path).read_all(path)
             compressed = bgzf.is_bgzf(data)
             hdr, first = read_bcf_header(data, compressed)
             guesser = BcfSplitGuesser(data, hdr, compressed)
@@ -255,19 +258,26 @@ class BcfInputFormat:
     def read_split(
         self, split: FileVirtualSplit, data: Optional[bytes] = None
     ) -> VariantBatch:
-        if data is None:
-            with open(split.path, "rb") as f:
-                data = f.read()
-        compressed = bgzf.is_bgzf(data)
         stringency = self._stringency()
         intervals = self._intervals()
-        if compressed:
-            payload, p, end = _inflate_range(data, split.vstart, split.vend)
+        if data is None:
+            # Split-local: the header comes from a growing prefix read and
+            # the record range from its own byte window — a split costs
+            # O(header + split), not O(file).  Split ends are record-start
+            # voffsets (the planner's contract), so no record spills past
+            # the window's end-block margin.
+            hdr, payload, p, end = _read_bcf_split_local(split)
         else:
-            payload = data
-            p = split.vstart >> 16
-            end = split.vend >> 16
-        hdr, _ = read_bcf_header(data, compressed)
+            compressed = bgzf.is_bgzf(data)
+            if compressed:
+                payload, p, end = _inflate_range(
+                    data, split.vstart, split.vend
+                )
+            else:
+                payload = data
+                p = split.vstart >> 16
+                end = split.vend >> 16
+            hdr, _ = read_bcf_header(data, compressed)
         variants: List[bcf.BcfVariant] = []
         while p + 8 <= end:
             try:
@@ -289,6 +299,44 @@ class BcfInputFormat:
         return VariantBatch(
             header=hdr.vcf, variants=variants, keys=keys, pos=pos, end=endp
         )
+
+
+def _read_bcf_header_prefix(path: str):
+    """(header, compressed?) via growing prefix reads — O(header) bytes."""
+    f = fs.get_fs(path)
+    size = f.size(path)
+    n = 8 << 10
+    while True:
+        prefix = f.read_range(path, 0, min(n, size))
+        compressed = bgzf.is_bgzf(prefix)
+        try:
+            hdr, _ = read_bcf_header(prefix, compressed)
+            return hdr, compressed
+        except (bcf.BcfError, bgzf.BgzfError, struct.error, IndexError):
+            if n >= size:
+                raise
+            n *= 4
+
+
+def _read_bcf_split_local(split: FileVirtualSplit):
+    """(header, payload, start, record-start limit) reading only the
+    split's byte window + a growing header prefix."""
+    hdr, compressed = _read_bcf_header_prefix(split.path)
+    f = fs.get_fs(split.path)
+    if compressed:
+        c0 = split.vstart >> 16
+        c1 = split.vend >> 16
+        # The end block's full extent (≤64KiB) plus slack.
+        window = f.read_range(split.path, c0, (c1 - c0) + 0x20000)
+        shift = c0 << 16
+        payload, p, end = _inflate_range(
+            window, split.vstart - shift, split.vend - shift
+        )
+        return hdr, payload, p, end
+    p = split.vstart >> 16
+    end = split.vend >> 16
+    window = f.read_range(split.path, p, end - p)
+    return hdr, window, 0, end - p
 
 
 def _inflate_range(data: bytes, vstart: int, vend: int) -> Tuple[bytes, int, int]:

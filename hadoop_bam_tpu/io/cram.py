@@ -17,11 +17,11 @@ reconstruction) lives in ``spec/cram.py``.
 from __future__ import annotations
 
 import bisect
-import os
 from typing import Callable, Dict, List, Optional
 
 from ..conf import CRAM_REFERENCE_SOURCE_PATH, Configuration
 from ..spec import bam, cram
+from . import fs
 from .splits import ByteSplit
 
 
@@ -77,8 +77,10 @@ class CramInputFormat:
     def get_splits(self, paths, split_size: int = 4 << 20) -> List[ByteSplit]:
         out: List[ByteSplit] = []
         for path in sorted(paths):
-            with open(path, "rb") as f:
-                data = f.read()
+            # Container inventory needs the header chain — one planning
+            # pass through the seam (CRAMInputFormat.java:58-70 iterates
+            # the whole container stream the same way).
+            data = fs.get_fs(path).read_all(path)
             containers = cram.iter_containers(data)
             # Data containers only: skip the leading CRAM-header container
             # and the EOF container.
@@ -89,7 +91,7 @@ class CramInputFormat:
             ]
             if not offsets:
                 continue
-            size = os.path.getsize(path)
+            size = len(data)
             eof_start = next(
                 (c.offset for c in containers if c.is_eof), size
             )
@@ -107,13 +109,11 @@ class CramInputFormat:
         return out
 
     def container_inventory(self, path: str) -> List[cram.ContainerHeader]:
-        with open(path, "rb") as f:
-            return cram.iter_containers(f.read())
+        return cram.iter_containers(fs.get_fs(path).read_all(path))
 
     def count_records(self, split: ByteSplit) -> int:
         """Record count from container headers alone (no decode)."""
-        with open(split.path, "rb") as f:
-            data = f.read()
+        data = fs.get_fs(split.path).read_all(split.path)
         return sum(
             c.n_records
             for c in cram.iter_containers(data)
@@ -122,15 +122,31 @@ class CramInputFormat:
 
     def read_split(self, split: ByteSplit, data: Optional[bytes] = None):
         """Decode every record of the split's containers into the standard
-        RecordBatch (same device pipeline as BAM/SAM)."""
+        RecordBatch (same device pipeline as BAM/SAM).
+
+        Without a preloaded buffer the read is split-local: the CRAM major
+        version comes from the 26-byte file definition and only the
+        split's own container-aligned byte window is fetched — a split
+        costs O(split), not O(file)."""
         from .sam import _records_to_batch
 
-        if data is None:
-            with open(split.path, "rb") as f:
-                data = f.read()
-        major, _ = cram.parse_file_definition(data)
         ref = self._ref_getter()
         records: List[bam.BamRecord] = []
+        if data is None:
+            f = fs.get_fs(split.path)
+            major, _ = cram.parse_file_definition(
+                f.read_range(split.path, 0, cram.FILE_DEFINITION_LEN)
+            )
+            window = f.read_range(split.path, split.start, split.length)
+            pos = 0
+            while pos < len(window):
+                ch = cram.parse_container_header(window, pos, major)
+                records.extend(
+                    cram.decode_container(window, ch, major, ref)
+                )
+                pos = ch.next_offset
+            return _records_to_batch(records)
+        major, _ = cram.parse_file_definition(data)
         for ch in cram.iter_containers(data):
             if ch.offset < split.start or ch.offset >= split.end:
                 continue
@@ -145,7 +161,7 @@ def read_cram_header(path_or_bytes) -> bam.BamHeader:
     data = (
         path_or_bytes
         if isinstance(path_or_bytes, (bytes, bytearray))
-        else open(path_or_bytes, "rb").read()
+        else fs.get_fs(path_or_bytes).read_all(path_or_bytes)
     )
     return bam.header_from_text(cram.read_cram_header_text(data))
 

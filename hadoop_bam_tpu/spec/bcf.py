@@ -665,6 +665,12 @@ def decode_header(buf) -> Tuple[BcfHeader, int]:
     if bytes(buf[3:5]) != b"\x02\x02" and buf[3] != 2:
         raise BcfError(f"unsupported BCF version {buf[3]}.{buf[4]}")
     (l_text,) = struct.unpack_from("<I", buf, 5)
+    if len(buf) < 9 + l_text:
+        # A truncated buffer must not silently parse as a shorter header
+        # (prefix readers grow on this error until the dictionary is whole).
+        raise BcfError(
+            f"BCF header truncated: need {9 + l_text} bytes, have {len(buf)}"
+        )
     text = bytes(buf[9 : 9 + l_text]).rstrip(b"\x00").decode()
     return BcfHeader(VcfHeader.parse(text)), 9 + l_text
 

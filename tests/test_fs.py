@@ -228,3 +228,69 @@ def test_vcf_bgzf_split_local_equals_preloaded(tmp_path, counting_fs):
             ByteSplit(s.path, s.start, s.length), data=raw
         )
         assert len(b2.variants) == n_local
+
+
+def test_bcf_split_read_is_split_local(tmp_path, counting_fs):
+    import io as _io
+
+    from hadoop_bam_tpu.io.bcf import BcfInputFormat, BcfRecordWriter
+    from hadoop_bam_tpu.spec.vcf import VcfHeader, parse_variant_line
+
+    head = VcfHeader.parse(
+        "##fileformat=VCFv4.2\n"
+        "##INFO=<ID=DP,Number=1,Type=Integer,Description=\"Depth\">\n"
+        "##FILTER=<ID=PASS,Description=\"ok\">\n"
+        + "".join(f"##contig=<ID=chr{c}>\n" for c in (1, 2))
+        + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+    buf = _io.BytesIO()
+    w = BcfRecordWriter(buf, head)
+    n = 60000
+    for i in range(n):
+        w.write(
+            parse_variant_line(
+                f"chr{1 + i % 2}\t{100 + i}\t.\tA\tG\t50\tPASS\tDP={i % 9}"
+            )
+        )
+    w.close()
+    p = tmp_path / "big.bcf"
+    p.write_bytes(buf.getvalue())
+    path = f"cnt://{p}"
+    fmt = BcfInputFormat()
+    splits = fmt.get_splits([path], split_size=8 << 10)
+    assert len(splits) > 2
+    mid = splits[len(splits) // 2]
+    counting_fs.bytes_read = 0
+    b = fmt.read_split(mid)
+    assert len(b.variants) > 0
+    # header prefix + split window + end-block margin, not the whole file
+    assert counting_fs.bytes_read < p.stat().st_size
+    total = sum(len(fmt.read_split(s).variants) for s in splits)
+    assert total == n
+
+
+def test_cram_split_read_is_split_local(tmp_path, counting_fs):
+    import io as _io
+
+    from hadoop_bam_tpu.io.cram import CramInputFormat, CramRecordWriter
+
+    blob = make_bam_bytes(n=12000, seed=6)
+    hdr, recs = bam.read_bam(blob)
+    buf = _io.BytesIO()
+    w = CramRecordWriter(buf, hdr, records_per_container=200)
+    for r in recs:
+        w.write_record(r)
+    w.close()
+    p = tmp_path / "big.cram"
+    p.write_bytes(buf.getvalue())
+    path = f"cnt://{p}"
+    fmt = CramInputFormat()
+    splits = fmt.get_splits([path], split_size=16 << 10)
+    assert len(splits) > 2
+    mid = splits[len(splits) // 2]
+    counting_fs.bytes_read = 0
+    b = fmt.read_split(mid)
+    assert b.n_records > 0
+    assert counting_fs.bytes_read < p.stat().st_size // 2
+    total = sum(fmt.read_split(s).n_records for s in splits)
+    assert total == len(recs)
