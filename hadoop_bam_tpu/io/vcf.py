@@ -437,7 +437,7 @@ def _read_vectorized(
     T = tabs[np.minimum(tk, len(tabs) - 1)]
     if not (exists & (T < line_end[:, None])).all():
         return None  # a line with < 8 fields: exact error text needed
-    fs = np.concatenate([starts[:, None], T + 1], axis=1)  # field starts
+    fstart = np.concatenate([starts[:, None], T + 1], axis=1)  # field starts
     # INFO ends at the 8th tab when genotype columns follow, else line end.
     tk7 = t0 + 7
     has8 = (tk7 < len(tabs)) & (
@@ -447,7 +447,7 @@ def _read_vectorized(
         has8, tabs[np.minimum(tk7, len(tabs) - 1)], line_end
     )
     fe = np.concatenate([T, info_end[:, None]], axis=1)  # field ends
-    flen = fe - fs
+    flen = fe - fstart
 
     if (flen[:, 0] == 0).any() or (flen[:, 3] == 0).any():
         return None  # empty CHROM/REF
@@ -455,7 +455,7 @@ def _read_vectorized(
     # any non-ASCII byte would make byte length diverge — exact path.
     rlen = flen[:, 3]
     Wr = int(rlen.max())
-    rmat = gather_padded(a, fs[:, 3], rlen, Wr)
+    rmat = gather_padded(a, fstart[:, 3], rlen, Wr)
     if (rmat >= 0x80).any():
         return None
 
@@ -463,7 +463,7 @@ def _read_vectorized(
     plen = flen[:, 1]
     if (plen == 0).any() or (plen > 10).any():
         return None
-    pmat = gather_padded(a, fs[:, 1], plen, int(plen.max()))
+    pmat = gather_padded(a, fstart[:, 1], plen, int(plen.max()))
     pdig = pmat - 48
     col = np.arange(pmat.shape[1])[None, :]
     pvalid = col < plen[:, None]
@@ -478,7 +478,7 @@ def _read_vectorized(
     qlen = flen[:, 5]
     W = int(qlen.max()) if n else 0
     if W:
-        qmat = gather_padded(a, fs[:, 5], qlen, W)
+        qmat = gather_padded(a, fstart[:, 5], qlen, W)
         qcol = np.arange(W)[None, :]
         qvalid = qcol < qlen[:, None]
         is_dot = (qlen == 1) & (qmat[:, 0] == 0x2E)
@@ -494,7 +494,7 @@ def _read_vectorized(
     alen = flen[:, 4]
     Wa = int(alen.max()) if n else 0
     if Wa:
-        amat = gather_padded(a, fs[:, 4], alen, Wa)
+        amat = gather_padded(a, fstart[:, 4], alen, Wa)
         acol = np.arange(Wa)[None, :]
         avalid = acol < alen[:, None]
         if (avalid & _ALT_SYM[amat]).any():
@@ -522,7 +522,7 @@ def _read_vectorized(
         return None
     clen = flen[:, 0]
     Wc = int(clen.max())
-    cmat = gather_padded(a, fs[:, 0], clen, Wc)
+    cmat = gather_padded(a, fstart[:, 0], clen, Wc)
     if Wc <= 16:
         # Pack each padded row into 1-2 machine words: scalar np.unique is
         # an order of magnitude faster than the axis=0 (row-sort) form.
@@ -575,7 +575,7 @@ def _read_vectorized(
     else:
         hits = np.empty(0, np.int64)
     if len(hits):
-        i0 = np.searchsorted(hits, fs[:, 7])
+        i0 = np.searchsorted(hits, fstart[:, 7])
         i1 = np.searchsorted(hits, fe[:, 7] - 3)
         flagged = np.nonzero(i1 > i0)[0]
         for r in flagged:

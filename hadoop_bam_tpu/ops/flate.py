@@ -600,6 +600,31 @@ def _canonical_decoder(lens: jax.Array, max_len: int):
     return first, count, symoff, sym_sorted
 
 
+def _kraft_valid(
+    count: jax.Array, max_len: int, allow_single: bool = True
+) -> jax.Array:
+    """Per-member validity of a canonical table's length histogram
+    (ADVICE r2 low).  ``count``: int32 [B, max_len+1], as returned in
+    ``_canonical_decoder``'s tables[1].
+
+    Over-subscribed sets (Kraft sum > 1) can alias two symbols onto one
+    window and ``_canon_decode``'s smallest-length-wins rule would silently
+    pick one — so reject them.  Incomplete sets are rejected too, except —
+    matching zlib's inftrees.c — a single code of length 1 when
+    ``allow_single`` (some encoders emit a lone distance code; zlib never
+    extends this grace to the code-length table).  Empty sets are valid
+    here; whether an empty table may be *used* is enforced at decode
+    time."""
+    Lr = jnp.arange(max_len + 1, dtype=jnp.int32)
+    kraft = jnp.sum(count << (max_len - Lr)[None, :], axis=1)
+    ncodes = jnp.sum(count, axis=1)
+    full = jnp.int32(1) << max_len
+    ok = (ncodes == 0) | (kraft == full)
+    if allow_single:
+        ok = ok | ((ncodes == 1) & (count[:, 1] == 1))
+    return ok
+
+
 def _canon_decode(rev: jax.Array, tables, max_len: int):
     """Decode MSB-first-reversed bit windows against canonical tables.
 
@@ -733,6 +758,7 @@ def inflate_dynamic(
             jnp.arange(B)[:, None], clc_order[None, :]
         ].set(cl_raw)
         cl_tables = _canonical_decoder(cl_lens, 7)
+        ok = ok & (~is_dyn | _kraft_valid(cl_tables[1], 7, allow_single=False))
         total_codes = hlit + hdist
 
         def hstep(carry, _):
@@ -809,6 +835,15 @@ def inflate_dynamic(
         dl_lens = jnp.where(use_dyn, dyn_dl, fixed_dl[None, :])
         ll_tables = _canonical_decoder(ll_lens, 15)
         dl_tables = _canonical_decoder(dl_lens, 15)
+        # For dynamic members ll_lens == dyn_ll (and likewise dist), so the
+        # decoder's own histograms serve; non-dynamic members are masked.
+        ok = ok & (
+            ~is_dyn
+            | (
+                _kraft_valid(ll_tables[1], 15)
+                & _kraft_valid(dl_tables[1], 15)
+            )
+        )
         data_start = jnp.where(btype == 2, hpos, bitpos + 3)
 
         # ---- speculative token resolve at every bit position -----------

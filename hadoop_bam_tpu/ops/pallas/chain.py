@@ -183,6 +183,15 @@ def record_chain_device(stream, n_bytes=None, interpret=None):
     """
     a = jnp.asarray(stream, dtype=jnp.uint8)
     n = int(a.shape[0]) if n_bytes is None else int(n_bytes)
+    if n > 2**31 - CHUNK:
+        # Offsets, cursors and n_bytes ride int32 lanes inside the kernel;
+        # past 2 GiB they wrap silently and the cursor==n_bytes check would
+        # compare wrapped values.  The margin keeps the last chunk's
+        # (k+1)*CHUNK limit inside int32 too.  Callers chunk well below.
+        raise ValueError(
+            f"record_chain_device: stream of {n} bytes exceeds the int32 "
+            "offset domain (2 GiB); chunk the stream before calling"
+        )
     n_chunks = max(1, -(-n // CHUNK))
     nbytes_pad = n_chunks * CHUNK + 256 * 4
     pad = nbytes_pad - a.shape[0]

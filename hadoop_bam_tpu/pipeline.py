@@ -327,17 +327,23 @@ def _read_splits_pipelined(fmt, splits, fields=None, depth: Optional[int] = None
     try:
         for i in range(len(splits)):
             b = futs[i].result()
+            # Drop the Future (and with it the decoded batch it retains) so
+            # only ~depth+1 batches are ever alive: the external-sort path
+            # counts on this generator being O(depth), not O(file).
+            futs[i] = None
             if nxt < len(splits):
                 futs.append(
                     pool.submit(fmt.read_split, splits[nxt], fields=fields)
                 )
                 nxt += 1
             yield b
+            del b
     finally:
         # On a decode error (or the consumer abandoning the generator),
         # don't block on — or keep paying for — reads nobody will use.
         for f in futs:
-            f.cancel()
+            if f is not None:
+                f.cancel()
         pool.shutdown(wait=False, cancel_futures=True)
 
 
