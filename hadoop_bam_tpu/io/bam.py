@@ -59,7 +59,10 @@ class RecordBatch:
 
     @property
     def n_records(self) -> int:
-        return len(self.keys)
+        # rec_off is present in every field subset; keys may be skipped
+        # entirely (with_keys=False, e.g. the device-parse sort path).
+        off = self.soa.get("rec_off")
+        return len(off) if off is not None else len(self.keys)
 
     def record(self, i: int) -> bam.BamRecord:
         off = int(self.soa["rec_off"][i])

@@ -85,6 +85,75 @@ def parse_stream_device(data, n_bytes=None, interpret=None):
     return soa, hi, lo, valid, ok
 
 
+@jax.jit
+def _stream_keys(data: jax.Array, offs: jax.Array, count: jax.Array):
+    """Slim key-only field gather: refid/pos/flag at the chain offsets →
+    (hi, lo) key halves + a valid-masked unmapped-row mask.
+
+    The production subset of :func:`soa_decode_device` — the sort needs only
+    the three key inputs, so the other ten columns' gathers are skipped.
+    Padded rows (``offs`` beyond ``count``) are clipped to offset 0 and
+    masked out of ``unmapped``; their hi/lo values are garbage the caller
+    never reads (it slices ``[:count]``).
+    """
+    from .keys import make_keys, unmapped_mask
+
+    valid = jnp.arange(offs.shape[0], dtype=jnp.int32) < count
+    offs = jnp.where(valid, offs, 0)
+    body = offs + 4
+    refid = _le(data, body, 4).astype(jnp.int32)
+    pos = _le(data, body + 4, 4).astype(jnp.int32)
+    flag = _le(data, body + 14, 2).astype(jnp.int32)
+    hash32 = jnp.zeros(offs.shape, jnp.int32)
+    hi, lo = make_keys(refid, pos, flag, hash32)
+    unmapped = unmapped_mask(refid, pos, flag) & valid
+    return hi, lo, unmapped
+
+
+def keys_from_stream_device(stream, n_bytes=None, interpret=None):
+    """Sort keys of a raw BAM record stream, computed entirely on device.
+
+    The production device-resident read path (SURVEY §7 stage 4): the
+    caller uploads the inflated record stream once; the Pallas chain kernel
+    re-derives record boundaries from the raw bytes, and the key gathers +
+    :func:`ops.keys.make_keys` assemble the (hi, lo) sort-key halves
+    on-chip — the host never walks fields or builds keys (displacing the
+    per-record decode loop of BAMRecordReader.java:223-232).
+
+    Returns ``(hi, lo, unmapped, count, ok)`` — all device arrays, padded
+    to the chain kernel's capacity; live rows are ``[:count]``.  ``unmapped``
+    marks rows whose key needs the host murmur3 hash patched in via
+    :func:`patch_unmapped_keys` (hash32 is 0 here; mapped rows are final).
+    ``ok`` is False on a misaligned/truncated chain (caller falls back).
+    """
+    from .pallas.chain import record_chain_device
+
+    a = jnp.asarray(stream, dtype=jnp.uint8)
+    offs, count, ok = record_chain_device(a, n_bytes, interpret=interpret)
+    if a.shape[0] < 36:
+        a = jnp.pad(a, (0, 36 - a.shape[0]))
+    hi, lo, unmapped = _stream_keys(a, offs, count)
+    return hi, lo, unmapped, count, ok
+
+
+@jax.jit
+def patch_unmapped_keys(
+    hi: jax.Array, lo: jax.Array, unmapped: jax.Array, hash32: jax.Array
+):
+    """Overwrite unmapped rows' keys with the host-computed murmur3 hash.
+
+    Java packs the unmapped key as ``(long)INT_MAX << 32 | hash`` with sign
+    extension (BAMRecordReader.java:85-86, 119-121): a negative hash floods
+    the high word to -1.  Bit-equal to :func:`spec.bam.soa_keys`.
+    """
+    int_max = jnp.int32(2**31 - 1)
+    hi = jnp.where(
+        unmapped, jnp.where(hash32 < 0, jnp.int32(-1), int_max), hi
+    )
+    lo = jnp.where(unmapped, hash32.astype(jnp.uint32), lo)
+    return hi, lo
+
+
 def pad_offsets(offsets, batch: int):
     """Pad an offsets array to ``batch`` rows; returns (padded, valid mask).
 

@@ -23,6 +23,16 @@ import numpy as np
 from ..spec.bam import FLAG_UNMAPPED, INT_MAX
 
 
+def unmapped_mask(
+    refid: jax.Array, pos: jax.Array, flag: jax.Array
+) -> jax.Array:
+    """Rows keyed by the murmur3 hash instead of (refid, pos): the
+    reference's condition is unmapped flag OR refid<0 OR alignmentStart<0
+    (BAMRecordReader.java:85-86).  The single definition shared by the key
+    builders and the device-parse hash patching."""
+    return ((flag & FLAG_UNMAPPED) != 0) | (refid < 0) | ((pos + 1) < 0)
+
+
 def make_keys(
     refid: jax.Array,  # int32[N]
     pos: jax.Array,  # int32[N], 0-based, -1 if unplaced
@@ -30,7 +40,7 @@ def make_keys(
     hash32: jax.Array,  # int32[N], murmur3 low word (only used when unmapped)
 ) -> tuple[jax.Array, jax.Array]:
     """(hi: int32[N], lo: uint32[N]) with Java-exact packing."""
-    unmapped = ((flag & FLAG_UNMAPPED) != 0) | (refid < 0) | ((pos + 1) < 0)
+    unmapped = unmapped_mask(refid, pos, flag)
     sel_hi = jnp.where(unmapped, jnp.int32(INT_MAX), refid)
     sel_lo = jnp.where(unmapped, hash32, pos)
     # Java `|` sign-extends the low int into the long: a negative low word

@@ -23,7 +23,7 @@ from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from ..utils.murmur3 import murmurhash3_bytes
+from ..utils.murmur3 import murmurhash3_int32
 
 MAGIC = b"BAM\x01"
 
@@ -415,10 +415,7 @@ def alignment_key(rec: BamRecord) -> int:
     prefix (BAMRecordReader.java:100-102)."""
     if not (rec.is_unmapped or rec.refid < 0 or rec.alignment_start < 0):
         return key0(rec.refid, rec.pos)
-    h = murmurhash3_bytes(rec.raw[32:], 0)
-    h32 = h & 0xFFFFFFFF
-    h32_signed = h32 - (1 << 32) if h32 >= 1 << 31 else h32
-    return key0(INT_MAX, h32_signed)
+    return key0(INT_MAX, murmurhash3_int32(rec.raw[32:], 0))
 
 
 # ---------------------------------------------------------------------------
@@ -544,10 +541,7 @@ def soa_keys(soa: dict, data: bytes) -> np.ndarray:
             blob = data[off + 32 : off + ln]
             if isinstance(blob, np.ndarray):
                 blob = blob.tobytes()
-            h = murmurhash3_bytes(blob, 0)
-            h32 = h & 0xFFFFFFFF
-            h32s = h32 - (1 << 32) if h32 >= 1 << 31 else h32
-            keys[i] = key0(INT_MAX, h32s)
+            keys[i] = key0(INT_MAX, murmurhash3_int32(blob, 0))
     return keys
 
 

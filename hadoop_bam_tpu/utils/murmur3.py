@@ -102,6 +102,14 @@ def murmurhash3_bytes(key: bytes, seed: int = 0) -> int:
     return _finish(h1, h2, length)
 
 
+def murmurhash3_int32(key: bytes, seed: int = 0) -> int:
+    """Low 32 bits of the hash as a *signed* int32 — the unmapped-read key
+    truncation of BAMRecordReader.java:85-86 (Java's implicit (int) cast).
+    The single definition of the sign rule shared by every key builder."""
+    v = murmurhash3_bytes(key, seed) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= 1 << 31 else v
+
+
 def murmurhash3_chars(chars: str, seed: int = 0) -> int:
     """Hash UTF-16 code units directly (reference MurmurHash3.java:105-171).
 

@@ -345,3 +345,134 @@ class TestDynamicInflate:
             flate.bgzf_decompress_device(
                 bytes(blob) + bgzf.TERMINATOR
             )
+
+
+class _BitWriter:
+    """LSB-first bit packer for hand-built DEFLATE streams."""
+
+    def __init__(self):
+        self.bits = []
+
+    def w(self, val, n):
+        for k in range(n):
+            self.bits.append((val >> k) & 1)
+
+    def code(self, c, length):
+        # Huffman codes are emitted MSB-first (RFC 1951 §3.1.1).
+        for k in range(length - 1, -1, -1):
+            self.bits.append((c >> k) & 1)
+
+    def bytes(self):
+        out = bytearray((len(self.bits) + 7) // 8)
+        for i, b in enumerate(self.bits):
+            out[i >> 3] |= b << (i & 7)
+        return bytes(out)
+
+
+def _inflate_dyn_raw(raw: bytes, isize: int, out_cap: int = 1024):
+    C = max(512, 1 << (max(len(raw) - 1, 1)).bit_length())
+    comp = np.zeros((1, C), np.uint8)
+    comp[0, : len(raw)] = np.frombuffer(raw, np.uint8)
+    out, ok = flate.inflate_dynamic(
+        jnp.asarray(comp),
+        jnp.asarray([len(raw)], np.int32),
+        jnp.asarray([isize], np.int32),
+        out_cap,
+    )
+    return np.asarray(out)[0], bool(np.asarray(ok)[0])
+
+
+class TestHuffmanTableValidation:
+    """Regression tests for the Kraft-sum table checks (ADVICE r3): these
+    streams were accepted (silently mis-decoded) before the validation
+    landed.  Hand-built headers, since zlib never emits such tables."""
+
+    def test_oversubscribed_ll_table_rejected(self):
+        # Literal/length table with THREE codes of length 1 (Kraft 3/2 > 1).
+        bw = _BitWriter()
+        bw.w(1, 1)  # BFINAL
+        bw.w(2, 2)  # BTYPE=10 dynamic
+        bw.w(0, 5)  # HLIT  -> 257 ll codes
+        bw.w(0, 5)  # HDIST -> 1 dist code
+        bw.w(14, 4)  # HCLEN -> 18 clc lengths
+        # CLC order [16,17,18,0,8,7,9,6,10,5,11,4,12,3,13,2,14,1,15]:
+        # symbol 18 (pos 2) and symbol 1 (pos 17) get length 1, rest 0.
+        for pos in range(18):
+            bw.w(1 if pos in (2, 17) else 0, 3)
+        # canonical CLC: 1 -> '0', 18 -> '1'
+        one, rep18 = (0, 1), (1, 1)
+        # ll lengths: three 1s, then 254 zeros (18:138 + 18:116)
+        for _ in range(3):
+            bw.code(*one)
+        bw.code(*rep18)
+        bw.w(138 - 11, 7)
+        bw.code(*rep18)
+        bw.w(116 - 11, 7)
+        # dist lengths: one "1"
+        bw.code(*one)
+        raw = bw.bytes() + b"\0" * 8
+        _, ok = _inflate_dyn_raw(raw, 1)
+        assert not ok
+
+    def test_incomplete_clc_table_rejected(self):
+        # Code-length code with a single length-1 entry: zlib's lone-code
+        # grace never applies to the CLC table (inftrees.c).
+        bw = _BitWriter()
+        bw.w(1, 1)
+        bw.w(2, 2)
+        bw.w(0, 5)
+        bw.w(0, 5)
+        bw.w(0, 4)  # HCLEN -> 4 clc lengths: positions 16,17,18,0
+        for pos in range(4):
+            bw.w(1 if pos == 3 else 0, 3)  # only symbol 0, length 1
+        raw = bw.bytes() + b"\0" * 16
+        _, ok = _inflate_dyn_raw(raw, 1)
+        assert not ok
+
+    def test_lone_length1_distance_code_accepted(self):
+        # A single distance code of length 1 is an *incomplete* table that
+        # zlib (and therefore this decoder) accepts.  Full valid member:
+        # lit 'A', one length-4 copy at distance 1, EOB -> "AAAAA".
+        bw = _BitWriter()
+        bw.w(1, 1)
+        bw.w(2, 2)
+        bw.w(2, 5)  # HLIT -> 259 ll codes (need symbol 258)
+        bw.w(0, 5)  # HDIST -> 1 dist code
+        bw.w(14, 4)  # HCLEN -> 18
+        # CLC lengths 2 for symbols {0,1,2,18} at positions {3,17,15,2}.
+        for pos in range(18):
+            bw.w(2 if pos in (3, 17, 15, 2) else 0, 3)
+        # canonical CLC (len 2): 0->'00', 1->'01', 2->'10', 18->'11'
+        zero, one, two, rep18 = (0, 2), (1, 2), (2, 2), (3, 2)
+        # ll lengths[259]: sym65->1, sym256->2, sym258->2, rest 0:
+        bw.code(*rep18)
+        bw.w(65 - 11, 7)  # 65 zeros
+        bw.code(*one)  # 'A' -> length 1
+        bw.code(*rep18)
+        bw.w(138 - 11, 7)  # zeros 66..203
+        bw.code(*rep18)
+        bw.w(52 - 11, 7)  # zeros 204..255
+        bw.code(*two)  # EOB -> length 2
+        bw.code(*zero)  # 257 unused
+        bw.code(*two)  # 258 (copy len 4) -> length 2
+        # dist lengths[1]: distance-1 code -> length 1 (the lone code)
+        bw.code(*one)
+        # canonical LL: 65->'0'; len-2: 256->'10', 258->'11'
+        bw.code(0, 1)  # literal 'A'
+        bw.code(3, 2)  # copy length 4
+        bw.code(0, 1)  # distance 1 (the lone code is '0')
+        bw.code(2, 2)  # EOB
+        raw = bw.bytes()
+        out, ok = _inflate_dyn_raw(raw, 5)
+        assert ok
+        assert bytes(out[:5]) == b"AAAAA"
+
+
+class TestChainStreamGuard:
+    def test_reject_streams_past_int32_domain(self):
+        # Regression for the 2 GiB int32 guard: offsets/cursors ride int32
+        # lanes inside the chain kernel and would wrap silently.
+        from hadoop_bam_tpu.ops.pallas.chain import record_chain_device
+
+        with pytest.raises(ValueError, match="int32"):
+            record_chain_device(np.zeros(64, np.uint8), n_bytes=2**31 - 1)

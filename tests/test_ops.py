@@ -116,6 +116,52 @@ class TestSort:
         assert np.asarray(perm)[3] == 2
 
 
+class TestDeviceParseKeys:
+    """The production device-resident key path (pipeline device_parse):
+    raw stream → chain kernel → slim gathers → make_keys, bit-equal to
+    spec.bam.soa_keys (interpret mode on the CPU mesh)."""
+
+    def test_stream_keys_bit_equal_oracle(self):
+        from hadoop_bam_tpu.utils.murmur3 import murmurhash3_int32
+
+        blob, offsets, soa, recs = make_batch()
+        oracle = bam.soa_keys(soa, blob)
+        n = len(offsets)
+        hi, lo, unm, count, ok = decode_ops.keys_from_stream_device(
+            np.frombuffer(blob, np.uint8)
+        )
+        assert bool(ok) and int(count) == n
+        exp_unm = (
+            ((soa["flag"] & bam.FLAG_UNMAPPED) != 0)
+            | (soa["refid"] < 0)
+            | (soa["pos"] + 1 < 0)
+        )
+        np.testing.assert_array_equal(np.asarray(unm[:n]), exp_unm)
+        hash32 = np.zeros(n, np.int32)
+        for i in np.nonzero(exp_unm)[0]:
+            off = int(soa["rec_off"][i])
+            ln = int(soa["rec_len"][i])
+            hash32[i] = murmurhash3_int32(blob[off + 32 : off + ln], 0)
+        hi2, lo2 = decode_ops.patch_unmapped_keys(
+            hi[:n], lo[:n], unm[:n], jnp.asarray(hash32)
+        )
+        packed = keys_ops.pack_keys_np(np.asarray(hi2), np.asarray(lo2))
+        np.testing.assert_array_equal(packed, oracle)
+
+    def test_mapped_rows_final_without_patch(self):
+        blob, offsets, soa, recs = make_batch()
+        oracle = bam.soa_keys(soa, blob)
+        n = len(offsets)
+        hi, lo, unm, count, ok = decode_ops.keys_from_stream_device(
+            np.frombuffer(blob, np.uint8)
+        )
+        mapped = ~np.asarray(unm[:n])
+        packed = keys_ops.pack_keys_np(
+            np.asarray(hi[:n]), np.asarray(lo[:n])
+        )
+        np.testing.assert_array_equal(packed[mapped], oracle[mapped])
+
+
 class TestQuality:
     def test_conversions_roundtrip(self):
         q = np.arange(33, 33 + 63, dtype=np.uint8).reshape(1, -1)

@@ -67,6 +67,94 @@ def test_sorted_output_reusable_as_input(reference_resources, tmp_path):
     ]
 
 
+def _write_mixed_bam(path, n=900, seed=2):
+    """Small BAM with mapped + unplaced-unmapped records (the unmapped keys
+    exercise the murmur3 patch path of the device parse)."""
+    rng = np.random.default_rng(seed)
+    refs = [("c1", 1 << 24), ("c2", 1 << 24)]
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n"
+        + "\n".join(f"@SQ\tSN:{nm}\tLN:{ln}" for nm, ln in refs),
+        refs,
+    )
+    recs = []
+    for i in range(n):
+        unmapped = i % 17 == 0
+        recs.append(
+            bam.build_record(
+                f"r{i:05d}",
+                -1 if unmapped else int(rng.integers(0, 2)),
+                -1 if unmapped else int(rng.integers(0, 1 << 20)),
+                30,
+                bam.FLAG_UNMAPPED if unmapped else 0,
+                [] if unmapped else [(36, "M")],
+                "ACGT" * 9,
+                bytes([25] * 36),
+            )
+        )
+    with open(path, "wb") as f:
+        bam.write_bam(f, hdr, iter(recs), level=1)
+    return recs
+
+
+def test_sort_device_parse_matches_host(tmp_path):
+    # The device-resident read path (chain kernel + on-chip keys; interpret
+    # mode here) must produce byte-identical output to the host-key sort.
+    src = tmp_path / "mixed.bam"
+    _write_mixed_bam(str(src))
+    out_dp = tmp_path / "sorted_dp.bam"
+    out_h = tmp_path / "sorted_h.bam"
+    stats = sort_bam(
+        str(src), str(out_dp), split_size=32 << 10, device_parse=True
+    )
+    assert stats.backend == "device-parse"
+    assert stats.n_records == 900
+    sort_bam(str(src), str(out_h), split_size=32 << 10, backend="host")
+    assert out_dp.read_bytes() == out_h.read_bytes()
+    _, recs = bam.read_bam(str(src))
+    check_sorted_bam(out_dp, recs)
+
+
+def test_device_parse_fallback_on_mismatch(tmp_path, monkeypatch):
+    # A device/host record-count disagreement must fall back to host keys
+    # and still produce correct output.
+    from hadoop_bam_tpu.ops import decode as decode_ops
+
+    real = decode_ops.keys_from_stream_device
+
+    def bad(stream, n_bytes=None, interpret=None):
+        hi, lo, unm, count, ok = real(stream, n_bytes, interpret)
+        return hi, lo, unm, count + 1, ok
+
+    monkeypatch.setattr(decode_ops, "keys_from_stream_device", bad)
+    src = tmp_path / "mixed.bam"
+    recs = _write_mixed_bam(str(src), n=300)
+    out = tmp_path / "sorted.bam"
+    stats = sort_bam(
+        str(src), str(out), split_size=32 << 10, device_parse=True
+    )
+    assert stats.backend == "host-fallback"
+    check_sorted_bam(out, recs)
+
+
+def test_pipelined_reads_drop_consumed_futures(reference_resources):
+    # Regression (ADVICE r3): consumed futures must be nulled out so only
+    # ~depth+1 decoded batches are ever alive — the external-sort path
+    # counts on this generator being O(depth) memory, not O(file).
+    from hadoop_bam_tpu.io.bam import BamInputFormat
+    from hadoop_bam_tpu.pipeline import _read_splits_pipelined
+
+    fmt = BamInputFormat()
+    splits = fmt.get_splits([REF_BAM], split_size=16 << 10)
+    assert len(splits) >= 4
+    gen = _read_splits_pipelined(fmt, splits, depth=2)
+    next(gen)
+    next(gen)
+    futs = gen.gi_frame.f_locals["futs"]
+    assert futs[0] is None and futs[1] is None
+    gen.close()
+
+
 def test_pipelined_reads_preserve_order(reference_resources, tmp_path):
     # Forced read-ahead must yield byte-identical batches in split order
     # (on 1-core hosts the default degrades to serial; force depth=3).

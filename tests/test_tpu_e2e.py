@@ -42,14 +42,17 @@ src = os.path.join(tmp, "in.bam")
 out = os.path.join(tmp, "out.bam")
 n = 50000
 synth_bam(src, n)
-sort_bam([src], out, split_size=1 << 20, level=1, backend="device")
+# On a real accelerator the auto rule selects the device-resident parse
+# (chain kernel + on-chip keys); assert it actually ran, not a fallback.
+stats = sort_bam([src], out, split_size=1 << 20, level=1, backend="device")
+assert stats.backend == "device-parse", stats.backend
 fmt = BamInputFormat()
 keys = np.concatenate(
     [fmt.read_split(s).keys for s in fmt.get_splits([out], split_size=1 << 20)]
 )
 assert len(keys) == n, (len(keys), n)
 assert np.all(keys[:-1] <= keys[1:])
-print("TPU_E2E_OK n=%d" % n)
+print("TPU_E2E_OK n=%d backend=%s" % (n, stats.backend))
 
 # Pallas record-chain kernel on the real chip (interpret=False), oracle-equal.
 from hadoop_bam_tpu.ops.decode import parse_stream_device
