@@ -235,6 +235,14 @@ def sort_bam(
                 # The split's record stream ships to the chip as raw bytes;
                 # boundary walk + field gathers + key assembly all happen
                 # there, overlapping the next split's host-side inflate.
+                # One failed split dooms the whole device path (the sort
+                # falls back to host keys for the job), so stop uploading
+                # the moment any split fails rather than shipping the rest
+                # of the file to the chip for results that will be thrown
+                # away.
+                if parsed and parsed[-1] is False:
+                    parsed.append(False)
+                    continue
                 try:
                     parsed.append(_device_parse_split(b))
                 except Exception:

@@ -269,6 +269,154 @@ def test_bcf_split_read_is_split_local(tmp_path, counting_fs):
     assert total == n
 
 
+class _RangeHandler:
+    """Request handler factory serving a dict of blobs with Range support."""
+
+    def __new__(cls, files, honor_range=True):
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _blob(self):
+                return files.get(self.path)
+
+            def do_HEAD(self):
+                blob = self._blob()
+                if blob is None:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                blob = self._blob()
+                if blob is None:
+                    self.send_error(404)
+                    return
+                rng = self.headers.get("Range")
+                if rng and honor_range:
+                    lo, hi = rng.split("=")[1].split("-")
+                    lo = int(lo)
+                    hi = min(int(hi), len(blob) - 1)
+                    if lo >= len(blob):
+                        self.send_error(416)
+                        return
+                    body = blob[lo : hi + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi}/{len(blob)}"
+                    )
+                else:
+                    body = blob
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+
+@pytest.fixture
+def http_server():
+    """A local range-serving HTTP server; yields (base_url, files dict)."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    files = {}
+    srv = ThreadingHTTPServer(
+        ("127.0.0.1", 0), _RangeHandler(files, honor_range=True)
+    )
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", files
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_http_primitives(http_server):
+    base, files = http_server
+    files["/data.bin"] = bytes(range(256)) * 10
+    h = fs.get_fs(f"{base}/data.bin")
+    assert isinstance(h, fs.HttpFilesystem)
+    url = f"{base}/data.bin"
+    assert h.size(url) == 2560
+    assert h.read_range(url, 0, 4) == bytes(range(4))
+    assert h.read_range(url, 2550, 100) == bytes(range(246, 256))  # EOF-short
+    assert h.read_range(url, 10_000, 4) == b""  # past EOF (416)
+    with pytest.raises(FileNotFoundError):
+        h.size(f"{base}/missing.bin")
+    with pytest.raises(OSError):
+        h.open_write(url)
+
+
+def test_http_server_ignoring_range_still_correct():
+    """A 200-without-Range server degrades to slicing, not corruption."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    files = {"/x.bin": b"0123456789abcdef"}
+    srv = ThreadingHTTPServer(
+        ("127.0.0.1", 0), _RangeHandler(files, honor_range=False)
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x.bin"
+        h = fs.HttpFilesystem()
+        assert h.read_range(url, 4, 6) == b"456789"
+        assert h.size(url) == 16
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_bam_sort_end_to_end(tmp_path, http_server):
+    """VERDICT r3 #5: a BAM sort whose *input* arrives over http:// range
+    reads through the seam produces byte-identical output to the same
+    sort over the local file."""
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    base, files = http_server
+    blob = make_bam_bytes(n=6000, seed=9)
+    files["/in.bam"] = blob
+    local_src = tmp_path / "in.bam"
+    local_src.write_bytes(blob)
+
+    out_http = tmp_path / "out_http.bam"
+    out_local = tmp_path / "out_local.bam"
+    sort_bam(
+        [f"{base}/in.bam"], str(out_http), split_size=64 << 10,
+        backend="host", level=1,
+    )
+    sort_bam(
+        [str(local_src)], str(out_local), split_size=64 << 10,
+        backend="host", level=1,
+    )
+    assert out_http.read_bytes() == out_local.read_bytes()
+    hdr, recs = bam.read_bam(out_http.read_bytes())
+    assert len(recs) == 6000
+
+
+def test_gcs_adapter_against_local_endpoint(http_server):
+    """The gs:// skeleton exercises its full code path (URL mapping, auth
+    header, range reads) against the in-test endpoint — zero egress."""
+    base, files = http_server
+    files["/bucket/ref/a.bam"] = make_bam_bytes(n=500, seed=4)
+    gcs = fs.GcsFilesystem(endpoint=base, token="sekrit")
+    assert gcs._headers["Authorization"] == "Bearer sekrit"
+    fs.register_filesystem("gs", gcs)
+    try:
+        fmt = BamInputFormat()
+        splits = fmt.get_splits(["gs://bucket/ref/a.bam"], split_size=1 << 20)
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        assert total == 500
+    finally:
+        fs._REGISTRY.pop("gs", None)
+
+
 def test_cram_split_read_is_split_local(tmp_path, counting_fs):
     import io as _io
 
