@@ -72,6 +72,22 @@ class SamInputFormat:
             )
         else:
             header = self.read_header(split.path, data=data)
+        # Vectorized fast path: the whole split tokenizes as array ops and
+        # emits the binary blob directly (byte-identical to the per-line
+        # encode); anything it cannot prove well-formed falls back to the
+        # exact per-line parser, whose error messages are the contract.
+        from .sam_vec import parse_split_vectorized
+
+        blob_arr = parse_split_vectorized(
+            np.frombuffer(data, np.uint8)
+            if not isinstance(data, np.ndarray)
+            else data,
+            split.start,
+            split.end,
+            header,
+        )
+        if blob_arr is not None:
+            return _blob_to_batch(blob_arr)
         reader = SplitLineReader(data, split.start, split.end)
         records: List[bam.BamRecord] = []
         for _, line in reader.lines():
@@ -85,10 +101,13 @@ def _records_to_batch(records: List[bam.BamRecord]) -> RecordBatch:
     """Binary-encode parsed records and run the standard SoA decode, so SAM
     text feeds the identical device pipeline as BAM."""
     blob = b"".join(r.encode() for r in records)
+    return _blob_to_batch(np.frombuffer(blob, np.uint8))
+
+
+def _blob_to_batch(arr: np.ndarray) -> RecordBatch:
+    blob = arr.tobytes()
     offsets = (
-        bam.record_offsets(np.frombuffer(blob, np.uint8), 0)
-        if blob
-        else np.empty(0, np.int64)
+        bam.record_offsets(arr, 0) if len(arr) else np.empty(0, np.int64)
     )
     soa = (
         bam.soa_decode(blob, offsets)
@@ -96,9 +115,7 @@ def _records_to_batch(records: List[bam.BamRecord]) -> RecordBatch:
         else {k: np.empty(0, np.int64) for k in bam.SOA_FIELDS}
     )
     keys = bam.soa_keys(soa, blob) if len(offsets) else np.empty(0, np.int64)
-    return RecordBatch(
-        soa=soa, data=np.frombuffer(blob, np.uint8), keys=keys
-    )
+    return RecordBatch(soa=soa, data=arr, keys=keys)
 
 
 class SamOutputWriter:

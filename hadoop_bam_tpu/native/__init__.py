@@ -28,7 +28,7 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed: Optional[str] = None
 
 MAX_BLOCK = 0x10000
-_ABI = 5
+_ABI = 6
 
 
 def _build(lib_path: str) -> None:
@@ -70,6 +70,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hbam_gather_rows.restype = None
     lib.hbam_gather_rows.argtypes = [u8p, i64p, i64p, i64, i64, u8p, ctypes.c_int]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.hbam_parse_i64.restype = i64
+    lib.hbam_parse_i64.argtypes = [u8p, i64p, i64p, i64, i64p, ctypes.c_int]
+    lib.hbam_parse_cigars.restype = i64
+    lib.hbam_parse_cigars.argtypes = [
+        u8p, i64p, i64p, i64, i64p, i64p, i64p, u32p, ctypes.c_int,
+    ]
+    lib.hbam_encode_tags.restype = i64
+    lib.hbam_encode_tags.argtypes = [
+        u8p, i64p, i64p, i64, i64p, i64p, u8p, ctypes.c_int,
+    ]
+    lib.hbam_count_byte.restype = i64
+    lib.hbam_count_byte.argtypes = [u8p, i64, i64, ctypes.c_int]
+    lib.hbam_sam_scan.restype = i64
+    lib.hbam_sam_scan.argtypes = (
+        [u8p, i64, i64, i64, i64, i64p] + [i64p] * 16 + [i64, i64]
+    )
+    lib.hbam_sam_emit.restype = i64
+    lib.hbam_sam_emit.argtypes = (
+        [u8p, i64, i64p, i64p]
+        + [i32p] * 10
+        + [i64p, i64p, i64p, u32p, i64p, u8p, i64p, i64p, u8p,
+           i64p, i64p, u8p, u8p, ctypes.c_int]
+    )
     return lib
 
 
@@ -466,4 +490,204 @@ def gather_rows(
         _ptr(ln, ctypes.c_int64), n, width, _ptr(out, ctypes.c_uint8),
         threads or default_threads(),
     )
+    return out
+
+
+def parse_i64(data, starts, lens, threads: Optional[int] = None):
+    """Vectorized decimal parse of byte slices → int64[n], or None when
+    native is unavailable; raises ValueError when any slice is not a plain
+    (optionally negative) decimal — callers fall back to the exact parser."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(data)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(len(st), dtype=np.int64)
+    if len(st) == 0:
+        return out
+    if st.min() < 0 or ln.min() < 0 or int((st + ln).max()) > len(a):
+        raise IndexError("slice extents out of bounds")
+    rc = lib.hbam_parse_i64(
+        _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64), len(st), _ptr(out, ctypes.c_int64),
+        threads or default_threads(),
+    )
+    if rc != 0:
+        raise ValueError("non-decimal field")
+    return out
+
+
+def parse_cigars(data, starts, lens, threads: Optional[int] = None):
+    """All CIGAR fields → (n_ops i64[n], opvals u32 concat, span i64[n], op_off),
+    or None when native is unavailable; ValueError on any malformed field."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(data)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    n = len(st)
+    n_ops = np.zeros(n, dtype=np.int64)
+    span = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return n_ops, np.empty(0, np.uint32), span, np.zeros(1, np.int64)
+    if st.min() < 0 or ln.min() < 0 or int((st + ln).max()) > len(a):
+        raise IndexError("slice extents out of bounds")
+    thr = threads or default_threads()
+    rc = lib.hbam_parse_cigars(
+        _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64), n, _ptr(n_ops, ctypes.c_int64),
+        _ptr(span, ctypes.c_int64), None, None, thr,
+    )
+    if rc != 0:
+        raise ValueError("malformed CIGAR")
+    op_off = np.concatenate(([0], np.cumsum(n_ops)))
+    opvals = np.empty(int(op_off[-1]), dtype=np.uint32)
+    if len(opvals):
+        rc = lib.hbam_parse_cigars(
+            _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+            _ptr(ln, ctypes.c_int64), n, _ptr(n_ops, ctypes.c_int64),
+            _ptr(span, ctypes.c_int64), _ptr(op_off, ctypes.c_int64),
+            _ptr(opvals, ctypes.c_uint32), thr,
+        )
+        if rc != 0:
+            raise ValueError("malformed CIGAR")
+    return n_ops, opvals, span, op_off
+
+
+def sam_emit(
+    text, rec_off, body_len, cols, name_src, name_len, op_off, opvals,
+    seq_src, seq_star, qual_src, qual_len, qual_star, tag_off, tag_len,
+    tag_blob, total: int, threads: Optional[int] = None,
+):
+    """Assemble all binary SAM records in one threaded native pass.
+
+    ``cols`` = (refid, pos0, mapq, bin, n_ops, flag, l_seq, nrefid, npos0,
+    tlen) int32 arrays.  Returns the uint8 blob, or None when native is
+    unavailable; ValueError on a QUAL byte below '!'."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(text)
+    out = np.empty(total, dtype=np.uint8)  # C writes every byte
+    n = len(rec_off)
+    if n == 0:
+        return out
+    i64c = lambda x: np.ascontiguousarray(x, dtype=np.int64)
+    i32c = lambda x: np.ascontiguousarray(x, dtype=np.int32)
+    u8c = lambda x: np.ascontiguousarray(x, dtype=np.uint8)
+    cols32 = [i32c(c) for c in cols]
+    ov = np.ascontiguousarray(opvals, dtype=np.uint32)
+    args = (
+        [_ptr(a, ctypes.c_uint8), n,
+         _ptr(i64c(rec_off), ctypes.c_int64),
+         _ptr(i64c(body_len), ctypes.c_int64)]
+        + [_ptr(c, ctypes.c_int32) for c in cols32]
+        + [
+            _ptr(i64c(name_src), ctypes.c_int64),
+            _ptr(i64c(name_len), ctypes.c_int64),
+            _ptr(i64c(op_off), ctypes.c_int64),
+            _ptr(ov, ctypes.c_uint32),
+            _ptr(i64c(seq_src), ctypes.c_int64),
+            _ptr(u8c(seq_star), ctypes.c_uint8),
+            _ptr(i64c(qual_src), ctypes.c_int64),
+            _ptr(i64c(qual_len), ctypes.c_int64),
+            _ptr(u8c(qual_star), ctypes.c_uint8),
+            _ptr(i64c(tag_off), ctypes.c_int64),
+            _ptr(i64c(tag_len), ctypes.c_int64),
+            _ptr(u8c(tag_blob), ctypes.c_uint8),
+            _ptr(out, ctypes.c_uint8),
+            threads or default_threads(),
+        ]
+    )
+    rc = lib.hbam_sam_emit(*args)
+    if rc != 0:
+        raise ValueError("QUAL byte below '!'")
+    return out
+
+
+def encode_tags(text, starts, lens, threads: Optional[int] = None):
+    """SAM tag tokens → (enc_len i64[n], blob u8), or None when native is
+    unavailable; ValueError when any token needs the exact encoder."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(text)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    n = len(st)
+    enc_len = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return enc_len, np.empty(0, np.uint8)
+    if st.min() < 0 or ln.min() < 0 or int((st + ln).max()) > len(a):
+        raise IndexError("token extents out of bounds")
+    thr = threads or default_threads()
+    rc = lib.hbam_encode_tags(
+        _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64), n, _ptr(enc_len, ctypes.c_int64),
+        None, None, thr,
+    )
+    if rc != 0:
+        raise ValueError("tag token needs exact encoder")
+    dst = np.concatenate(([0], np.cumsum(enc_len)))
+    blob = np.empty(int(dst[-1]), dtype=np.uint8)
+    rc = lib.hbam_encode_tags(
+        _ptr(a, ctypes.c_uint8), _ptr(st, ctypes.c_int64),
+        _ptr(ln, ctypes.c_int64), n, _ptr(enc_len, ctypes.c_int64),
+        _ptr(dst, ctypes.c_int64), _ptr(blob, ctypes.c_uint8), thr,
+    )
+    if rc != 0:
+        raise ValueError("tag token needs exact encoder")
+    return enc_len, blob
+
+
+def sam_scan(text, lo: int, hi: int, window_end: int):
+    """One native pass over a SAM split: line table + 11-field table +
+    core integers + tag-token table.  Returns a dict of arrays, None when
+    native is unavailable, or ValueError when any line needs the exact
+    parser."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(text)
+    nl_bound = (
+        lib.hbam_count_byte(_ptr(a, ctypes.c_uint8), lo, min(hi, window_end), 0x0A)
+        + 1
+    )
+    tab_bound = lib.hbam_count_byte(
+        _ptr(a, ctypes.c_uint8), lo, window_end, 0x09
+    ) + 1
+    counts = np.zeros(2, dtype=np.int64)
+    ints = np.empty(5 * nl_bound, dtype=np.int64)
+    cols = {
+        k: np.empty(nl_bound, dtype=np.int64)
+        for k in (
+            "name_src", "name_len", "rname_src", "rname_len", "cigar_src",
+            "cigar_len", "rnext_src", "rnext_len", "seq_src", "seq_len",
+            "qual_src", "qual_len",
+        )
+    }
+    tok_start = np.empty(tab_bound, dtype=np.int64)
+    tok_len = np.empty(tab_bound, dtype=np.int64)
+    tok_rid = np.empty(tab_bound, dtype=np.int64)
+    rc = lib.hbam_sam_scan(
+        _ptr(a, ctypes.c_uint8), len(a), lo, hi, window_end,
+        _ptr(counts, ctypes.c_int64), _ptr(ints, ctypes.c_int64),
+        *(_ptr(cols[k], ctypes.c_int64) for k in (
+            "name_src", "name_len", "rname_src", "rname_len", "cigar_src",
+            "cigar_len", "rnext_src", "rnext_len", "seq_src", "seq_len",
+            "qual_src", "qual_len",
+        )),
+        _ptr(tok_start, ctypes.c_int64), _ptr(tok_len, ctypes.c_int64),
+        _ptr(tok_rid, ctypes.c_int64), nl_bound, tab_bound,
+    )
+    if rc != 0:
+        raise ValueError("SAM line needs exact parser")
+    n, T = int(counts[0]), int(counts[1])
+    out = {k: v[:n] for k, v in cols.items()}
+    out["ints"] = ints[: 5 * n].reshape(n, 5)
+    out["tok_start"] = tok_start[:T]
+    out["tok_len"] = tok_len[:T]
+    out["tok_rid"] = tok_rid[:T]
     return out

@@ -11,7 +11,9 @@
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 
 #include <atomic>
+#include <cctype>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -316,6 +318,447 @@ void hbam_gather_rows(const uint8_t* data, const int64_t* starts,
   });
 }
 
-int hbam_abi_version() { return 5; }
+// ---------------------------------------------------------------------------
+// SAM text parse helpers: the memcpy-class inner loops of the vectorized
+// SAM tokenizer (io/sam_vec.py).  NumPy owns tokenization and validation
+// structure; these functions replace its index-array scatters with threaded
+// per-record loops.  All return 0 on success, 1 when any row needs the
+// exact per-line parser (the caller falls back for the whole split).
+// ---------------------------------------------------------------------------
+
+int64_t hbam_parse_i64(const uint8_t* data, const int64_t* starts,
+                       const int64_t* lens, int64_t n, int64_t* out,
+                       int threads) {
+  std::atomic<int64_t> fail(0);
+  run_parallel(n, threads, [&](int64_t i) {
+    const uint8_t* p = data + starts[i];
+    int64_t len = lens[i];
+    if (len <= 0 || len > 19) { fail.store(1); out[i] = 0; return; }
+    int64_t k = 0;
+    bool neg = p[0] == '-';
+    if (neg) k = 1;
+    if (k >= len) { fail.store(1); out[i] = 0; return; }
+    int64_t v = 0;
+    for (; k < len; ++k) {
+      const uint8_t c = p[k];
+      if (c < '0' || c > '9') { fail.store(1); out[i] = 0; return; }
+      v = v * 10 + (c - '0');
+    }
+    out[i] = neg ? -v : v;
+  });
+  return fail.load();
+}
+
+namespace {
+constexpr const char kCigarOps[] = "MIDNSHP=X";
+int8_t cigar_code(uint8_t c) {
+  for (int k = 0; k < 9; ++k)
+    if (kCigarOps[k] == c) return static_cast<int8_t>(k);
+  return -1;
+}
+// Ops consuming reference bases (span for reg2bin): M D N = X
+constexpr uint16_t kCigarRefMask = (1u << 0) | (1u << 2) | (1u << 3) |
+                                   (1u << 7) | (1u << 8);
+}  // namespace
+
+// Pass 1 (opvals == nullptr): validate + count ops + reference span.
+// Pass 2 (opvals != nullptr): fill BAM-encoded (len<<4|op) u32s at op_off.
+int64_t hbam_parse_cigars(const uint8_t* data, const int64_t* starts,
+                          const int64_t* lens, int64_t n, int64_t* n_ops,
+                          int64_t* span, const int64_t* op_off,
+                          uint32_t* opvals, int threads) {
+  std::atomic<int64_t> fail(0);
+  run_parallel(n, threads, [&](int64_t i) {
+    const uint8_t* p = data + starts[i];
+    const int64_t len = lens[i];
+    if (len <= 0) { fail.store(1); return; }
+    if (len == 1 && p[0] == '*') {
+      if (opvals == nullptr) { n_ops[i] = 0; span[i] = 0; }
+      return;
+    }
+    uint32_t* dst = opvals ? opvals + op_off[i] : nullptr;
+    int64_t ops = 0, sp = 0, k = 0;
+    while (k < len) {
+      int64_t d = 0, v = 0;
+      while (k < len && p[k] >= '0' && p[k] <= '9') {
+        v = v * 10 + (p[k] - '0');
+        ++k; ++d;
+      }
+      if (d == 0 || d > 9 || v >= (1 << 28) || k >= len) {
+        fail.store(1);
+        return;
+      }
+      const int8_t code = cigar_code(p[k]);
+      if (code < 0) { fail.store(1); return; }
+      ++k;
+      if (dst) dst[ops] = (static_cast<uint32_t>(v) << 4) | code;
+      if (kCigarRefMask & (1u << code)) sp += v;
+      ++ops;
+    }
+    if (opvals == nullptr) { n_ops[i] = ops; span[i] = sp; }
+  });
+  return fail.load();
+}
+
+namespace {
+struct SeqLut {
+  uint8_t t[256];
+  SeqLut() {
+    for (int i = 0; i < 256; ++i) t[i] = 15;
+    const char* alphabet = "=ACMGRSVTWYHKDBN";
+    for (int i = 0; i < 16; ++i) {
+      t[static_cast<uint8_t>(alphabet[i])] = i;
+      t[static_cast<uint8_t>(std::tolower(alphabet[i]))] = i;
+    }
+  }
+};
+const SeqLut kSeqLut;
+}  // namespace
+
+// Assemble every binary SAM record in one threaded pass: fixed fields,
+// name+NUL, CIGAR u32s, packed SEQ nibbles, QUAL (-33 or 0xFF fill), tags.
+// Every output byte is written (callers may pass uninitialized memory).
+// Returns 1 if any QUAL byte is < '!' (exact path errors).
+int64_t hbam_sam_emit(
+    const uint8_t* text, int64_t n, const int64_t* rec_off,
+    const int64_t* body_len, const int32_t* refid, const int32_t* pos0,
+    const int32_t* mapq, const int32_t* bin, const int32_t* n_ops,
+    const int32_t* flag, const int32_t* l_seq, const int32_t* nrefid,
+    const int32_t* npos0, const int32_t* tlen, const int64_t* name_src,
+    const int64_t* name_len, const int64_t* op_off, const uint32_t* opvals,
+    const int64_t* seq_src, const uint8_t* seq_star, const int64_t* qual_src,
+    const int64_t* qual_len, const uint8_t* qual_star,
+    const int64_t* tag_off, const int64_t* tag_len, const uint8_t* tag_blob,
+    uint8_t* out, int threads) {
+  std::atomic<int64_t> fail(0);
+  run_parallel(n, threads, [&](int64_t i) {
+    uint8_t* r = out + rec_off[i];
+    auto w32 = [](uint8_t* p, uint32_t v) { std::memcpy(p, &v, 4); };
+    auto w16 = [](uint8_t* p, uint16_t v) { std::memcpy(p, &v, 2); };
+    w32(r, static_cast<uint32_t>(body_len[i]));
+    uint8_t* b = r + 4;
+    w32(b + 0, static_cast<uint32_t>(refid[i]));
+    w32(b + 4, static_cast<uint32_t>(pos0[i]));
+    b[8] = static_cast<uint8_t>(name_len[i] + 1);
+    b[9] = static_cast<uint8_t>(mapq[i]);
+    w16(b + 10, static_cast<uint16_t>(bin[i]));
+    w16(b + 12, static_cast<uint16_t>(n_ops[i]));
+    w16(b + 14, static_cast<uint16_t>(flag[i]));
+    w32(b + 16, static_cast<uint32_t>(l_seq[i]));
+    w32(b + 20, static_cast<uint32_t>(nrefid[i]));
+    w32(b + 24, static_cast<uint32_t>(npos0[i]));
+    w32(b + 28, static_cast<uint32_t>(tlen[i]));
+    uint8_t* p = b + 32;
+    std::memcpy(p, text + name_src[i], name_len[i]);
+    p[name_len[i]] = 0;
+    p += name_len[i] + 1;
+    std::memcpy(p, opvals + op_off[i], 4 * n_ops[i]);
+    p += 4 * n_ops[i];
+    const int64_t ls = l_seq[i];
+    if (!seq_star[i] && ls > 0) {
+      const uint8_t* s = text + seq_src[i];
+      int64_t j = 0;
+      for (; j + 1 < ls; j += 2)
+        p[j >> 1] = (kSeqLut.t[s[j]] << 4) | kSeqLut.t[s[j + 1]];
+      if (j < ls) p[j >> 1] = kSeqLut.t[s[j]] << 4;
+    }
+    p += (ls + 1) / 2;
+    if (qual_star[i]) {
+      std::memset(p, 0xFF, ls);
+      p += ls;
+    } else {
+      const uint8_t* q = text + qual_src[i];
+      const int64_t ql = qual_len[i];
+      for (int64_t j = 0; j < ql; ++j) {
+        if (q[j] < 33) { fail.store(1); return; }
+        p[j] = q[j] - 33;
+      }
+      p += ql;
+    }
+    std::memcpy(p, tag_blob + tag_off[i], tag_len[i]);
+  });
+  return fail.load();
+}
+
+namespace {
+// Strict decimal int parse over [p, p+len); returns false on anything
+// Python's int() would accept but this doesn't (caller bails to the exact
+// parser — a strict subset keeps byte-equivalence).
+bool parse_int_strict(const uint8_t* p, int64_t len, int64_t* out) {
+  if (len <= 0 || len > 19) return false;
+  int64_t k = (p[0] == '-') ? 1 : 0;
+  if (k >= len) return false;
+  int64_t v = 0;
+  for (; k < len; ++k) {
+    if (p[k] < '0' || p[k] > '9') return false;
+    v = v * 10 + (p[k] - '0');
+  }
+  *out = (p[0] == '-') ? -v : v;
+  return true;
+}
+
+int tag_int_width(int64_t v, uint8_t* code) {
+  if (v >= -128 && v <= 127) { *code = 'c'; return 1; }
+  if (v >= 0 && v <= 255) { *code = 'C'; return 1; }
+  if (v >= -32768 && v <= 32767) { *code = 's'; return 2; }
+  if (v >= 0 && v <= 65535) { *code = 'S'; return 2; }
+  if (v >= INT64_C(-2147483648) && v <= INT64_C(2147483647)) {
+    *code = 'i'; return 4;
+  }
+  if (v >= 0 && v <= INT64_C(4294967295)) { *code = 'I'; return 4; }
+  return 0;  // out of u32 range: exact path raises
+}
+
+int b_elem_size(uint8_t e) {
+  switch (e) {
+    case 'c': case 'C': return 1;
+    case 's': case 'S': return 2;
+    case 'i': case 'I': case 'f': return 4;
+    default: return 0;
+  }
+}
+
+bool b_elem_range(uint8_t e, int64_t v) {
+  switch (e) {
+    case 'c': return v >= -128 && v <= 127;
+    case 'C': return v >= 0 && v <= 255;
+    case 's': return v >= -32768 && v <= 32767;
+    case 'S': return v >= 0 && v <= 65535;
+    case 'i': return v >= INT64_C(-2147483648) && v <= INT64_C(2147483647);
+    case 'I': return v >= 0 && v <= INT64_C(4294967295);
+    default: return false;
+  }
+}
+
+// Parse a float value the way Python's float() + struct.pack('<f') does:
+// decimal → double (strtod) → float (the same double rounding).  Any form
+// where strtod and Python float() could diverge — hex floats ("0x1p3",
+// "-0X2"), nan payloads ("nan(1)"), whitespace — fails instead, sending
+// the token to the exact encoder (strict subset keeps byte-equivalence).
+bool parse_f32(const uint8_t* p, int64_t len, float* out) {
+  if (len <= 0 || len > 63) return false;
+  char buf[64];
+  for (int64_t i = 0; i < len; ++i) {
+    const uint8_t c = p[i];
+    if (c == 'x' || c == 'X' || c == '(' || c == ' ' || c == '\t')
+      return false;
+    buf[i] = static_cast<char>(c);
+  }
+  buf[len] = 0;
+  char* end = nullptr;
+  double d = std::strtod(buf, &end);
+  if (end != buf + len) return false;
+  *out = static_cast<float>(d);
+  return true;
+}
+}  // namespace
+
+// SAM tag tokens → binary BAM tag encoding, two passes like
+// hbam_parse_cigars: pass 1 (blob == nullptr) computes enc_len per token
+// (validating); pass 2 emits at dst[t].  Tokens are TAG:T:VALUE with
+// len >= 5 (caller pre-filters).  Returns 0 ok, 1 bail-to-exact-path.
+int64_t hbam_encode_tags(const uint8_t* text, const int64_t* starts,
+                         const int64_t* lens, int64_t n, int64_t* enc_len,
+                         const int64_t* dst, uint8_t* blob, int threads) {
+  std::atomic<int64_t> fail(0);
+  run_parallel(n, threads, [&](int64_t t) {
+    const uint8_t* p = text + starts[t];
+    const int64_t len = lens[t];
+    const uint8_t typ = p[3];
+    const uint8_t* v = p + 5;
+    const int64_t vlen = len - 5;
+    uint8_t* o = blob ? blob + dst[t] : nullptr;
+    if (o) { o[0] = p[0]; o[1] = p[1]; o[2] = typ; }
+    switch (typ) {
+      case 'A': {
+        if (!o) { enc_len[t] = 3 + (vlen > 0 ? 1 : 0); return; }
+        if (vlen > 0) o[3] = v[0];
+        return;
+      }
+      case 'i': {
+        int64_t iv;
+        uint8_t code;
+        if (!parse_int_strict(v, vlen, &iv)) { fail.store(1); return; }
+        const int w = tag_int_width(iv, &code);
+        if (w == 0) { fail.store(1); return; }
+        if (!o) { enc_len[t] = 3 + w; return; }
+        o[2] = code;
+        for (int b = 0; b < w; ++b) o[3 + b] = (iv >> (8 * b)) & 0xFF;
+        return;
+      }
+      case 'f': {
+        float f;
+        if (!parse_f32(v, vlen, &f)) { fail.store(1); return; }
+        if (!o) { enc_len[t] = 7; return; }
+        std::memcpy(o + 3, &f, 4);
+        return;
+      }
+      case 'Z':
+      case 'H': {
+        if (!o) { enc_len[t] = 3 + vlen + 1; return; }
+        std::memcpy(o + 3, v, vlen);
+        o[3 + vlen] = 0;
+        return;
+      }
+      case 'B': {
+        if (vlen < 1) { fail.store(1); return; }
+        const uint8_t elem = v[0];
+        const int es = b_elem_size(elem);
+        if (es == 0) { fail.store(1); return; }
+        // Count and validate comma-separated values.
+        int64_t count = 0, k = 1;
+        uint8_t* w = o ? o + 8 : nullptr;
+        while (k < vlen) {
+          if (v[k] != ',') { fail.store(1); return; }
+          ++k;
+          int64_t e = k;
+          while (e < vlen && v[e] != ',') ++e;
+          if (elem == 'f') {
+            float f;
+            if (!parse_f32(v + k, e - k, &f)) { fail.store(1); return; }
+            if (w) { std::memcpy(w, &f, 4); w += 4; }
+          } else {
+            int64_t iv;
+            if (!parse_int_strict(v + k, e - k, &iv) ||
+                !b_elem_range(elem, iv)) {
+              fail.store(1);
+              return;
+            }
+            if (w) {
+              for (int b = 0; b < es; ++b) w[b] = (iv >> (8 * b)) & 0xFF;
+              w += es;
+            }
+          }
+          ++count;
+          k = e;
+        }
+        if (!o) { enc_len[t] = 3 + 1 + 4 + count * es; return; }
+        o[3] = elem;
+        const uint32_t c32 = static_cast<uint32_t>(count);
+        std::memcpy(o + 4, &c32, 4);
+        return;
+      }
+      default:
+        fail.store(1);  // unknown type: exact path raises SamError
+        return;
+    }
+  });
+  return fail.load();
+}
+
+int64_t hbam_count_byte(const uint8_t* text, int64_t start, int64_t end,
+                        int needle) {
+  int64_t n = 0;
+  const uint8_t* p = text + start;
+  const uint8_t* const e = text + end;
+  while (p < e) {
+    const uint8_t* hit =
+        static_cast<const uint8_t*>(std::memchr(p, needle, e - p));
+    if (!hit) break;
+    ++n;
+    p = hit + 1;
+  }
+  return n;
+}
+
+// One serial memchr-paced pass over the SAM lines of [lo, hi): the line
+// table, the 11-field table, the five core integer fields, and the tag
+// token table (row-major, tokens < 5 bytes skipped like the exact parser).
+// Header ('@') and empty lines are skipped.  Outputs are sized by the
+// caller from hbam_count_byte bounds.  counts[0]=lines, counts[1]=tokens.
+// Returns 0 ok; 1 when any line needs the exact parser (field count < 11,
+// non-decimal core field, line cut off by window_end when more file
+// follows).
+int64_t hbam_sam_scan(
+    const uint8_t* text, int64_t len, int64_t lo, int64_t hi,
+    int64_t window_end, int64_t* counts, int64_t* ints /* [5*cap] */,
+    int64_t* name_src, int64_t* name_len, int64_t* rname_src,
+    int64_t* rname_len, int64_t* cigar_src, int64_t* cigar_len,
+    int64_t* rnext_src, int64_t* rnext_len, int64_t* seq_src,
+    int64_t* seq_len, int64_t* qual_src, int64_t* qual_len,
+    int64_t* tok_start, int64_t* tok_len, int64_t* tok_rid,
+    int64_t line_cap, int64_t tok_cap) {
+  int64_t n = 0, T = 0;
+  int64_t p = lo;
+  while (p < hi && p < len) {
+    const uint8_t* nl = static_cast<const uint8_t*>(
+        std::memchr(text + p, '\n', window_end - p));
+    int64_t e = nl ? (nl - text) : window_end;
+    const int64_t next = e + 1;
+    if (!nl && window_end < len) return 1;  // cut off by the scan window
+    if (e > p && text[e - 1] == '\r') --e;
+    if (e == p || text[p] == '@') {  // empty or header line
+      p = next;
+      continue;
+    }
+    if (n >= line_cap) return 1;
+    // 11 fields split on the first 10 tabs.
+    int64_t fs[12];
+    fs[0] = p;
+    int64_t k = 1;
+    const uint8_t* q = text + p;
+    const uint8_t* const qe = text + e;
+    while (k <= 10) {
+      const uint8_t* t =
+          static_cast<const uint8_t*>(std::memchr(q, '\t', qe - q));
+      if (!t) break;
+      fs[k++] = (t - text) + 1;
+      q = t + 1;
+    }
+    if (k <= 10) return 1;  // < 11 fields
+    // Field 10 (QUAL) ends at the next tab (tags follow) or line end.
+    const uint8_t* t10 =
+        static_cast<const uint8_t*>(std::memchr(q, '\t', qe - q));
+    const int64_t f10_end = t10 ? (t10 - text) : e;
+    // Core integers: flag(1) pos(3) mapq(4) pnext(7) tlen(8).
+    static const int kIntField[5] = {1, 3, 4, 7, 8};
+    for (int c = 0; c < 5; ++c) {
+      const int f = kIntField[c];
+      const int64_t fe = fs[f + 1] - 1;
+      if (!parse_int_strict(text + fs[f], fe - fs[f], &ints[5 * n + c]))
+        return 1;
+    }
+    // QNAME ('*' → empty name).
+    const int64_t ql = fs[1] - 1 - fs[0];
+    name_src[n] = fs[0];
+    name_len[n] = (ql == 1 && text[fs[0]] == '*') ? 0 : ql;
+    rname_src[n] = fs[2];
+    rname_len[n] = fs[3] - 1 - fs[2];
+    cigar_src[n] = fs[5];
+    cigar_len[n] = fs[6] - 1 - fs[5];
+    rnext_src[n] = fs[6];
+    rnext_len[n] = fs[7] - 1 - fs[6];
+    seq_src[n] = fs[9];
+    seq_len[n] = fs[10] - 1 - fs[9];
+    qual_src[n] = fs[10];
+    qual_len[n] = f10_end - fs[10];
+    // Tag tokens after field 10.
+    if (t10) {
+      const uint8_t* r = t10 + 1;
+      while (r <= qe) {
+        const uint8_t* t =
+            static_cast<const uint8_t*>(std::memchr(r, '\t', qe - r));
+        const uint8_t* te = t ? t : qe;
+        const int64_t tl = te - r;
+        if (tl >= 5) {
+          if (T >= tok_cap) return 1;
+          tok_start[T] = r - text;
+          tok_len[T] = tl;
+          tok_rid[T] = n;
+          ++T;
+        }
+        if (!t) break;
+        r = t + 1;
+      }
+    }
+    ++n;
+    p = next;
+  }
+  counts[0] = n;
+  counts[1] = T;
+  return 0;
+}
+
+int hbam_abi_version() { return 6; }
 
 }  // extern "C"
