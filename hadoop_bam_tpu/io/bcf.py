@@ -278,6 +278,9 @@ class BcfInputFormat:
                 p = split.vstart >> 16
                 end = split.vend >> 16
             hdr, _ = read_bcf_header(data, compressed)
+        fast = _read_vectorized(payload, p, end, hdr, intervals)
+        if fast is not None:
+            return fast
         variants: List[bcf.BcfVariant] = []
         while p + 8 <= end:
             try:
@@ -299,6 +302,102 @@ class BcfInputFormat:
         return VariantBatch(
             header=hdr.vcf, variants=variants, keys=keys, pos=pos, end=endp
         )
+
+
+def _read_vectorized(
+    payload, p: int, end: int, hdr: bcf.BcfHeader, intervals
+) -> Optional[VariantBatch]:
+    """Batched BCF split decode (VERDICT r3 #4): one serial chain walk
+    finds every record boundary, the fixed-width shared prefix decodes as
+    NumPy gathers over the whole payload, and the 64-bit keys and
+    pos/end columns come out as array ops — no per-record Python.  The
+    ``VariantContext`` rows stay lazy (``bcf.decode_record`` runs only if
+    a consumer asks — the LazyBCFGenotypesContext stance one level up).
+
+    Returns None when anything needs the exact per-record path — a
+    truncated/misaligned chain, a CHROM outside the dictionaries, any
+    typed value the C validator cannot prove the exact decoder would
+    accept (bad type codes, out-of-range dictionary indexes, shared-block
+    length mismatches, ambiguous INFO END) — so the exact parser's error
+    semantics (incl. STRICT stringency raises) stay the contract."""
+    from .. import native
+
+    a = (
+        payload
+        if isinstance(payload, np.ndarray)
+        else np.frombuffer(payload, np.uint8)
+    )
+    if not native.available():
+        return None  # the chain walk is serial: C or nothing
+    try:
+        end_key = hdr.strings.index("END") if "END" in hdr.strings else -1
+        offs, ref_len, end_info = native.bcf_scan(
+            a, p, end, len(hdr.contigs), len(hdr.strings), end_key
+        )
+    except ValueError:
+        return None
+    n = len(offs)
+    if n == 0:
+        return VariantBatch(header=hdr.vcf, variants=[])
+
+    def i32(at: np.ndarray) -> np.ndarray:
+        return (
+            a[at].astype(np.uint32)
+            | (a[at + 1].astype(np.uint32) << 8)
+            | (a[at + 2].astype(np.uint32) << 16)
+            | (a[at + 3].astype(np.uint32) << 24)
+        ).astype(np.int32)
+
+    body = offs + 8
+    chrom_i = i32(body)
+    pos0 = i32(body + 4).astype(np.int64)
+    # BCF contig order need not match the VCF header's contig-line order
+    # (IDX= overrides): map through the VCF dictionary once per contig
+    # (contig_index never raises — unknown names get the murmur3 key).
+    vmap = np.empty(len(hdr.contigs), dtype=np.int64)
+    for ci, name in enumerate(hdr.contigs):
+        vmap[ci] = hdr.vcf.contig_index(name)
+    idx = vmap[chrom_i]
+    # variant_key semantics including the Java sign-extension quirk: a
+    # negative (pos-1) floods the high word (POS=0 telomeric records).
+    keys = (idx << 32) | np.where(pos0 < 0, pos0, pos0 & 0xFFFFFFFF)
+    pos1 = pos0 + 1
+    # end: INFO END when present (the exact path's END= regex), else
+    # pos + len(REF) - 1 — both extracted by the C scan.
+    endp = np.where(
+        end_info != np.iinfo(np.int64).min, end_info, pos0 + ref_len
+    )
+
+    if intervals is not None:
+        name_to_ci = {name: ci for ci, name in enumerate(hdr.contigs)}
+        keep = np.zeros(n, dtype=bool)
+        for iv in intervals:
+            ci = name_to_ci.get(iv.contig)
+            if ci is None:
+                continue
+            keep |= (
+                (chrom_i == ci) & (pos1 <= iv.end) & (endp >= iv.start)
+            )
+        offs, keys, pos1, endp = (
+            offs[keep], keys[keep], pos1[keep], endp[keep]
+        )
+
+    kept = offs
+
+    def materialize() -> List[bcf.BcfVariant]:
+        out: List[bcf.BcfVariant] = []
+        for o in kept:
+            v, _ = bcf.decode_record(payload, int(o), hdr)
+            out.append(v)
+        return out
+
+    return VariantBatch(
+        header=hdr.vcf,
+        keys=keys,
+        pos=pos1,
+        end=endp,
+        materializer=materialize,
+    )
 
 
 def _read_bcf_header_prefix(path: str):

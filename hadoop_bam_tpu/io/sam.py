@@ -105,16 +105,15 @@ def _records_to_batch(records: List[bam.BamRecord]) -> RecordBatch:
 
 
 def _blob_to_batch(arr: np.ndarray) -> RecordBatch:
-    blob = arr.tobytes()
     offsets = (
         bam.record_offsets(arr, 0) if len(arr) else np.empty(0, np.int64)
     )
     soa = (
-        bam.soa_decode(blob, offsets)
+        bam.soa_decode(arr, offsets)
         if len(offsets)
         else {k: np.empty(0, np.int64) for k in bam.SOA_FIELDS}
     )
-    keys = bam.soa_keys(soa, blob) if len(offsets) else np.empty(0, np.int64)
+    keys = bam.soa_keys(soa, arr) if len(offsets) else np.empty(0, np.int64)
     return RecordBatch(soa=soa, data=arr, keys=keys)
 
 

@@ -532,7 +532,11 @@ def _finish(a, sc: dict, header) -> Optional[np.ndarray]:
     l_seq = np.where(seq_star, 0, seq_len)
     seq_bytes = (l_seq + 1) // 2
     qual_len = sc["qual_len"]
-    qual_star = (qual_len == 1) & (a[sc["qual_src"]] == 0x2A)
+    # '*' OR empty: build_record's `qual if qual else 0xFF*l_seq` treats an
+    # empty (zero-length) QUAL field exactly like '*'.
+    qual_star = (
+        (qual_len == 1) & (a[sc["qual_src"]] == 0x2A)
+    ) | (qual_len == 0)
     qual_bytes = np.where(qual_star, l_seq, qual_len)
 
     res = _encode_tags(a, sc["tok_start"], sc["tok_len"], sc["tok_rid"], n)
@@ -552,6 +556,8 @@ def _finish(a, sc: dict, header) -> Optional[np.ndarray]:
     eff_span = np.where((flag & bam.FLAG_UNMAPPED) != 0, 1,
                         np.maximum(1, span))
     bin_ = np.where(pos0 >= 0, _reg2bin_np(pos0, pos0 + eff_span), 4680)
+    if (bin_ > 0xFFFF).any():
+        return None  # bin overflows u16 (> ~1 Gbp positions): exact raises
     op_off = np.concatenate(([0], np.cumsum(n_ops)))[:-1]
     tag_at_rec = np.concatenate(([0], np.cumsum(tag_rec_bytes)))[:-1]
 

@@ -83,6 +83,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hbam_count_byte.restype = i64
     lib.hbam_count_byte.argtypes = [u8p, i64, i64, ctypes.c_int]
+    lib.hbam_bcf_scan.restype = i64
+    lib.hbam_bcf_scan.argtypes = [
+        u8p, i64, i64, i64, i64, i64, i64, i64p, i64p, i64p, i64,
+    ]
     lib.hbam_sam_scan.restype = i64
     lib.hbam_sam_scan.argtypes = (
         [u8p, i64, i64, i64, i64, i64p] + [i64p] * 16 + [i64, i64]
@@ -691,3 +695,33 @@ def sam_scan(text, lo: int, hi: int, window_end: int):
     out["tok_len"] = tok_len[:T]
     out["tok_rid"] = tok_rid[:T]
     return out
+
+
+def bcf_scan(data, start: int, end: int, n_contigs: int, n_strings: int,
+             end_key: int):
+    """BCF chain walk + full shared-block validation in one C pass.
+
+    Returns (offsets i64[n], ref_len i64[n], end_info i64[n] with
+    INT64_MIN for absent INFO/END), None when native is unavailable, or
+    ValueError when any record needs the exact decoder (truncation, bad
+    typed values, out-of-range dictionary indexes, ambiguous END)."""
+    lib = _get()
+    if lib is None:
+        return None
+    a = _as_u8(data)
+    # A record is >= 32 bytes (8-byte lengths + 24 fixed shared).
+    cap = max(16, (end - start) // 32 + 2)
+    offs = np.empty(cap, dtype=np.int64)
+    ref_len = np.empty(cap, dtype=np.int64)
+    end_info = np.empty(cap, dtype=np.int64)
+    n = lib.hbam_bcf_scan(
+        _ptr(a, ctypes.c_uint8), len(a), start, end,
+        n_contigs, n_strings, end_key,
+        _ptr(offs, ctypes.c_int64), _ptr(ref_len, ctypes.c_int64),
+        _ptr(end_info, ctypes.c_int64), cap,
+    )
+    if n == -1:
+        raise ValueError("BCF record needs exact decoder")
+    if n == -2:
+        raise ValueError("BCF chain capacity exceeded")
+    return offs[:n].copy(), ref_len[:n].copy(), end_info[:n].copy()

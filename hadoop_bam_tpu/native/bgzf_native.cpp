@@ -12,6 +12,7 @@
 
 #include <atomic>
 #include <cctype>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -337,7 +338,8 @@ int64_t hbam_parse_i64(const uint8_t* data, const int64_t* starts,
     int64_t k = 0;
     bool neg = p[0] == '-';
     if (neg) k = 1;
-    if (k >= len) { fail.store(1); out[i] = 0; return; }
+    // 18 digits max: 19 could overflow int64 in v*10+d (signed UB).
+    if (k >= len || len - k > 18) { fail.store(1); out[i] = 0; return; }
     int64_t v = 0;
     for (; k < len; ++k) {
       const uint8_t c = p[k];
@@ -485,7 +487,9 @@ namespace {
 // Python's int() would accept but this doesn't (caller bails to the exact
 // parser — a strict subset keeps byte-equivalence).
 bool parse_int_strict(const uint8_t* p, int64_t len, int64_t* out) {
+  // 18 digits max: 19 could overflow int64 in v*10+d (signed UB).
   if (len <= 0 || len > 19) return false;
+  if (len - ((p[0] == '-') ? 1 : 0) > 18) return false;
   int64_t k = (p[0] == '-') ? 1 : 0;
   if (k >= len) return false;
   int64_t v = 0;
@@ -548,7 +552,11 @@ bool parse_f32(const uint8_t* p, int64_t len, float* out) {
   char* end = nullptr;
   double d = std::strtod(buf, &end);
   if (end != buf + len) return false;
-  *out = static_cast<float>(d);
+  const float f = static_cast<float>(d);
+  // A finite double overflowing to float inf: struct.pack('<f') raises
+  // OverflowError — the exact encoder must own that error.
+  if (std::isfinite(d) && !std::isfinite(f)) return false;
+  *out = f;
   return true;
 }
 }  // namespace
@@ -757,6 +765,199 @@ int64_t hbam_sam_scan(
   counts[0] = n;
   counts[1] = T;
   return 0;
+}
+
+namespace {
+// One BCF typed value, mirroring spec/bcf.py read_typed_value's accepted
+// forms CONSERVATIVELY: any deviation (bad type code, nonstandard len-15
+// extension, missing/EOV where a scalar is required) reports failure and
+// the caller falls back to the exact decoder, whose error semantics are
+// the contract.  On success *p advances past the value.
+struct TypedVal {
+  int t = 0;        // type code
+  int64_t len = 0;  // element count
+  int64_t at = 0;   // first payload byte
+  int64_t first = 0;     // first element (int types only)
+  bool first_ok = false; // first element present and not MISSING/EOV
+};
+
+bool bcf_typed_skip(const uint8_t* b, int64_t limit, int64_t* p,
+                    TypedVal* out) {
+  if (*p + 1 > limit) return false;
+  const uint8_t d = b[(*p)++];
+  int t = d & 0xF;
+  int64_t ln = d >> 4;
+  if (ln == 15) {
+    // Length extension: a nested typed scalar (int types only here).
+    if (*p + 1 > limit) return false;
+    const uint8_t d2 = b[(*p)++];
+    const int t2 = d2 & 0xF;
+    const int64_t ln2 = d2 >> 4;
+    if (ln2 < 1) return false;
+    int64_t v = 0;
+    if (t2 == 1) {
+      if (*p + ln2 > limit) return false;
+      v = static_cast<int8_t>(b[*p]);
+      *p += ln2;
+    } else if (t2 == 2) {
+      if (*p + 2 * ln2 > limit) return false;
+      int16_t x;
+      std::memcpy(&x, b + *p, 2);
+      v = x;
+      *p += 2 * ln2;
+    } else if (t2 == 3) {
+      if (*p + 4 * ln2 > limit) return false;
+      int32_t x;
+      std::memcpy(&x, b + *p, 4);
+      v = x;
+      *p += 4 * ln2;
+    } else {
+      return false;
+    }
+    if (v < 0) return false;
+    ln = v;
+  }
+  out->t = t;
+  out->len = ln;
+  out->at = *p;
+  out->first_ok = false;
+  if (t == 0) return true;  // MISSING: no payload consumed
+  int64_t esize;
+  switch (t) {
+    case 1: esize = 1; break;
+    case 2: esize = 2; break;
+    case 3: esize = 4; break;
+    case 5: esize = 4; break;  // float
+    case 7: esize = 1; break;  // char
+    default: return false;     // the exact decoder raises "bad int type"
+  }
+  if (*p + esize * ln > limit) return false;
+  if (ln > 0 && (t == 1 || t == 2 || t == 3)) {
+    int64_t v = 0;
+    bool ok = true;
+    if (t == 1) {
+      const int8_t x = static_cast<int8_t>(b[*p]);
+      v = x;
+      ok = x != -128 && x != -127;  // MISSING / EOV
+    } else if (t == 2) {
+      int16_t x;
+      std::memcpy(&x, b + *p, 2);
+      v = x;
+      ok = x != -32768 && x != -32767;
+    } else {
+      int32_t x;
+      std::memcpy(&x, b + *p, 4);
+      v = x;
+      ok = x != INT32_MIN && x != INT32_MIN + 1;
+    }
+    out->first = v;
+    out->first_ok = ok;
+  }
+  *p += esize * ln;
+  return true;
+}
+}  // namespace
+
+// BCF record scan: chain walk + full shared-block validation in one pass.
+// Records are [u32 l_shared][u32 l_indiv][body] back to back; start
+// offsets of records beginning in [start, end) append to offsets, with
+// ref_len[i] = length of the REF allele and end_info[i] = the INFO END
+// value (INT64_MIN when absent/non-scalar — matching the exact decoder's
+// END= text-regex rule).  The shared block's typed values are walked and
+// bounds/type-checked against the header dictionary sizes, so a clean
+// return means the exact decoder would accept every record.  Returns the
+// count, -1 when anything needs the exact path, -2 when cap is too small.
+int64_t hbam_bcf_scan(const uint8_t* data, int64_t len, int64_t start,
+                      int64_t end, int64_t n_contigs, int64_t n_strings,
+                      int64_t end_key, int64_t* offsets, int64_t* ref_len,
+                      int64_t* end_info, int64_t cap) {
+  int64_t p = start, n = 0;
+  while (p + 8 <= end) {
+    if (p + 8 > len) return -1;
+    uint32_t ls, li;
+    std::memcpy(&ls, data + p, 4);
+    std::memcpy(&li, data + p + 4, 4);
+    const int64_t body = p + 8;
+    const int64_t next =
+        body + static_cast<int64_t>(ls) + static_cast<int64_t>(li);
+    if (ls < 24 || next > len) return -1;
+    if (n >= cap) return -2;
+    const int64_t limit = body + ls;
+    int32_t chrom, nai, nfs;
+    std::memcpy(&chrom, data + body, 4);
+    std::memcpy(&nai, data + body + 16, 4);
+    std::memcpy(&nfs, data + body + 20, 4);
+    if (chrom < 0 || chrom >= n_contigs) return -1;
+    const int64_t n_allele = static_cast<uint32_t>(nai) >> 16;
+    const int64_t n_info = static_cast<uint32_t>(nai) & 0xFFFF;
+    int64_t q = body + 24;
+    TypedVal tv;
+    if (!bcf_typed_skip(data, limit, &q, &tv)) return -1;  // ID
+    int64_t rl = 1;  // n_allele == 0 → REF "N" → length 1
+    for (int64_t k = 0; k < n_allele; ++k) {
+      if (!bcf_typed_skip(data, limit, &q, &tv)) return -1;
+      if (k == 0) {
+        if (tv.t != 7 || tv.len <= 0) return -1;  // REF must be chars
+        rl = tv.len;
+      }
+    }
+    // FILTER: int vector (or missing); every entry a valid string index.
+    if (!bcf_typed_skip(data, limit, &q, &tv)) return -1;
+    if (tv.t != 0) {
+      if (tv.t != 1 && tv.t != 2 && tv.t != 3) return -1;
+      const int64_t es = tv.t == 1 ? 1 : tv.t == 2 ? 2 : 4;
+      for (int64_t k = 0; k < tv.len; ++k) {
+        int64_t v;
+        if (tv.t == 1)
+          v = static_cast<int8_t>(data[tv.at + k]);
+        else if (tv.t == 2) {
+          int16_t x;
+          std::memcpy(&x, data + tv.at + 2 * k, 2);
+          v = x;
+        } else {
+          int32_t x;
+          std::memcpy(&x, data + tv.at + 4 * k, 4);
+          v = x;
+        }
+        const int64_t missing = es == 1 ? -128 : es == 2 ? -32768
+                                               : INT64_C(-2147483648);
+        if (v == missing) continue;  // skipped by the exact decoder
+        if (v == missing + 1) break;  // EOV terminates
+        if (v < 0 || v >= n_strings) return -1;
+      }
+    }
+    int64_t endv = INT64_MIN;
+    for (int64_t k = 0; k < n_info; ++k) {
+      TypedVal key;
+      if (!bcf_typed_skip(data, limit, &q, &key)) return -1;
+      // Key must be an int scalar-first with a live value in range.
+      if (!(key.t == 1 || key.t == 2 || key.t == 3) || key.len < 1 ||
+          !key.first_ok || key.first < 0 || key.first >= n_strings)
+        return -1;
+      TypedVal val;
+      if (!bcf_typed_skip(data, limit, &q, &val)) return -1;
+      // INFO END override: the exact path's END= regex matches only the
+      // "END=<int>" rendering.  A clean int scalar overrides; a MISSING
+      // value renders as a bare flag (no override); anything else (float
+      // END, vectors, missing-first) could render regex-matchable text —
+      // bail so the exact decoder decides.
+      if (key.first == end_key) {
+        if ((val.t == 1 || val.t == 2 || val.t == 3) && val.len == 1 &&
+            val.first_ok) {
+          if (endv == INT64_MIN) endv = val.first;
+        } else if (val.t != 0) {
+          return -1;
+        }
+      }
+    }
+    if (q != limit) return -1;  // shared-length mismatch: exact raises
+    offsets[n] = p;
+    ref_len[n] = rl;
+    end_info[n] = endv;
+    ++n;
+    p = next;
+  }
+  return n;
 }
 
 int hbam_abi_version() { return 6; }

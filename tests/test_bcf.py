@@ -330,3 +330,208 @@ class TestReviewRegressions:
         hop2, _ = decode_variant(encode_variant(hop1))  # re-encode mid-relay
         reattach_genotypes(hop2, hdr)
         assert hop2.format_line() == v.format_line()
+
+
+class TestVectorizedDecode:
+    """VERDICT r3 #4: batched BCF split decode — the chain walk + fixed-
+    prefix gathers must match the exact per-record path on columns AND on
+    lazily materialized rows, at >=10x."""
+
+    def _big_file(self, tmp_path, n=50_000):
+        import io as _io
+
+        from hadoop_bam_tpu.io.bcf import BcfRecordWriter
+
+        h = vcf.VcfHeader.parse(
+            "##fileformat=VCFv4.2\n"
+            '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+            '##FILTER=<ID=PASS,Description="ok">\n'
+            '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+            + "".join(f"##contig=<ID=chr{c}>\n" for c in (1, 2, 3))
+            + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+        )
+        buf = _io.BytesIO()
+        w = BcfRecordWriter(buf, h)
+        for i in range(n):
+            w.write(
+                vcf.parse_variant_line(
+                    f"chr{1 + i % 3}\t{100 + i}\t.\tAC\tA\t50\tPASS\t"
+                    f"DP={i % 97}\tGT\t0/1"
+                )
+            )
+        w.close()
+        p = tmp_path / "vec.bcf"
+        p.write_bytes(buf.getvalue())
+        return str(p), n
+
+    def _eager(self, fmt, splits):
+        import hadoop_bam_tpu.io.bcf as B
+
+        orig = B._read_vectorized
+        B._read_vectorized = lambda *a, **k: None
+        try:
+            return [fmt.read_split(s) for s in splits]
+        finally:
+            B._read_vectorized = orig
+
+    def test_columns_and_rows_match_exact_path(self, tmp_path):
+        import numpy as np
+
+        path, n = self._big_file(tmp_path, n=20_000)
+        fmt = BcfInputFormat()
+        splits = fmt.get_splits([path], split_size=32 << 10)
+        assert len(splits) > 1
+        fast = [fmt.read_split(s) for s in splits]
+        eager = self._eager(fmt, splits)
+        assert sum(b.n_records for b in fast) == n
+        for bv, be in zip(fast, eager):
+            np.testing.assert_array_equal(bv.keys, be.keys)
+            np.testing.assert_array_equal(
+                bv.pos, np.array([v.pos for v in be.variants])
+            )
+            np.testing.assert_array_equal(
+                bv.end, np.array([v.end for v in be.variants])
+            )
+        # Lazy rows materialize identically (spot-checked).
+        vs, es = fast[0].variants, eager[0].variants
+        assert len(vs) == len(es)
+        for i in range(0, len(vs), 499):
+            assert vs[i].format_line() == es[i].format_line()
+            assert vs[i].genotypes_raw == es[i].genotypes_raw
+
+    def test_reference_fixtures_match(self):
+        import os
+
+        import numpy as np
+
+        for fx in (
+            "/root/reference/src/test/resources/test.uncompressed.bcf",
+            "/root/reference/src/test/resources/test.bgzf.bcf",
+        ):
+            if not os.path.exists(fx):
+                continue
+            fmt = BcfInputFormat()
+            splits = fmt.get_splits([fx], split_size=1 << 20)
+            fast = [fmt.read_split(s) for s in splits]
+            eager = self._eager(fmt, splits)
+            for bv, be in zip(fast, eager):
+                np.testing.assert_array_equal(bv.keys, be.keys)
+                assert [v.format_line() for v in bv.variants] == [
+                    v.format_line() for v in be.variants
+                ]
+
+    def test_interval_filter_matches_exact_path(self, tmp_path):
+        import numpy as np
+
+        from hadoop_bam_tpu.conf import Configuration
+
+        path, _ = self._big_file(tmp_path, n=20_000)
+        conf = Configuration()
+        conf.set("hadoopbam.vcf.intervals", "chr2:5000-9000")
+        fmt = BcfInputFormat(conf)
+        splits = fmt.get_splits([path], split_size=32 << 10)
+        fast = [fmt.read_split(s) for s in splits]
+        eager = self._eager(fmt, splits)
+        assert sum(b.n_records for b in fast) == sum(
+            len(b.variants) for b in eager
+        )
+        for x, y in zip(fast, eager):
+            np.testing.assert_array_equal(x.keys, y.keys)
+
+    @pytest.mark.slow
+    def test_vectorized_10x(self, tmp_path):
+        import time
+
+        path, n = self._big_file(tmp_path, n=100_000)
+        fmt = BcfInputFormat()
+        splits = fmt.get_splits([path], split_size=256 << 10)
+        t0 = time.perf_counter()
+        total = sum(fmt.read_split(s).n_records for s in splits)
+        t_vec = time.perf_counter() - t0
+        assert total == n
+        t0 = time.perf_counter()
+        self._eager(fmt, splits)
+        t_eager = time.perf_counter() - t0
+        assert t_eager / t_vec >= 10, f"only {t_eager / t_vec:.1f}x"
+
+
+class TestVectorizedReviewRegressions:
+    """Review r4: the fast path must bail (never silently diverge) on
+    corrupt typed streams, and must reproduce the exact path's END and
+    POS=0 key semantics."""
+
+    def _header(self):
+        return vcf.VcfHeader.parse(
+            "##fileformat=VCFv4.2\n"
+            '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+            '##INFO=<ID=END,Number=1,Type=Integer,Description="e">\n'
+            '##FILTER=<ID=PASS,Description="ok">\n'
+            '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+            "##contig=<ID=chr1>\n##contig=<ID=chr2>\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+        )
+
+    def test_corrupt_typed_stream_raises_strict(self, tmp_path):
+        h = self._header()
+        hdr = bcf.BcfHeader(h)
+        raw = bytearray(bcf.encode_header(h))
+        rec = len(raw)
+        raw.extend(
+            bcf.encode_record(
+                hdr,
+                vcf.parse_variant_line(
+                    "chr1\t10\t.\tAC\tA\t50\tPASS\tDP=1\tGT\t0/1"
+                ),
+            )
+        )
+        raw[rec + 8 + 24] = 0xFB  # ID descriptor → bad type 11
+        p = tmp_path / "bad.bcf"
+        p.write_bytes(bytes(raw))
+        fmt = BcfInputFormat()
+        with pytest.raises(Exception):
+            fmt.read_split(fmt.get_splits([str(p)], split_size=1 << 20)[0])
+
+    def test_info_end_and_pos0_semantics(self, tmp_path):
+        import io as _io
+
+        import numpy as np
+
+        from hadoop_bam_tpu.io.bcf import BcfRecordWriter
+        import hadoop_bam_tpu.io.bcf as B
+
+        h = self._header()
+        buf = _io.BytesIO()
+        w = BcfRecordWriter(buf, h)
+        w.write(
+            vcf.parse_variant_line(
+                "chr2\t0\t.\tA\tG\t50\tPASS\tDP=1\tGT\t0/1"  # POS=0 quirk
+            )
+        )
+        w.write(
+            vcf.parse_variant_line(
+                "chr1\t100\t.\tN\t<DEL>\t50\tPASS\tEND=600;DP=3\tGT\t0/1"
+            )
+        )
+        w.close()
+        p = tmp_path / "e.bcf"
+        p.write_bytes(buf.getvalue())
+        fmt = BcfInputFormat()
+        splits = fmt.get_splits([str(p)], split_size=1 << 20)
+        fast = [fmt.read_split(s) for s in splits]
+        orig = B._read_vectorized
+        B._read_vectorized = lambda *a, **k: None
+        try:
+            eager = [fmt.read_split(s) for s in splits]
+        finally:
+            B._read_vectorized = orig
+        np.testing.assert_array_equal(
+            np.concatenate([b.keys for b in fast]),
+            np.concatenate([b.keys for b in eager]),
+        )
+        np.testing.assert_array_equal(
+            np.concatenate([b.end for b in fast]),
+            np.concatenate(
+                [np.array([v.end for v in b.variants]) for b in eager]
+            ),
+        )
+        assert fast[0].keys[0] == -1  # Java sign-extension quirk

@@ -191,3 +191,40 @@ def test_sam_vectorized_10x(tmp_path):
     assert arr.tobytes()[: len(blob)] == blob
     speedup = t_loop / t_vec
     assert speedup >= 10, f"vectorized speedup only {speedup:.1f}x"
+
+
+def test_empty_qual_field_matches_exact():
+    """Empty (not '*') QUAL with non-empty SEQ: build_record substitutes
+    0xFF * l_seq — the vectorized path must match (review r4 finding)."""
+    line = "r1\t0\tchr1\t100\t60\t1M\t*\t0\t0\tA\t\tXX:i:1"
+    data = (HDR + "\n" + line + "\n").encode()
+    arr = sam_vec.parse_split_vectorized(
+        np.frombuffer(data, np.uint8), 0, len(data), HEADER
+    )
+    assert arr is not None
+    assert arr.tobytes() == oracle_blob([line])
+
+
+def test_bin_overflow_bails():
+    """reg2bin > 0xFFFF (positions past ~1 Gbp on a giant contig): the
+    exact path's struct.pack raises, so the fast path must bail."""
+    hdr = bam.BamHeader(
+        "@SQ\tSN:big\tLN:2147483647", [("big", 2147483647)]
+    )
+    line = "r1\t0\tbig\t2147483000\t60\t1M\t*\t0\t0\tA\tI"
+    data = (line + "\n").encode()
+    arr = sam_vec.parse_split_vectorized(
+        np.frombuffer(data, np.uint8), 0, len(data), hdr
+    )
+    assert arr is None
+
+
+def test_float_overflow_tag_bails():
+    """'XF:f:1e300' packs to OverflowError on the exact path — the native
+    encoder must not silently emit inf."""
+    line = "r1\t0\tchr1\t100\t60\t1M\t*\t0\t0\tA\tI\tXF:f:1e300"
+    data = (HDR + "\n" + line + "\n").encode()
+    arr = sam_vec.parse_split_vectorized(
+        np.frombuffer(data, np.uint8), 0, len(data), HEADER
+    )
+    assert arr is None
