@@ -30,6 +30,11 @@ MAGIC = b"BAM\x01"
 # SEQ 4-bit code → base character ("=ACMGRSVTWYHKDBN", SAM spec table).
 SEQ_DECODE = "=ACMGRSVTWYHKDBN"
 _SEQ_ENCODE = {c: i for i, c in enumerate(SEQ_DECODE)}
+# Byte-wise nibble table for the vectorized encode path: byte b maps to
+# _SEQ_ENCODE.get(chr(b).upper(), 15) (identical for all latin-1 bytes).
+_SEQ_NIB_TABLE = bytes(
+    _SEQ_ENCODE.get(chr(_b).upper(), 15) for _b in range(256)
+)
 CIGAR_OPS = "MIDNSHP=X"
 _CIGAR_ENCODE = {c: i for i, c in enumerate(CIGAR_OPS)}
 
@@ -308,12 +313,24 @@ def build_record(
         seq_b = b""
     else:
         l_seq = len(seq)
-        nibbles = [_SEQ_ENCODE.get(c.upper(), 15) for c in seq]
-        if l_seq % 2:
-            nibbles.append(0)
-        seq_b = bytes(
-            (nibbles[i] << 4) | nibbles[i + 1] for i in range(0, len(nibbles), 2)
-        )
+        try:
+            # Byte-wise fast path: one translate + one vectorized pack
+            # (equivalent to the per-char dict walk for every latin-1
+            # string — upper() of a latin-1 char never lands in the
+            # nibble alphabet unless the byte-wise upper does too).
+            nib = seq.encode("latin-1").translate(_SEQ_NIB_TABLE)
+            if l_seq % 2:
+                nib += b"\x00"
+            arr = np.frombuffer(nib, dtype=np.uint8)
+            seq_b = ((arr[0::2] << 4) | arr[1::2]).astype(np.uint8).tobytes()
+        except UnicodeEncodeError:
+            nibbles = [_SEQ_ENCODE.get(c.upper(), 15) for c in seq]
+            if l_seq % 2:
+                nibbles.append(0)
+            seq_b = bytes(
+                (nibbles[i] << 4) | nibbles[i + 1]
+                for i in range(0, len(nibbles), 2)
+            )
     if isinstance(qual, str):
         qual_b = (
             b"\xff" * l_seq if qual == "*" else bytes(ord(c) - 33 for c in qual)

@@ -15,6 +15,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 MAGIC = b"CRAM"
 FILE_DEFINITION_LEN = 26  # magic + 2 version bytes + 20-byte file id
 
@@ -573,9 +575,7 @@ def _decode_slice_records(
                 r.features.append((fpos, fc, payload))
             r.mq = E("MQ").read_int(ctx)
             if r.cf & CF_QS_STORED:
-                r.quals = bytes(
-                    E("QS").read_byte(ctx) for _ in range(r.rl)
-                )
+                r.quals = E("QS").read_byte_run(ctx, r.rl)
             if not comp.rr_required:
                 # no-ref mode drains the BA series *inside* the record's
                 # decode turn (htslib cram_decode_seq ordering)
@@ -584,13 +584,9 @@ def _decode_slice_records(
                 )
         else:
             if not (r.cf & CF_NO_SEQ):
-                r.bases = bytes(
-                    E("BA").read_byte(ctx) for _ in range(r.rl)
-                )
+                r.bases = E("BA").read_byte_run(ctx, r.rl)
             if r.cf & CF_QS_STORED:
-                r.quals = bytes(
-                    E("QS").read_byte(ctx) for _ in range(r.rl)
-                )
+                r.quals = E("QS").read_byte_run(ctx, r.rl)
         recs.append(r)
 
     # mate linking within the slice (non-detached pairs)
@@ -800,16 +796,29 @@ def _reconstruct_mapped(
         _fill_match(bases, covered, rpos, tail, ref, ref_cursor)
         push("M", tail)
     if not comp.rr_required:
-        # no-ref: uncovered positions drain the BA series in read order
-        ba = E("BA")
-        for k in range(r.rl):
-            if not covered[k]:
-                bases[k] = ba.read_byte(ctx)
+        # no-ref: uncovered positions drain the BA series in read order —
+        # one batched series read, scattered by the coverage mask.
+        n_unc = r.rl - sum(covered)
+        if n_unc > 0:
+            run = E("BA").read_byte_run(ctx, n_unc)
+            if n_unc == r.rl:
+                bases[:] = run
+            else:
+                dst = np.frombuffer(bases, dtype=np.uint8)
+                idx = np.nonzero(
+                    np.frombuffer(covered, dtype=np.uint8) == 0
+                )[0]
+                dst[idx] = np.frombuffer(run, dtype=np.uint8)
     return bases.decode("latin-1"), cigar_ops
 
 
 def _upper(b: int) -> int:
     return b - 32 if 97 <= b <= 122 else b
+
+
+_UPPER_TABLE = bytes(
+    b - 32 if 97 <= b <= 122 else b for b in range(256)
+)
 
 
 def _fill_match(
@@ -820,12 +829,24 @@ def _fill_match(
     ref: Optional[bytes],
     ref_cursor: int,
 ) -> None:
+    # Slice assignment on a bytearray silently resizes on length mismatch;
+    # out-of-range cursors from corrupt features must ERROR, not shift
+    # every downstream base (the old per-index loop raised IndexError).
+    if rpos < 0 or rpos + n > len(covered):
+        raise CramError(
+            f"feature positions run past the read length "
+            f"({rpos}+{n} > {len(covered)})"
+        )
     if ref is None:
         return  # no-ref mode: BA fills later, covered stays 0
-    for k in range(n):
-        if ref_cursor + k < len(ref):
-            bases[rpos + k] = _upper(ref[ref_cursor + k])
-        covered[rpos + k] = 1
+    if ref_cursor < 0:
+        raise CramError(f"reference cursor negative ({ref_cursor})")
+    avail = min(n, max(0, len(ref) - ref_cursor))
+    if avail > 0:
+        bases[rpos : rpos + avail] = ref[
+            ref_cursor : ref_cursor + avail
+        ].translate(_UPPER_TABLE)
+    covered[rpos : rpos + n] = b"\x01" * n
 
 
 # ---------------------------------------------------------------------------

@@ -407,6 +407,22 @@ class Encoding:
             return self.read_int(ctx)
         raise CramError(f"encoding {c} cannot decode bytes")
 
+    def read_byte_run(self, ctx: DecodeContext, n: int) -> bytes:
+        """``n`` consecutive bytes of this series in one call.
+
+        The hot byte series (QS qualities, BA bases) are EXTERNAL in
+        practice — one stream slice instead of n Python calls; a
+        zero-bit Huffman constant is one repeat.  Other codecs keep the
+        per-byte loop (bit-level state)."""
+        if n <= 0:
+            return b""
+        c = self.codec
+        if c == ENC_EXTERNAL:
+            return ctx.stream(self.content_id).read_bytes(n)
+        if c == ENC_HUFFMAN and self._zero_bit:
+            return bytes([self._single]) * n  # type: ignore[list-item]
+        return bytes(self.read_byte(ctx) for _ in range(n))
+
     def read_bytes(self, ctx: DecodeContext, n: Optional[int] = None) -> bytes:
         c = self.codec
         if c == ENC_BYTE_ARRAY_STOP:

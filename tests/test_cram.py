@@ -197,3 +197,87 @@ class TestWriterMerger:
         w.write_record(_records()[0])
         w.close()
         assert not buf.getvalue().startswith(cram.MAGIC)
+
+
+@pytest.mark.slow
+def test_cram_read_throughput_and_batched_series(tmp_path):
+    """VERDICT r3 #10: record the CRAM read rate and prove the batched
+    byte-series decode (QS/BA as stream slices instead of per-byte Python
+    calls) beats the per-byte tier by >=3x on the hot series."""
+    import io as _io
+    import time
+
+    from hadoop_bam_tpu.io.cram import CramInputFormat, CramRecordWriter
+    from hadoop_bam_tpu.spec import cram_codecs
+
+    hdr = bam.BamHeader(
+        "@SQ\tSN:chr1\tLN:248956422", [("chr1", 248956422)]
+    )
+    recs = [
+        bam.build_record(
+            f"r{i:06d}", 0, 1000 + i * 30, 60, 0, [(100, "M")],
+            "ACGT" * 25, bytes([30 + i % 10] * 100),
+        )
+        for i in range(20000)
+    ]
+    buf = _io.BytesIO()
+    w = CramRecordWriter(buf, hdr, records_per_container=2000)
+    for r in recs:
+        w.write_record(r)
+    w.close()
+    p = tmp_path / "perf.cram"
+    p.write_bytes(buf.getvalue())
+    fmt = CramInputFormat()
+    splits = fmt.get_splits([str(p)], split_size=1 << 20)
+
+    def run():
+        t0 = time.perf_counter()
+        n = sum(fmt.read_split(s).n_records for s in splits)
+        return n, time.perf_counter() - t0
+
+    run()  # warm
+    n, t_fast = run()
+    assert n == len(recs)
+    mb_s = len(buf.getvalue()) / t_fast / 1e6
+    print(f"\nCRAM read: {n / t_fast:,.0f} rec/s, {mb_s:.1f} MB/s compressed")
+
+    # De-batch the hot series: read_byte_run degrades to the per-byte loop
+    # (the pre-optimization shape), everything else unchanged.
+    orig = cram_codecs.Encoding.read_byte_run
+
+    def per_byte(self, ctx, nn):
+        return bytes(self.read_byte(ctx) for _ in range(nn))
+
+    cram_codecs.Encoding.read_byte_run = per_byte
+    try:
+        n2, t_slow = run()
+    finally:
+        cram_codecs.Encoding.read_byte_run = orig
+    assert n2 == len(recs)
+    # End-to-end the batching must still show through the other decode
+    # stages; the 3x bar applies to the series itself below.
+    assert t_slow / t_fast >= 1.5, (
+        f"batched read only {t_slow / t_fast:.1f}x end-to-end"
+    )
+
+    # The hot series in isolation: one EXTERNAL byte series, 2M bytes,
+    # read as 20k record-sized runs — batched vs per-byte.
+    payload = bytes(range(256)) * 8192  # 2 MiB
+    enc = cram_codecs.Encoding(cram_codecs.ENC_EXTERNAL, bytes([7]))
+    runs = 20000
+    ln = len(payload) // runs
+
+    def series(fn):
+        ctx = cram_codecs.DecodeContext(b"", {7: payload})
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            fn(ctx)
+        return time.perf_counter() - t0
+
+    t_batched = series(lambda c: enc.read_byte_run(c, ln))
+    t_loop = series(
+        lambda c: bytes(enc.read_byte(c) for _ in range(ln))
+    )
+    assert t_loop / t_batched >= 3, (
+        f"hot series only {t_loop / t_batched:.1f}x"
+    )
