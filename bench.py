@@ -99,14 +99,17 @@ def synth_bam(path: str, n: int) -> None:
         f.write(bgzf.TERMINATOR)
 
 
-def run_sort(src: str, out: str, backend: str) -> float:
+def run_sort(
+    src: str, out: str, backend: str, device_parse=None
+) -> float:
     """Returns wall seconds for a full sort with the given backend (the
     product pipeline end to end: plan → read → sort → parts → merge)."""
     from hadoop_bam_tpu.pipeline import sort_bam
 
     t0 = time.time()
     sort_bam(
-        [src], out, split_size=SPLIT_SIZE, level=1, backend=backend
+        [src], out, split_size=SPLIT_SIZE, level=1, backend=backend,
+        device_parse=device_parse,
     )
     return time.time() - t0
 
@@ -148,7 +151,7 @@ def _measure(platform: str) -> dict:
     ), "device sort wrong"
 
     reads_per_sec = N_RECORDS / t_device
-    return {
+    out = {
         "metric": "bam_sort_reads_per_sec",
         "value": round(reads_per_sec),
         "unit": "reads/s",
@@ -156,6 +159,20 @@ def _measure(platform: str) -> dict:
         "platform": platform,
         "n_records": N_RECORDS,
     }
+    if platform == "tpu":
+        # Secondary diagnostic: the device-resident parse mode, forced on
+        # regardless of the topology auto rule (on a remote tunnel its
+        # per-split uploads pay ~70 ms RTTs and it loses to host keys; on
+        # a local chip it is the intended production path).
+        from hadoop_bam_tpu.pipeline import _device_roundtrip_ms
+
+        try:
+            t_dp = run_sort(src, out_d, "device", device_parse=True)
+            out["device_parse_reads_per_sec"] = round(N_RECORDS / t_dp)
+        except Exception as e:  # never fail the headline for a diagnostic
+            out["device_parse_error"] = str(e)[:120]
+        out["device_rtt_ms"] = round(_device_roundtrip_ms(), 2)
+    return out
 
 
 def _child(platform: str) -> None:

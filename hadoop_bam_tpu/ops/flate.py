@@ -50,14 +50,25 @@ Inflate (decompress), device side
 Host-side helpers assemble/validate the BGZF framing (headers, CRC32,
 ISIZE — spec/bgzf.py owns the layout) around the device payloads.
 
-Performance status (v5e-1, measured): both kernels bottleneck on XLA:TPU
-gather throughput (~70M gathered elements/s) — roughly 0.5-1 MB/s end to
-end, far below the native host tier (~170 MB/s zlib).  The kernels are
+Performance status (v5e-1, measured): these XLA kernels bottleneck on
+XLA:TPU gather throughput (~70M gathered elements/s) — roughly 0.5-1 MB/s
+end to end, far below the native host tier (~170 MB/s zlib).  They are
 the *capability* deliverable (device-resident decode with zero host CPU
 in the loop); the production pipeline keeps the tiered design with the
-C++ host codec on the hot path.  A Pallas rewrite would need a dense
-(non-gather) reformulation to beat the host tier; the chain/copy
-resolution math here is deliberately layout-agnostic so it can move.
+C++ host codec on the hot path.
+
+The path past the host tier is measured, not hypothetical: the
+lockstep-lane Pallas formulation (128 members in the 128 vector lanes,
+serial Huffman walks in one kernel, per-lane window extraction as dense
+iota-compare reductions — ops/pallas/inflate_probe.py) clocks a marginal
+**~748 ns per 128-token wave** on the v5e (two-point fit, RTT-free):
+~170M tokens/s ≈ **~340 MB/s** of walk-engine throughput at DEFLATE's
+~2 output bytes/token — two orders of magnitude above this module's
+gather-bound loop and ~2x the host tier.  The remaining build is the
+full decoder around that engine (per-member table construction, one-hot
+output emit, windowed LZ77 copy resolve, far-copy fallback); until it
+lands, device inflate stays a capability tier and the probe pins the
+measured ceiling.
 
 Caveat for all launches: XLA:TPU gathers silently mis-index above 2^24
 elements per launch (f32 index precision); wrappers chunk accordingly.

@@ -116,3 +116,28 @@ class TestOverlapMask:
                          end1.astype(np.int32), interpret=True)
         )
         assert np.array_equal(host, dev)
+
+
+def test_inflate_probe_walk_matches_oracle():
+    """The lockstep-lane walk probe (ops/pallas/inflate_probe.py) must
+    match its NumPy oracle — pins the per-lane extraction + divergent
+    cursor semantics the future device inflate builds on."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hadoop_bam_tpu.ops.pallas import inflate_probe as ip
+
+    rng = np.random.default_rng(3)
+    R, T = 256, 64
+    streams = rng.integers(-(1 << 31), 1 << 31, (R, ip.LANES), dtype=np.int32)
+    cursors = rng.integers(0, 64, (1, ip.LANES), dtype=np.int32)
+    walk = ip.make_walk(R, T, interpret=True)
+    cur, acc = walk(jnp.asarray(streams), jnp.asarray(cursors))
+    c_ref, a_ref = ip.reference_walk(streams, cursors, T)
+    np.testing.assert_array_equal(
+        np.asarray(cur).astype(np.int64) & 0xFFFFFFFF,
+        c_ref & 0xFFFFFFFF,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(acc).astype(np.int64) & 0xFFFFFFFF, a_ref
+    )
