@@ -254,6 +254,12 @@ class HttpFilesystem(Filesystem):
             data = data[start : start + length]
         return data[:length]
 
+    def read_all(self, path: str) -> bytes:
+        # One plain GET — the default (HEAD for size, then a ranged GET)
+        # costs two round trips per file.
+        _, _, data = self._request(self._url(path), "GET", {})
+        return data or b""
+
     def open_write(self, path: str) -> BinaryIO:
         raise OSError(
             f"HttpFilesystem is read-only ({path}); write outputs to a "

@@ -15,13 +15,17 @@ TPU-native equivalent here:
   the collective over ICI within a host and DCN across hosts.  The shuffle
   additionally returns each input row's destination device (the sender-side
   routing table).
-- **byte plane**: ragged record payloads move host-to-host through spill
-  files on the shared filesystem (the moral equivalent of Hadoop's
-  map-output spill + HTTP fetch — and of a GCS-backed shuffle on a TPU
-  pod): each process writes one run of raw records per destination process,
-  sorted by global source row with a memmappable row/offset sidecar;
-  after a global barrier every process fetches and gathers exactly the
-  bytes its devices' key ranges own.
+- **byte plane**: ragged record payloads move host-to-host either through
+  spill files on a shared filesystem (the GCS-backed-shuffle stance) or —
+  with ``byte_plane="http"`` — over authenticated HTTP range fetches from
+  each process's LOCAL disk (Hadoop's map-output servlet + parallel
+  copier, no shared filesystem in the data path): each process writes one
+  run of raw records per destination process, sorted by global source row
+  with a memmappable row/offset sidecar; after a global barrier every
+  process fetches and gathers exactly the bytes its devices' key ranges
+  own.  Both planes compose with ``memory_budget`` (key-sorted spill
+  runs, contiguous per-destination slices, receiver-side (key, ordinal)
+  range merge).
 
 ``sort_bam_multihost`` is the end-to-end driver: it produces a part file
 per *global device* and process 0 performs the ordinary header+parts+
@@ -154,12 +158,15 @@ def _serve_dir(directory: str, token: str):
 
     root = os.path.abspath(directory)
 
+    import hmac
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
         def _path(self):
-            if self.headers.get("X-Hbam-Token") != token:
+            got = self.headers.get("X-Hbam-Token") or ""
+            if not hmac.compare_digest(got, token):
                 self.send_error(403)
                 return None
             # One flat directory; reject anything path-like.
@@ -224,15 +231,21 @@ def _serve_dir(directory: str, token: str):
                     self.wfile.write(chunk)
                     remaining -= len(chunk)
 
-    srv = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    # Peers must be able to reach this address: the hostname by default
-    # (resolvable on real clusters), HBAM_SHUFFLE_HOST to override
-    # (tests pin 127.0.0.1; multi-NIC hosts pin the data-plane address).
+    # Peers must reach this address: the hostname by default (resolvable
+    # on real clusters), HBAM_SHUFFLE_HOST to override (tests pin
+    # 127.0.0.1; multi-NIC hosts pin the data-plane address).  When an
+    # address is pinned, LISTEN on it too — spill bytes must not be
+    # reachable on interfaces the operator pinned away from.
     import socket
 
-    host = os.environ.get("HBAM_SHUFFLE_HOST") or socket.gethostname()
+    pinned = os.environ.get("HBAM_SHUFFLE_HOST")
+    srv = ThreadingHTTPServer((pinned or "0.0.0.0", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host = pinned or socket.gethostname()
     return srv, f"http://{host}:{srv.server_address[1]}"
+
+
+_ENDPOINT_REC = 512  # fits http:// + 253-char FQDN + port + 32-hex token
 
 
 def _publish_endpoints(
@@ -243,16 +256,35 @@ def _publish_endpoints(
     The allgather also doubles as the 'server is up' barrier — no
     receiver can hold a peer's endpoint before that peer published it."""
     rec = f"{url} {token}".encode()
-    buf = np.zeros(256, dtype=np.uint8)
-    if len(rec) > 256:
+    buf = np.zeros(_ENDPOINT_REC, dtype=np.uint8)
+    if len(rec) > _ENDPOINT_REC:
         raise ValueError(f"shuffle endpoint too long: {rec!r}")
     buf[: len(rec)] = np.frombuffer(rec, np.uint8)
-    allb = ctx.allgather_array(buf)  # [P, 256]
+    allb = ctx.allgather_array(buf)  # [P, _ENDPOINT_REC]
     out = []
     for p in range(len(allb)):
         u, t = bytes(allb[p]).rstrip(b"\x00").decode().split(" ", 1)
         out.append((u, t))
     return out
+
+
+def _start_http_plane(ctx: MultihostContext, serve_dir: str, stack):
+    """Start the data server over ``serve_dir``, publish the endpoint,
+    and return the per-source locator list (own files stay local).
+
+    Server teardown (shutdown + socket close) is registered on ``stack``
+    (a ``contextlib.ExitStack`` owned by the driver), so every failure
+    path from this moment on closes the data port; the serve directory
+    itself belongs to its creator."""
+    import secrets
+
+    token = secrets.token_hex(16)
+    srv, url = _serve_dir(serve_dir, token)
+    stack.callback(srv.server_close)
+    stack.callback(srv.shutdown)
+    sources: List = list(_publish_endpoints(ctx, url, token))
+    sources[ctx.process_id] = serve_dir  # no socket hop for own files
+    return sources
 
 
 def _write_byte_runs(
@@ -320,37 +352,39 @@ class _ByteFetcher:
     def __init__(self, sources: List, ctx: MultihostContext,
                  rows_per_device: int):
         import io as _io
+        from concurrent.futures import ThreadPoolExecutor
 
         from ..io.fs import HttpFilesystem
 
         self.rows = rows_per_device
         self.ctx = ctx
-        self.rows_tab: List[np.ndarray] = []
-        self.offs_tab: List[np.ndarray] = []
-        bufs: List[np.ndarray] = []
-        for s in range(ctx.num_processes):
+
+        def fetch_one(s: int):
             name = _bytes_name(s, ctx.process_id)
             if isinstance(sources[s], tuple):
                 url, token = sources[s]
                 f = HttpFilesystem(headers={"X-Hbam-Token": token})
                 base = url.rstrip("/")
-                bufs.append(
+                return (
                     np.frombuffer(
                         f.read_all(f"{base}/{name}.bin"), dtype=np.uint8
-                    )
+                    ),
+                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.rows"))),
+                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.offs"))),
                 )
-                self.rows_tab.append(
-                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.rows")))
-                )
-                self.offs_tab.append(
-                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.offs")))
-                )
-            else:
-                p = os.path.join(sources[s], name)
-                with open(p + ".bin", "rb") as fh:
-                    bufs.append(np.frombuffer(fh.read(), dtype=np.uint8))
-                self.rows_tab.append(np.load(p + ".rows"))
-                self.offs_tab.append(np.load(p + ".offs"))
+            p = os.path.join(sources[s], name)
+            with open(p + ".bin", "rb") as fh:
+                buf = np.frombuffer(fh.read(), dtype=np.uint8)
+            return buf, np.load(p + ".rows"), np.load(p + ".offs")
+
+        # Pull peers concurrently (Hadoop's parallel copier): the fetch
+        # phase is network-bound, not peer-count-bound.
+        P_ = ctx.num_processes
+        with ThreadPoolExecutor(max_workers=min(8, P_)) as pool:
+            got = list(pool.map(fetch_one, range(P_)))
+        bufs = [g[0] for g in got]
+        self.rows_tab = [g[1] for g in got]
+        self.offs_tab = [g[2] for g in got]
         # One concatenated buffer built once (gather() runs per local
         # device; re-concatenating there would copy the whole received
         # shard L times).
@@ -396,10 +430,121 @@ class _ByteFetcher:
         return data, out_off[:-1] + 4, out_len - 4
 
 
+class _RemoteNpy:
+    """Range-read slices of a remote int64 ``.npy`` sideband.
+
+    The local plane memmaps sidecars (O(log n) pages touched); the
+    network plane must match that footprint or it silently defeats the
+    memory budget, so only the header (to locate the data) and the
+    requested element ranges ever cross the wire."""
+
+    def __init__(self, fs, url: str):
+        self._fs = fs
+        self._url = url
+        head = fs.read_range(url, 0, 128)
+        if head[:6] != b"\x93NUMPY":
+            raise IOError(f"not an npy file: {url}")
+        major = head[6]
+        if major == 1:
+            hlen = int.from_bytes(head[8:10], "little")
+            self._data0 = 10 + hlen
+            hdr = head[10 : 10 + hlen]
+        else:
+            hlen = int.from_bytes(head[8:12], "little")
+            self._data0 = 12 + hlen
+            hdr = head[12 : 12 + hlen]
+        if len(hdr) < hlen:
+            hdr = fs.read_range(url, self._data0 - hlen, hlen)
+        text = hdr.decode("latin-1")
+        if "'<i8'" not in text or "'fortran_order': False" not in text:
+            raise IOError(f"unexpected npy layout for ranged reads: {url}")
+
+    def slice(self, i0: int, i1: int) -> np.ndarray:
+        n = i1 - i0
+        if n <= 0:
+            return np.empty(0, np.int64)
+        raw = self._fs.read_range(self._url, self._data0 + 8 * i0, 8 * n)
+        if len(raw) != 8 * n:
+            raise IOError(f"short sideband read from {self._url}")
+        return np.frombuffer(raw, dtype="<i8")
+
+
+class _RunAccess:
+    """Uniform access to one process's spill runs for the budget plane:
+    a local directory (shared-FS plane / own files, memmapped sidecars)
+    or an ``(http_base, token)`` endpoint (network plane, ranged reads).
+    Per-run handles are cached; bulk data never is."""
+
+    def __init__(self, source):
+        self._source = source
+        self._cache: dict = {}
+
+    def _handles(self, j: int):
+        got = self._cache.get(j)
+        if got is not None:
+            return got
+        from ..io import runs as runs_mod
+
+        if isinstance(self._source, tuple):
+            from ..io.fs import HttpFilesystem
+
+            url, token = self._source
+            f = HttpFilesystem(headers={"X-Hbam-Token": token})
+            stem = f"{url.rstrip('/')}/run-{j:05d}"
+            got = (
+                _RemoteNpy(f, stem + runs_mod.RUN_KEYS_EXT),
+                _RemoteNpy(f, stem + runs_mod.RUN_OFFS_EXT),
+                _RemoteNpy(f, stem + ".org.npy"),
+                (f, stem + runs_mod.RUN_DATA_EXT),
+            )
+        else:
+            run = runs_mod.Run.open(self._source, j)
+            org = np.load(
+                os.path.join(self._source, f"run-{j:05d}.org.npy"),
+                mmap_mode="r",
+            )
+            got = (run.keys, run.offs, org, run.data_path)
+        self._cache[j] = got
+        return got
+
+    @staticmethod
+    def _sl(arr, i0: int, i1: int) -> np.ndarray:
+        if isinstance(arr, _RemoteNpy):
+            return arr.slice(i0, i1)
+        return np.asarray(arr[i0:i1], dtype=np.int64)
+
+    def slices(self, j: int, i0: int, i1: int):
+        """(keys[i0:i1], org[i0:i1], lens, byte_start, byte_len)."""
+        keys, offs, org, _ = self._handles(j)
+        o = self._sl(offs, i0, i1 + 1)
+        return (
+            self._sl(keys, i0, i1),
+            self._sl(org, i0, i1),
+            np.diff(o),
+            int(o[0]),
+            int(o[-1] - o[0]),
+        )
+
+    def read_into(self, j: int, view, byte_start: int, size: int) -> None:
+        _, _, _, loc = self._handles(j)
+        if isinstance(loc, tuple):
+            f, url = loc
+            data = f.read_range(url, byte_start, size)
+            if len(data) != size:
+                raise IOError(f"short HTTP read from {url}")
+            view[:] = np.frombuffer(data, np.uint8)
+        else:
+            with open(loc, "rb") as fh:
+                fh.seek(byte_start)
+                got = fh.readinto(memoryview(view))
+            if got != size:
+                raise IOError(f"short read from spill run {loc}")
+
+
 def _budget_byte_plane(
     ctx: MultihostContext,
     td: str,
-    shuffle_dir: str,
+    sources: List,
     splits,
     own_counts: List[int],
     dest_of_record: np.ndarray,
@@ -415,11 +560,11 @@ def _budget_byte_plane(
     run's share of destination device ``g`` is one contiguous slice; a
     [runs, D+1] cut table per process (allgathered — a few KB) tells every
     receiver exactly which slice of which run it owns.  Receivers merge
-    their slices by (key, ordinal) straight off the shared filesystem, one
-    destination device at a time — peak materialized bytes is one device's
-    output, not the received shard."""
-    from ..io import runs as runs_mod
-
+    their slices by (key, ordinal) one destination device at a time —
+    straight off the shared filesystem, or over authenticated HTTP range
+    reads when the runs live on peers' local disks (``sources`` carries a
+    directory or endpoint per process) — so peak materialized bytes is
+    one device's output, not the received shard."""
     P_ = ctx.num_processes
     L = ctx.local_device_count
     n_runs_of = [
@@ -436,45 +581,35 @@ def _budget_byte_plane(
     cuts_all = ctx.allgather_array(cuts)  # [P, max_runs, D+1]
     ctx.barrier("spill_published")
 
+    access = [_RunAccess(src) for src in sources]
     with span("mh.range_merge"):
         for g in range(ctx.process_id * L, (ctx.process_id + 1) * L):
             # Two passes over this device's slices: size everything, then
-            # pread each slice DIRECTLY into its place in one final buffer
+            # read each slice DIRECTLY into its place in one final buffer
             # (no per-slice temporaries coexisting with the concatenation).
-            slices = []  # (data_path, byte_start, byte_len)
+            slices = []  # (source idx, run idx, byte_start, byte_len)
             key_parts: List[np.ndarray] = []
             org_parts: List[np.ndarray] = []
             len_parts: List[np.ndarray] = []
             for s in range(P_):
-                sdir = os.path.join(shuffle_dir, f"spill-{s:03d}")
                 for j in range(n_runs_of[s]):
                     i0 = int(cuts_all[s][j][g])
                     i1 = int(cuts_all[s][j][g + 1])
                     if i1 <= i0:
                         continue
-                    run = runs_mod.Run.open(sdir, j)
-                    b0 = int(run.offs[i0])
-                    slices.append(
-                        (run.data_path, b0, int(run.offs[i1]) - b0)
+                    keys_s, org_s, lens_s, b0, sz = access[s].slices(
+                        j, i0, i1
                     )
-                    key_parts.append(np.asarray(run.keys[i0:i1]))
-                    offs = np.asarray(run.offs[i0 : i1 + 1], dtype=np.int64)
-                    len_parts.append(np.diff(offs))
-                    org = np.load(
-                        os.path.join(sdir, f"run-{j:05d}.org.npy"),
-                        mmap_mode="r",
-                    )
-                    org_parts.append(np.asarray(org[i0:i1]))
+                    slices.append((s, j, b0, sz))
+                    key_parts.append(keys_s)
+                    org_parts.append(org_s)
+                    len_parts.append(lens_s)
             if slices:
-                total = sum(sz for _, _, sz in slices)
+                total = sum(sz for _, _, _, sz in slices)
                 data = np.empty(total, dtype=np.uint8)
                 pos = 0
-                for path, b0, sz in slices:
-                    with open(path, "rb") as f:
-                        f.seek(b0)
-                        got = f.readinto(memoryview(data[pos : pos + sz]))
-                    if got != sz:
-                        raise IOError(f"short read from spill run {path}")
+                for s, j, b0, sz in slices:
+                    access[s].read_into(j, data[pos : pos + sz], b0, sz)
                     pos += sz
                 lens = np.concatenate(len_parts)
                 keys_all = np.concatenate(key_parts)
@@ -530,6 +665,31 @@ def sort_bam_multihost(
     memory_budget: Optional[int] = None,
     byte_plane: str = "fs",
 ) -> int:
+    """Coordinate-sort BAM(s) across every process of the JAX runtime
+    (full docs on the implementation below; resources — shuffle data
+    servers, local spill directories — are owned by an ExitStack so every
+    failure path tears them down)."""
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        return _sort_bam_multihost_impl(
+            in_paths, out_path, ctx, conf, split_size, level,
+            samples_per_device, memory_budget, byte_plane, stack,
+        )
+
+
+def _sort_bam_multihost_impl(
+    in_paths,
+    out_path: str,
+    ctx: Optional[MultihostContext],
+    conf,
+    split_size: int,
+    level: int,
+    samples_per_device: int,
+    memory_budget: Optional[int],
+    byte_plane: str,
+    _stack,
+) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime.
 
     All paths (input, output, and the shuffle directory derived from the
@@ -572,12 +732,6 @@ def sort_bam_multihost(
         ctx = initialize()
     if byte_plane not in ("fs", "http"):
         raise ValueError(f"byte_plane must be 'fs' or 'http': {byte_plane!r}")
-    if byte_plane == "http" and memory_budget is not None:
-        raise ValueError(
-            "byte_plane='http' is not yet supported with memory_budget "
-            "(the out-of-core plane reads spill runs directly; serve them "
-            "the same way in a follow-up)"
-        )
     if memory_budget is not None:
         # A split inflates as one batch: keep it well under the budget
         # (same clamp rule as the single-host external sort).
@@ -595,7 +749,17 @@ def sort_bam_multihost(
     shuffle_dir = os.path.join(td, "shuffle")
     spill_dir = os.path.join(shuffle_dir, f"spill-{ctx.process_id:03d}")
     if memory_budget is not None:
-        os.makedirs(spill_dir, exist_ok=True)
+        if byte_plane == "http":
+            # Network plane: spill runs live on LOCAL disk and are served
+            # over HTTP; the shared directory is never written.  The
+            # ExitStack owns the directory: any failure from here on
+            # removes the spilled shard.
+            import tempfile as _tf
+
+            spill_dir = _tf.mkdtemp(prefix="hbam_spill_")
+            _stack.callback(nio.delete_recursive, spill_dir)
+        else:
+            os.makedirs(spill_dir, exist_ok=True)
 
     peak_bytes = 0
     if memory_budget is None:
@@ -773,25 +937,20 @@ def sort_bam_multihost(
     os.makedirs(shuffle_dir, exist_ok=True)
 
     if memory_budget is None:
-        srv = None
         write_dir = shuffle_dir
         if byte_plane == "http":
             # Network plane: outgoing runs live on LOCAL disk and are
             # served over HTTP; no process ever reads another's disk.
-            import secrets
             import tempfile as _tf
 
             write_dir = _tf.mkdtemp(prefix="hbam_shuf_")
+            _stack.callback(nio.delete_recursive, write_dir)
         with span("mh.byte_shuffle.write"):
             _write_byte_runs(
                 write_dir, ctx, local, dest_of_record, row_of_record, rows
             )
         if byte_plane == "http":
-            token = secrets.token_hex(16)
-            srv, url = _serve_dir(write_dir, token)
-            sources: List = list(_publish_endpoints(ctx, url, token))
-            # A process's own files never need the socket hop.
-            sources[ctx.process_id] = write_dir
+            sources: List = _start_http_plane(ctx, write_dir, _stack)
         else:
             sources = [shuffle_dir] * ctx.num_processes
         # The input shard is on disk in destination-keyed runs now; release
@@ -799,48 +958,47 @@ def sort_bam_multihost(
         del local, dest_of_record, row_of_record, dest_l
         ctx.barrier("byte_shuffle_written")
 
-        # Receiver: each local device's sorted rows → one part file each.
-        # On ANY outcome, stop serving and drop the local outgoing runs —
-        # a failed part write must not leak an open data port or a full
-        # outgoing shard on disk.
-        try:
-            with span("mh.byte_shuffle.fetch"):
-                fetcher = _ByteFetcher(sources, ctx, rows)
-                cap_rows = res.hi.shape[0] // D
-                v_sh = _local_view(res.valid, cap_rows)
-                sd_sh = _local_view(res.src_dev, cap_rows)
-                sr_sh = _local_view(res.src_row, cap_rows)
-                # Which global devices are this process's shards?
-                g_devs = sorted(
-                    (s.index[0].start or 0) // cap_rows
-                    for s in res.valid.addressable_shards
+        # Receiver: each local device's sorted rows → one part file each
+        # (the ExitStack owns server/spill teardown on every outcome).
+        with span("mh.byte_shuffle.fetch"):
+            fetcher = _ByteFetcher(sources, ctx, rows)
+            cap_rows = res.hi.shape[0] // D
+            v_sh = _local_view(res.valid, cap_rows)
+            sd_sh = _local_view(res.src_dev, cap_rows)
+            sr_sh = _local_view(res.src_row, cap_rows)
+            # Which global devices are this process's shards?
+            g_devs = sorted(
+                (s.index[0].start or 0) // cap_rows
+                for s in res.valid.addressable_shards
+            )
+            for k, g_dev in enumerate(g_devs):
+                v = v_sh[k]
+                sd = sd_sh[k][v]
+                sr = sr_sh[k][v]
+                data, rec_off, rec_len = fetcher.gather(sd, sr)
+                keys = np.zeros(len(sd), dtype=np.int64)  # writer-unused
+                batch = RecordBatch(
+                    soa={"rec_off": rec_off, "rec_len": rec_len},
+                    data=data,
+                    keys=keys,
                 )
-                for k, g_dev in enumerate(g_devs):
-                    v = v_sh[k]
-                    sd = sd_sh[k][v]
-                    sr = sr_sh[k][v]
-                    data, rec_off, rec_len = fetcher.gather(sd, sr)
-                    keys = np.zeros(len(sd), dtype=np.int64)  # writer-unused
-                    batch = RecordBatch(
-                        soa={"rec_off": rec_off, "rec_len": rec_len},
-                        data=data,
-                        keys=keys,
-                    )
-                    tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
-                    with open(tmp, "wb") as f:
-                        write_part_fast(f, batch, order=None, level=level)
-                    os.replace(
-                        tmp, os.path.join(td, f"part-r-{g_dev:05d}")
-                    )
-            ctx.barrier("parts_written")
-        finally:
-            if srv is not None:
-                srv.shutdown()
-                srv.server_close()
-                nio.delete_recursive(write_dir)
+                tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
+                with open(tmp, "wb") as f:
+                    write_part_fast(f, batch, order=None, level=level)
+                os.replace(
+                    tmp, os.path.join(td, f"part-r-{g_dev:05d}")
+                )
+        ctx.barrier("parts_written")
     else:
+        if byte_plane == "http":
+            sources: List = _start_http_plane(ctx, spill_dir, _stack)
+        else:
+            sources = [
+                os.path.join(shuffle_dir, f"spill-{s:03d}")
+                for s in range(ctx.num_processes)
+            ]
         peak_bytes = _budget_byte_plane(
-            ctx, td, shuffle_dir, splits, own_counts, dest_of_record,
+            ctx, td, sources, splits, own_counts, dest_of_record,
             level, D, peak_bytes, RecordBatch, write_part_fast,
         )
     LAST_STATS["peak_bytes"] = peak_bytes

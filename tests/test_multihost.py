@@ -271,3 +271,67 @@ def test_two_process_http_byte_plane(bam_80k, tmp_path):
     d1 = native.decompress_all(open(out, "rb").read())
     d2 = native.decompress_all(open(out_ref, "rb").read())
     assert np.array_equal(d1, d2), "http byte plane output differs"
+
+
+_HTTP_BUDGET_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; out = sys.argv[5]; budget = int(sys.argv[6])
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.parallel import multihost
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+n = multihost.sort_bam_multihost([src], out, ctx=ctx,
+                                 split_size=1 << 20, level=1,
+                                 memory_budget=budget, byte_plane="http")
+peak = multihost.LAST_STATS["peak_bytes"]
+assert peak <= budget, f"peak {{peak}} exceeds budget {{budget}}"
+print(f"MH_HTTPB_OK pid={{pid}} n={{n}} peak={{peak}}", flush=True)
+"""
+
+
+def test_two_process_http_budget_compose(bam_80k, tmp_path):
+    """Out-of-core x multi-host x network byte plane, all at once: spill
+    runs on local disks, range-merged over authenticated HTTP, within an
+    enforced per-process budget — byte-identical output."""
+    out = str(tmp_path / "mh_httpb.bam")
+    port = _free_port()
+    budget = 5 << 20
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    worker = _HTTP_BUDGET_WORKER.format(repo=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             bam_80k, out, str(budget)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"MH_HTTPB_OK pid={pid} n=80000" in o, o[-2000:]
+
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu import native
+
+    out_ref = str(tmp_path / "ref.bam")
+    sort_bam([bam_80k], out_ref, level=1, backend="host", split_size=1 << 20)
+    d1 = native.decompress_all(open(out, "rb").read())
+    d2 = native.decompress_all(open(out_ref, "rb").read())
+    assert np.array_equal(d1, d2), "http+budget output differs"
