@@ -64,11 +64,14 @@ iota-compare reductions — ops/pallas/inflate_probe.py) clocks a marginal
 **~748 ns per 128-token wave** on the v5e (two-point fit, RTT-free):
 ~170M tokens/s ≈ **~340 MB/s** of walk-engine throughput at DEFLATE's
 ~2 output bytes/token — two orders of magnitude above this module's
-gather-bound loop and ~2x the host tier.  The remaining build is the
-full decoder around that engine (per-member table construction, one-hot
-output emit, windowed LZ77 copy resolve, far-copy fallback); until it
-lands, device inflate stays a capability tier and the probe pins the
-measured ceiling.
+gather-bound loop and ~2x the host tier.  The first production slice is
+LIVE: ops/pallas/inflate_fixed.py decodes literal-only fixed-Huffman
+members (exactly what :func:`deflate_fixed` emits, so device-compressed
+BGZF round-trips through Pallas) and is the preferred tier for the
+"fixed" group in :func:`bgzf_decompress_device` on real chips.  The
+remaining build is the general decoder around the same engine
+(per-member dynamic tables, one-hot emit for variable-emit tokens,
+windowed LZ77 copy resolve, far-copy fallback).
 
 Caveat for all launches: XLA:TPU gathers silently mis-index above 2^24
 elements per launch (f32 index precision); wrappers chunk accordingly.
@@ -1119,6 +1122,33 @@ def bgzf_decompress_device(
             for k, i in enumerate(gi):
                 s = int(co[i]) + 12 + int(xlen[i])
                 comp[k, : gc[k]] = raw[s : s + gc[k]]
+            if kind == "fixed" and jax.devices()[0].platform == "tpu":
+                # Preferred tier on real chips: the lockstep-lane Pallas
+                # decoder for literal-only fixed members (everything the
+                # device deflate emits).  Members outside its contract
+                # come back ok=False and fall through to the XLA kernels
+                # below.  Never taken on CPU: interpret-mode emulation of
+                # the lockstep walk is far slower than the XLA path.
+                from ..utils.tracing import METRICS
+                from .pallas.inflate_fixed import inflate_fixed_literal
+
+                try:
+                    out_l, ok_l = inflate_fixed_literal(comp, gc, gz)
+                except Exception:
+                    # Compile/launch failure is a tier-down, but never a
+                    # silent one — the counter makes a dead tier visible.
+                    METRICS.count("flate.lockstep_launch_error", 1)
+                    ok_l = np.zeros(len(gi), dtype=bool)
+                    out_l = None
+                all_ok = bool(ok_l.all()) if len(ok_l) else False
+                for k, i in enumerate(gi):
+                    if ok_l[k]:
+                        outs[i] = out_l[k, : gz[k]].tobytes()
+                if all_ok:
+                    continue
+                METRICS.count(
+                    "flate.lockstep_tierdown", int((~ok_l).sum())
+                )
             if kind == "fixed":
                 # pow2-bucketed like C so distinct jit signatures stay few.
                 cbits = _pow2_at_least(int(gc.max()) * 8, 4096)
@@ -1136,6 +1166,10 @@ def bgzf_decompress_device(
             out_d = np.asarray(out_d)
             ok = np.asarray(ok)
             for k, i in enumerate(gi):
+                if outs[i] is not None:
+                    # Already decoded by the lockstep Pallas tier in a
+                    # mixed fixed group — keep that result.
+                    continue
                 if ok[k]:
                     outs[i] = out_d[k, : gz[k]].tobytes()
                 elif kind != "dyn":

@@ -989,6 +989,12 @@ def _sort_bam_multihost_impl(
                     tmp, os.path.join(td, f"part-r-{g_dev:05d}")
                 )
         ctx.barrier("parts_written")
+        if byte_plane == "http":
+            # Every process fetched its share: drop the outgoing shard
+            # now so it does not coexist with the merge on disk (the
+            # ExitStack callback stays as the failure-path backstop;
+            # delete_recursive is idempotent).
+            nio.delete_recursive(write_dir)
     else:
         if byte_plane == "http":
             sources: List = _start_http_plane(ctx, spill_dir, _stack)
@@ -1001,6 +1007,10 @@ def _sort_bam_multihost_impl(
             ctx, td, sources, splits, own_counts, dest_of_record,
             level, D, peak_bytes, RecordBatch, write_part_fast,
         )
+        if byte_plane == "http":
+            # parts_written barrier has passed inside the plane: the
+            # spill runs are no longer needed by any peer.
+            nio.delete_recursive(spill_dir)
     LAST_STATS["peak_bytes"] = peak_bytes
 
     if ctx.process_id == 0:

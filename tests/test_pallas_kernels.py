@@ -141,3 +141,124 @@ def test_inflate_probe_walk_matches_oracle():
     np.testing.assert_array_equal(
         np.asarray(acc).astype(np.int64) & 0xFFFFFFFF, a_ref
     )
+
+
+class TestLockstepFixedInflate:
+    """ops/pallas/inflate_fixed.py: the first production slice of the
+    lockstep-lane decoder — literal-only fixed-Huffman members decoded
+    128-per-kernel, byte-equal to the payload, with contract violations
+    tiering down (ok=False)."""
+
+    def _encode(self, payloads):
+        from hadoop_bam_tpu.ops.flate import encode_tokens_fixed
+
+        comps = [
+            encode_tokens_fixed([("lit", b) for b in p]) for p in payloads
+        ]
+        C = max(len(c) for c in comps)
+        comp = np.zeros((len(comps), C), np.uint8)
+        clens = np.zeros(len(comps), np.int32)
+        isz = np.zeros(len(comps), np.int32)
+        for i, c in enumerate(comps):
+            comp[i, : len(c)] = np.frombuffer(c, np.uint8)
+            clens[i] = len(c)
+            isz[i] = len(payloads[i])
+        return comp, clens, isz
+
+    def test_byte_equal_and_zlib_valid(self):
+        import zlib
+
+        from hadoop_bam_tpu.ops.pallas.inflate_fixed import (
+            inflate_fixed_literal,
+        )
+
+        rng = np.random.default_rng(7)
+        payloads = [
+            rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in (1, 2, 37, 144, 255, 300)
+        ] + [bytes([200] * 50), bytes(range(256))]
+        comp, clens, isz = self._encode(payloads)
+        # The encoded streams must be real DEFLATE (zlib agrees)...
+        for i, p in enumerate(payloads):
+            d = zlib.decompressobj(-15)
+            assert d.decompress(bytes(comp[i, : clens[i]])) == p
+        # ...and the lockstep kernel must reproduce them byte-for-byte.
+        out, ok = inflate_fixed_literal(comp, clens, isz, interpret=True)
+        assert ok.all()
+        for i, p in enumerate(payloads):
+            assert out[i, : isz[i]].tobytes() == p
+
+    def test_contract_violations_tier_down(self):
+        from hadoop_bam_tpu.ops.flate import encode_tokens_fixed
+        from hadoop_bam_tpu.ops.pallas.inflate_fixed import (
+            inflate_fixed_literal,
+        )
+
+        # LZ77 copy → symbols 257+ → ok=False.
+        c = encode_tokens_fixed([("lit", 65)] * 8 + [("copy", 5, 3)])
+        comp = np.zeros((1, len(c)), np.uint8)
+        comp[0] = np.frombuffer(c, np.uint8)
+        _, ok = inflate_fixed_literal(
+            comp, np.array([len(c)], np.int32), np.array([13], np.int32),
+            interpret=True,
+        )
+        assert not ok[0]
+        # Truncated stream → EOB past the bit length → ok=False.
+        full = encode_tokens_fixed([("lit", b) for b in b"ABCDEFGH" * 8])
+        half = full[: len(full) // 2]
+        comp = np.zeros((1, len(half)), np.uint8)
+        comp[0] = np.frombuffer(half, np.uint8)
+        _, ok = inflate_fixed_literal(
+            comp, np.array([len(half)], np.int32),
+            np.array([64], np.int32), interpret=True,
+        )
+        assert not ok[0]
+        # Wrong block header (btype=10) → ok=False.
+        comp = np.zeros((1, 8), np.uint8)
+        comp[0, 0] = 0b101
+        _, ok = inflate_fixed_literal(
+            comp, np.array([8], np.int32), np.array([4], np.int32),
+            interpret=True,
+        )
+        assert not ok[0]
+
+    def test_device_deflated_bgzf_roundtrip(self):
+        """bgzf_compress_device's members (the XLA literal-only deflate)
+        decode through the lockstep kernel — the all-Pallas/XLA BGZF
+        round trip, host zlib only as the oracle."""
+        import zlib
+
+        from hadoop_bam_tpu import native
+        from hadoop_bam_tpu.ops.flate import bgzf_compress_device
+        from hadoop_bam_tpu.ops.pallas.inflate_fixed import (
+            inflate_fixed_literal,
+        )
+
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, 700, dtype=np.uint8).tobytes()
+        blob = bgzf_compress_device(data, block_payload=256)
+        raw = np.frombuffer(blob, np.uint8)
+        co, cs, us = native.scan_blocks(raw)
+        keep = [i for i in range(len(co)) if us[i] > 0]
+        xlen = raw[np.asarray(co)[keep] + 10].astype(np.int32) | (
+            raw[np.asarray(co)[keep] + 11].astype(np.int32) << 8
+        )
+        clens = np.array(
+            [cs[i] - 20 - xlen[k] for k, i in enumerate(keep)], np.int32
+        )
+        isz = np.array([us[i] for i in keep], np.int32)
+        C = int(clens.max())
+        comp = np.zeros((len(keep), C), np.uint8)
+        for k, i in enumerate(keep):
+            s = int(co[i]) + 12 + int(xlen[k])
+            comp[k, : clens[k]] = raw[s : s + clens[k]]
+        out, ok = inflate_fixed_literal(comp, clens, isz, interpret=True)
+        assert ok.all()
+        got = b"".join(
+            out[k, : isz[k]].tobytes() for k in range(len(keep))
+        )
+        assert got == data
+        # zlib cross-check of the whole stream
+        import gzip, io as _io
+
+        assert gzip.decompress(blob) == data
