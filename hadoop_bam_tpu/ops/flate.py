@@ -167,6 +167,13 @@ REV8 = np.array([_bit_reverse(i, 8) for i in range(256)], dtype=np.int32)
 # Worst case the literal-only emit expands 9/8 + header; cap the per-member
 # payload so a device-deflated block always fits the u16 BSIZE field.
 DEV_MAX_PAYLOAD = 0xDF00  # 57088 → ≤ 64252-byte block, < 0x10000
+# Default block payload for the device deflate: sized so every emitted
+# member fits the lockstep Pallas decoder's whole-member-in-VMEM budget
+# (ops/pallas/inflate_fixed.py) — device-compressed BGZF then decodes
+# entirely on the Pallas tier.  Literal-only emit has no cross-block
+# matches, so smaller blocks cost only the ~26-byte header per block
+# (~0.1% at this size), not compression ratio.
+DEV_DEFAULT_PAYLOAD = 24000
 
 # XLA:TPU gathers mis-index when a single launch exceeds 2^24 elements
 # (observed empirically: B*NB == 2^24 exact, 2^24+… corrupt — consistent
@@ -981,7 +988,9 @@ def _pow2_at_least(n: int, lo: int) -> int:
 
 
 def bgzf_compress_device(
-    data, block_payload: int = DEV_MAX_PAYLOAD, append_terminator: bool = True
+    data,
+    block_payload: int = DEV_DEFAULT_PAYLOAD,
+    append_terminator: bool = True,
 ) -> bytes:
     """Compress a byte stream into BGZF using the device deflate kernel.
 

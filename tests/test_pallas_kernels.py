@@ -262,3 +262,26 @@ class TestLockstepFixedInflate:
         import gzip, io as _io
 
         assert gzip.decompress(blob) == data
+
+
+def test_device_deflate_default_fits_lockstep_budget():
+    """The device deflate's default block size must keep every emitted
+    member inside the lockstep decoder's VMEM budget — otherwise the
+    Pallas tier silently never fires on device-compressed data."""
+    from hadoop_bam_tpu.ops.flate import (
+        DEV_DEFAULT_PAYLOAD, _pow2_at_least,
+    )
+    from hadoop_bam_tpu.ops.pallas.inflate_fixed import (
+        LANES, _VMEM_BUDGET_BYTES,
+    )
+
+    # Worst-case member geometry for a full default block: 9/8 expansion
+    # plus headers (matches bgzf_compress_device's out_bytes formula).
+    comp_bytes = (3 + 9 * DEV_DEFAULT_PAYLOAD + 7 + 7) // 8 + 1
+    t_waves = _pow2_at_least(DEV_DEFAULT_PAYLOAD + 4, 64)
+    r_words = _pow2_at_least(-(-comp_bytes // 4) + 2, 64)
+    vmem = (r_words + t_waves // 4 + 1) * LANES * 4
+    assert vmem <= _VMEM_BUDGET_BYTES, (
+        f"default device block needs {vmem} bytes VMEM, "
+        f"budget {_VMEM_BUDGET_BYTES}"
+    )
