@@ -75,6 +75,21 @@ keys_h = bam.soa_keys(bam.soa_decode(stream, oracle), stream)
 got = pack_keys_np(np.asarray(hi)[:nv], np.asarray(lo)[:nv])
 assert np.array_equal(got, keys_h)
 print("TPU_CHAIN_OK n=%d" % nv)
+
+# Lockstep fixed-Huffman inflate tier on the real chip (interpret=False):
+# device-deflated BGZF must round-trip through the Pallas decoder, and
+# bgzf_decompress_device must take the lockstep tier (no tier-downs).
+from hadoop_bam_tpu.ops.flate import bgzf_compress_device, bgzf_decompress_device
+from hadoop_bam_tpu.utils.tracing import METRICS
+
+payload = bytes(rng.integers(0, 256, 200_000, dtype=np.uint8))
+blob2 = bgzf_compress_device(payload)
+out2 = bgzf_decompress_device(blob2, check_crc=True, _force_no_host=True)
+assert out2 == payload, "lockstep round trip mismatch"
+counters = METRICS.report()["counters"]
+assert not counters.get("flate.lockstep_tierdown"), counters
+assert not counters.get("flate.lockstep_launch_error"), counters
+print("TPU_LOCKSTEP_OK n=%d" % len(payload))
 """
 
 
