@@ -335,3 +335,32 @@ def test_two_process_http_budget_compose(bam_80k, tmp_path):
     d1 = native.decompress_all(open(out, "rb").read())
     d2 = native.decompress_all(open(out_ref, "rb").read())
     assert np.array_equal(d1, d2), "http+budget output differs"
+
+
+def test_remote_npy_ranged_slices(tmp_path):
+    """_RemoteNpy must slice int64 .npy sidebands over HTTP ranged reads
+    byte-for-byte like np.load, without fetching whole files."""
+    import numpy as np
+
+    from hadoop_bam_tpu.io.fs import HttpFilesystem
+    from hadoop_bam_tpu.parallel.multihost import _RemoteNpy, _serve_dir
+
+    arr = np.arange(10_000, dtype=np.int64) * 3 - 7
+    np.save(tmp_path / "side.npy", arr)
+    os.environ["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    try:
+        srv, base = _serve_dir(str(tmp_path), "tok")
+    finally:
+        os.environ.pop("HBAM_SHUFFLE_HOST", None)
+    try:
+        fs_auth = HttpFilesystem(headers={"X-Hbam-Token": "tok"})
+        rn = _RemoteNpy(fs_auth, f"{base}/side.npy")
+        for i0, i1 in ((0, 1), (0, 100), (5000, 5001), (9990, 10000), (3, 3)):
+            np.testing.assert_array_equal(rn.slice(i0, i1), arr[i0:i1])
+        # Unauthenticated access must be refused outright.
+        fs_bad = HttpFilesystem(headers={"X-Hbam-Token": "wrong"}, retries=0)
+        with pytest.raises(Exception):
+            _RemoteNpy(fs_bad, f"{base}/side.npy")
+    finally:
+        srv.shutdown()
+        srv.server_close()
