@@ -172,6 +172,20 @@ def _measure(platform: str) -> dict:
         except Exception as e:  # never fail the headline for a diagnostic
             out["device_parse_error"] = str(e)[:120]
         out["device_rtt_ms"] = round(_device_roundtrip_ms(), 2)
+        # Secondary diagnostic: lockstep-lane inflate throughput, tracked
+        # per round next to device_parse_reads_per_sec.  Marginal-cost
+        # two-point fit (RTT-free), so tunnel topologies report the
+        # walk-engine pace rather than their round-trip latency.
+        try:
+            from hadoop_bam_tpu.ops.pallas.inflate_probe import (
+                bench_marginal,
+            )
+
+            r = bench_marginal()
+            out["device_inflate_MBps"] = round(r["projected_mb_s"], 1)
+            out["device_inflate_ns_per_wave"] = round(r["ns_per_wave"], 1)
+        except Exception as e:
+            out["device_inflate_error"] = str(e)[:120]
     return out
 
 
@@ -212,15 +226,24 @@ def main() -> None:
     probe_timeout = float(os.environ.get("HBAM_BENCH_PROBE_TIMEOUT", "300"))
     run_timeout = float(os.environ.get("HBAM_BENCH_TIMEOUT", "3000"))
     error = None
+    probed = None
 
     if want == "auto":
-        platform = _backend.probe_platform(timeout_s=probe_timeout)
-        if platform is None:
+        # One retry in a fresh subprocess (BENCH r4/r5: two consecutive
+        # opaque "init failed or timed out" CPU fallbacks); on failure the
+        # probe's stderr tail rides into the JSON error so the NEXT
+        # fallback is diagnosable instead of a bare timeout string.
+        probed, probe_err = _backend.probe_platform_ex(
+            timeout_s=probe_timeout, retries=1
+        )
+        if probed is None:
             error = (
-                "ambient backend init failed or timed out after "
-                f"{probe_timeout:.0f}s; falling back to CPU"
+                "ambient backend probe failed twice "
+                f"({probe_err or 'no diagnostics'}); falling back to CPU"
             )
             platform = "cpu"
+        else:
+            platform = probed
     else:
         platform = want
 
@@ -266,6 +289,11 @@ def main() -> None:
         error = (error + "; " if error else "") + (err or "unknown failure")
     if error:
         result["error"] = error
+    if want == "auto":
+        # What the ambient probe actually found, recorded even when the
+        # measurement fell back — "cpu because the probe saw cpu" and
+        # "cpu because the probe died" must be distinguishable.
+        result["probed_platform"] = probed or "probe-failed"
     print(json.dumps(result), flush=True)
 
 

@@ -44,6 +44,10 @@ FASTQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.fastq-output.base-quality-encoding"
 QSEQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.qseq-output.base-quality-encoding"
 # New in the TPU build (per driver BASELINE.json north star).
 BACKEND = "hadoopbam.backend"
+# Lockstep-lane Pallas inflate tier (ops/pallas/inflate_lanes.py): "true"
+# forces it on, "false" off; unset defers to the local-latency auto rule
+# (on for real, local accelerators — see ops.flate.lanes_tier_enabled).
+INFLATE_LANES = "hadoopbam.inflate.lanes"
 
 _TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
 _FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))

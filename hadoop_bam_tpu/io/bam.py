@@ -152,6 +152,17 @@ class BamInputFormat:
 
     def __init__(self, conf: Optional[Configuration] = None):
         self.conf = conf or Configuration()
+        self._device_inflate_cached: Optional[bool] = None
+
+    def _device_inflate(self) -> bool:
+        """Route split inflate through the lockstep-lane device tier?
+        Conf/env/auto-rule resolution is in ``ops.flate.lanes_tier_enabled``
+        (imported lazily — split *planning* must not pull in jax)."""
+        if self._device_inflate_cached is None:
+            from ..ops.flate import lanes_tier_enabled
+
+            self._device_inflate_cached = lanes_tier_enabled(self.conf)
+        return self._device_inflate_cached
 
     # -- planning -----------------------------------------------------------
 
@@ -393,6 +404,7 @@ class BamInputFormat:
         with_keys: bool = True,
         threads: Optional[int] = None,
         fields: Optional[Sequence[str]] = None,
+        device_inflate: Optional[bool] = None,
     ) -> RecordBatch:
         """Inflate the split's blocks and decode all its records as one batch.
 
@@ -400,7 +412,14 @@ class BamInputFormat:
         spill margin for straddling records) is read from disk — a 100GB BAM
         costs each split only its own bytes.  ``fields`` restricts the SoA
         decode (see :func:`spec.bam.soa_decode`); pass
-        :data:`SORT_FIELDS` when only keys + record extents are needed."""
+        :data:`SORT_FIELDS` when only keys + record extents are needed.
+
+        ``device_inflate`` (default: the ``hadoopbam.inflate.lanes`` conf
+        key / local-latency auto rule via ``ops.flate.lanes_tier_enabled``)
+        ships the split's blocks to the accelerator compressed and inflates
+        them on the lockstep-lane tier instead of host zlib."""
+        if device_inflate is None:
+            device_inflate = self._device_inflate()
         if data is not None:
             return read_virtual_range(
                 data,
@@ -410,6 +429,7 @@ class BamInputFormat:
                 threads=threads,
                 interval_chunks=split.interval_chunks,
                 fields=fields,
+                device_inflate=device_inflate,
             )
         sfs = fs.get_fs(split.path)
         size = sfs.size(split.path)
@@ -438,6 +458,7 @@ class BamInputFormat:
                     threads=threads,
                     interval_chunks=chunks,
                     fields=fields,
+                    device_inflate=device_inflate,
                 )
             except (bam.BamError, bgzf.BgzfError):
                 if at_eof:
@@ -491,6 +512,7 @@ def read_virtual_range(
     threads: Optional[int] = None,
     interval_chunks: Optional[List[Tuple[int, int]]] = None,
     fields: Optional[Sequence[str]] = None,
+    device_inflate: bool = False,
 ) -> RecordBatch:
     """Decode all records whose start voffset lies in ``[vstart, vend)``.
 
@@ -501,6 +523,12 @@ def read_virtual_range(
     vend are cut off.  Records *spanning* past vend are completed by
     inflating spill blocks (the ``…|0xffff`` contract guarantees the next
     split will skip them via its own vstart).
+
+    ``device_inflate`` routes the batched block inflate through the
+    lockstep-lane device codec (ops.flate.inflate_blocks_device): the
+    split's blocks ship to the accelerator *compressed* (≈4x fewer h2d
+    bytes than the inflated stream) and members the device tier rejects
+    fall back to native zlib per member — output is identical either way.
     """
     if fields is not None and with_keys:
         # Keys need refid/pos/flag + record extents even if the caller's
@@ -534,6 +562,20 @@ def read_virtual_range(
     spill_pos = pos
 
     def inflate(co, cs, us):
+        if device_inflate:
+            from ..ops import flate
+
+            try:
+                return flate.inflate_blocks_device(
+                    data,
+                    np.asarray(co, dtype=np.int64),
+                    np.asarray(cs, dtype=np.int32),
+                    np.asarray(us, dtype=np.int32),
+                )
+            except Exception:
+                # Device tier failure is never fatal to a read — tier
+                # down to the native host codec for the whole window.
+                METRICS.count("bam.device_inflate_fallback", 1)
         return native.inflate_blocks(
             data,
             np.asarray(co, dtype=np.int64),

@@ -53,25 +53,39 @@ ISIZE — spec/bgzf.py owns the layout) around the device payloads.
 Performance status (v5e-1, measured): these XLA kernels bottleneck on
 XLA:TPU gather throughput (~70M gathered elements/s) — roughly 0.5-1 MB/s
 end to end, far below the native host tier (~170 MB/s zlib).  They are
-the *capability* deliverable (device-resident decode with zero host CPU
-in the loop); the production pipeline keeps the tiered design with the
-C++ host codec on the hot path.
+the correctness floor and the universal device fallback; the hot path is
+the lockstep-lane Pallas tier below.
 
-The path past the host tier is measured, not hypothetical: the
-lockstep-lane Pallas formulation (128 members in the 128 vector lanes,
-serial Huffman walks in one kernel, per-lane window extraction as dense
-iota-compare reductions — ops/pallas/inflate_probe.py) clocks a marginal
-**~748 ns per 128-token wave** on the v5e (two-point fit, RTT-free):
-~170M tokens/s ≈ **~340 MB/s** of walk-engine throughput at DEFLATE's
-~2 output bytes/token — two orders of magnitude above this module's
-gather-bound loop and ~2x the host tier.  The first production slice is
-LIVE: ops/pallas/inflate_fixed.py decodes literal-only fixed-Huffman
-members (exactly what :func:`deflate_fixed` emits, so device-compressed
-BGZF round-trips through Pallas) and is the preferred tier for the
-"fixed" group in :func:`bgzf_decompress_device` on real chips.  The
-remaining build is the general decoder around the same engine
-(per-member dynamic tables, one-hot emit for variable-emit tokens,
-windowed LZ77 copy resolve, far-copy fallback).
+Device codec tiers, top to bottom (each tier falls through per member):
+
+1. **LIVE — lockstep lanes, general** (ops/pallas/inflate_lanes.py):
+   128 BGZF members ride the 128 vector lanes of one Pallas kernel —
+   per-member canonical Huffman tables built on chip, transposed-stream
+   bit windows, 15-compare canonical decode, byte-per-wave lockstep
+   emit, windowed LZ77 resolve with a host-assisted pass for rare
+   far-distance copies, and per-member ``[n_out, ok]`` meta so one bad
+   member tiers down without dooming its launch.  Built on the walk
+   engine ops/pallas/inflate_probe.py measured at **~748 ns per
+   128-token wave** on the v5e (~170M tokens/s ≈ **~340 MB/s** at
+   DEFLATE's ~2 output bytes/token — ~2x the native host tier).  Gated
+   by the ``hadoopbam.inflate.lanes`` conf key / ``HBAM_INFLATE_LANES``
+   env var, defaulting to the same local-latency auto rule as the
+   device-resident parse (:func:`lanes_tier_enabled`).
+2. **LIVE — lockstep lanes, literal-only fixed**
+   (ops/pallas/inflate_fixed.py): the specialized slice for what
+   :func:`deflate_fixed` emits; preferred for the "fixed" group on real
+   chips when the general tier is off.
+3. **XLA array programs** (this module): ``inflate_stored`` /
+   ``inflate_fixed`` / ``inflate_dynamic`` — slow but fully general and
+   platform-agnostic.
+4. **Native host zlib** (spec/bgzf.py + native/): the unconditional
+   correctness tier; nothing above it is load-bearing for correctness.
+
+NEXT: whole-member VMEM residency caps lanes-tier member size
+(inflate_lanes._VMEM_BUDGET_BYTES); the HBM-streaming windowed variant
+(sliding output window + the already-built far-copy host pass) lifts it,
+and on-chip output residency feeds the parsed stream straight to the
+chain kernel without the d2h/h2d bounce.
 
 Caveat for all launches: XLA:TPU gathers silently mis-index above 2^24
 elements per launch (f32 index precision); wrappers chunk accordingly.
@@ -79,6 +93,7 @@ elements per launch (f32 index precision); wrappers chunk accordingly.
 
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from functools import partial
@@ -980,6 +995,143 @@ def inflate_dynamic(
 # --------------------------------------------------------------------------
 
 
+def lanes_tier_enabled(conf=None) -> bool:
+    """Should BGZF inflate route through the lockstep-lane Pallas tier?
+
+    Resolution order: ``HBAM_INFLATE_LANES`` env var (0/1 force) →
+    ``hadoopbam.inflate.lanes`` conf key → the local-latency auto rule
+    (same stance as ``pipeline._default_device_parse``): on only for a
+    real TPU whose host↔device round trip is local-class (< 5 ms).  On a
+    CPU backend the kernel runs in (slow) interpret mode, and on a
+    tunneled remote chip the per-batch transfers pay latency the native
+    host codec does not — both lose, so the auto rule declines.
+    """
+    env = os.environ.get("HBAM_INFLATE_LANES")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    if conf is not None:
+        from ..conf import INFLATE_LANES
+
+        if INFLATE_LANES in conf:
+            return conf.get_boolean(INFLATE_LANES)
+    try:
+        from ..utils.backend import backend_initialized, device_roundtrip_ms
+
+        # The auto rule never *initializes* the backend (a wedged TPU
+        # plugin can hang on first touch, and split reads must not): it
+        # fires only in processes where the device pipeline already
+        # brought JAX up.
+        if not backend_initialized():
+            return False
+        if jax.devices()[0].platform != "tpu":
+            return False
+        return device_roundtrip_ms() < 5.0
+    except Exception:
+        return False
+
+
+def _lanes_decode_members(
+    raw: np.ndarray, co, cs, xlen, idx: List[int], us
+) -> Tuple[dict, int]:
+    """Run the lockstep-lane decoder over the members in ``idx``.
+
+    Returns ``({member_index: payload_bytes}, n_tierdown)`` — members the
+    lanes tier could not decode are simply absent and flow to the next
+    tier.  Never raises: a launch failure counts every member as a
+    tier-down (visible in METRICS, like the fixed-slice tier).
+    """
+    from ..utils.tracing import METRICS
+    from .pallas.inflate_lanes import inflate_lanes
+
+    clens = np.asarray([cs[i] - 20 - xlen[i] for i in idx], dtype=np.int32)
+    isz = np.asarray([us[i] for i in idx], dtype=np.int32)
+    comp = np.zeros((len(idx), max(int(clens.max()), 1)), dtype=np.uint8)
+    for k, i in enumerate(idx):
+        s = int(co[i]) + 12 + int(xlen[i])
+        comp[k, : clens[k]] = raw[s : s + clens[k]]
+    try:
+        out_l, ok_l = inflate_lanes(comp, clens, isz)
+    except Exception:
+        METRICS.count("flate.lanes_launch_error", 1)
+        return {}, len(idx)
+    decoded = {
+        i: out_l[k, : isz[k]].tobytes()
+        for k, i in enumerate(idx)
+        if ok_l[k]
+    }
+    n_down = len(idx) - len(decoded)
+    if n_down:
+        METRICS.count("flate.lanes_tierdown", n_down)
+    return decoded, n_down
+
+
+def inflate_blocks_device(
+    data,
+    coffsets: np.ndarray,
+    csizes: np.ndarray,
+    usizes: np.ndarray,
+    check_crc: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Device-tier drop-in for :func:`native.inflate_blocks`.
+
+    Same contract — ``(out, out_offsets)`` with block i's payload at
+    ``out[out_offsets[i]:out_offsets[i+1]]`` — but the member payloads
+    ship to the accelerator *compressed* (≈4x fewer h2d bytes than the
+    inflated stream) and inflate on the lockstep-lane tier; members the
+    tier rejects fall back to native host zlib per member.  This is the
+    split-read surface: ``io.bam.read_virtual_range(device_inflate=True)``
+    routes its batched block inflate here when the lanes tier is enabled.
+    """
+    from .. import native
+
+    raw = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    n = len(coffsets)
+    out_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.asarray(usizes, dtype=np.int64), out=out_offsets[1:])
+    out = np.empty(int(out_offsets[-1]), dtype=np.uint8)
+    co64 = np.asarray(coffsets, dtype=np.int64)
+    xlen = raw[co64 + 10].astype(np.int32) | (
+        raw[co64 + 11].astype(np.int32) << 8
+    )
+    live = [i for i in range(n) if usizes[i] > 0]
+    decoded, _ = (
+        _lanes_decode_members(raw, coffsets, csizes, xlen, live, usizes)
+        if live
+        else ({}, 0)
+    )
+    fallback: List[int] = []
+    for i in live:
+        payload = decoded.get(i)
+        if payload is None:
+            fallback.append(i)
+            continue
+        if check_crc:
+            crc = struct.unpack_from(
+                "<I", raw, int(coffsets[i]) + int(csizes[i]) - 8
+            )[0]
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                fallback.append(i)  # host re-decode decides corrupt-vs-bug
+                continue
+        out[out_offsets[i] : out_offsets[i + 1]] = np.frombuffer(
+            payload, dtype=np.uint8
+        )
+    if fallback:
+        f_out, f_offs = native.inflate_blocks(
+            raw,
+            co64[fallback],
+            np.asarray(csizes, dtype=np.int32)[fallback],
+            np.asarray(usizes, dtype=np.int32)[fallback],
+            check_crc=check_crc,
+        )
+        for k, i in enumerate(fallback):
+            out[out_offsets[i] : out_offsets[i + 1]] = f_out[
+                f_offs[k] : f_offs[k + 1]
+            ]
+    return out, out_offsets
+
+
 def _pow2_at_least(n: int, lo: int) -> int:
     v = lo
     while v < n:
@@ -1055,19 +1207,28 @@ def bgzf_compress_device(
 
 
 def bgzf_decompress_device(
-    data, check_crc: bool = True, _force_no_host: bool = False
+    data,
+    check_crc: bool = True,
+    _force_no_host: bool = False,
+    conf=None,
 ) -> bytes:
     """Decompress a whole BGZF stream, batching members onto the device.
 
-    Members are grouped by first-block DEFLATE flavor and dispatched to the
-    matching device kernel — ``inflate_stored`` / ``inflate_fixed`` /
-    ``inflate_dynamic`` (the general decoder; real zlib output at level ≥1
-    is dynamic-Huffman and decodes on device).  A member whose specialized
-    kernel rejects it (mixed block flavors) retries through the general
-    decoder, and only a member the device cannot decode at all tiers down
-    to native host zlib — same data, same result, tiered like the split
-    planner (BAMInputFormat.java:244-258).  ``_force_no_host`` turns that
-    last tier into an error (device-only mode, used by tests)."""
+    When the lockstep-lane tier is enabled (``hadoopbam.inflate.lanes`` /
+    ``HBAM_INFLATE_LANES`` / the local-latency auto rule — see
+    :func:`lanes_tier_enabled`), every member first rides the general
+    Pallas decoder (ops/pallas/inflate_lanes.py); only members it rejects
+    continue below.  The remainder are grouped by first-block DEFLATE
+    flavor and dispatched to the matching XLA kernel —
+    ``inflate_stored`` / ``inflate_fixed`` / ``inflate_dynamic`` (the
+    general decoder; real zlib output at level ≥1 is dynamic-Huffman and
+    decodes on device).  A member whose specialized kernel rejects it
+    (mixed block flavors) retries through the general decoder, and only a
+    member the device cannot decode at all tiers down to native host
+    zlib — same data, same result, tiered like the split planner
+    (BAMInputFormat.java:244-258).  The chain is lanes → XLA → host and
+    correctness never depends on a device tier.  ``_force_no_host`` turns
+    the last tier into an error (device-only mode, used by tests)."""
     from .. import native
 
     raw = np.frombuffer(data, dtype=np.uint8) if not isinstance(
@@ -1101,6 +1262,21 @@ def bgzf_decompress_device(
             # every real-world BAM): the device decoder builds the
             # canonical tables per member/block on chip.
             groups["dyn"].append(i)
+    # ---- Tier 1: the general lockstep-lane Pallas decoder --------------
+    # One pass over every member regardless of block flavor (the lanes
+    # kernel walks any stored/fixed/dynamic mix); members it rejects stay
+    # in their flavor group and continue through the XLA tiers below.
+    lanes_idx = (
+        groups["stored"] + groups["fixed"] + groups["dyn"]
+        if lanes_tier_enabled(conf)
+        else []
+    )
+    if lanes_idx:
+        decoded, _ = _lanes_decode_members(raw, co, cs, xlen, lanes_idx, us)
+        for i, payload in decoded.items():
+            outs[i] = payload
+        for kind in groups:
+            groups[kind] = [i for i in groups[kind] if i not in decoded]
     for kind in ("stored", "fixed", "dyn"):
         idx = groups[kind]
         if not idx:

@@ -1,0 +1,687 @@
+"""Lockstep-lane Pallas inflate for *general* DEFLATE members.
+
+The production promotion of the walk engine measured by
+ops/pallas/inflate_probe.py (~748 ns per 128-token wave on a v5e — ~340
+MB/s of walk-engine throughput): up to 128 BGZF members ride the 128
+vector lanes of one kernel, each walking its own DEFLATE bit stream
+serially through any per-member mix of stored/fixed/dynamic blocks.
+
+Architecture (all stages share the probe's register/VMEM-resident style —
+per-lane row selects are dense iota-compare column reductions, never
+gathers):
+
+- streams live TRANSPOSED in VMEM ([words, 128]: member j's words go down
+  lane j); "read 32 bits at my cursor" is two one-hot row selects;
+- per-member canonical Huffman tables are built ON CHIP per block — the
+  length histogram, first-code and symbol-offset columns are static
+  15-step loops over [1,128] rows, and the canonical symbol ranking is a
+  288-step lockstep scan with one-hot scatters (semantics pinned to
+  ops/flate.py's ``_canonical_decoder``/``_kraft_valid``, the spec);
+- decode is the 15-compare canonical range test of the probe, against the
+  per-lane table columns — pure elementwise VPU work;
+- emit is a byte-per-wave state machine: every wave each live lane either
+  emits one literal, copies one LZ77 byte back from its own output
+  column, streams one stored-block byte, decodes a length/distance pair,
+  or retires its block on EOB — so lanes with different block types and
+  token mixes stay in lockstep;
+- LZ77 copies resolve in-kernel through a window of the lane's own output
+  column (the whole member rides VMEM in this slice, so the window spans
+  the member); copies farther than ``far_dist`` — and any later copy
+  whose source could overlap a deferred destination — are recorded in a
+  small per-lane side list and replayed by a host-assisted pass after
+  download (rare by construction; list overflow tiers the member down);
+- per-member ``[n_out, ok]`` meta comes back with the payload, so a
+  single bad member tiers down to the XLA/host decoders without dooming
+  its launch.
+
+The whole-member-in-VMEM layout caps member size by the VMEM budget
+(``_VMEM_BUDGET_BYTES``); members past it come back ``ok=False`` and tier
+down.  The HBM-streaming windowed variant (small ``far_dist``, sliding
+output window) is the follow-up that lifts the cap — the host-assisted
+far-copy pass below is exactly the machinery it needs.
+
+Oracle: zlib via the fuzz corpus in tests/test_inflate_lanes.py; tests
+run the kernel in interpret mode on CPU and compare byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..flate import CLC_ORDER, DIST_BASE, DIST_EXTRA, LEN_BASE, LEN_EXTRA
+
+LANES = 128
+
+#: Code-length section is ≤ 286+30 = 316 codes; RLE tokens never exceed it.
+_MAX_CODES = 320
+_MAX_HDR_TOKENS = 318
+
+#: VMEM budget for one launch (streams + output + table scratch).  Members
+#: whose geometry exceeds it come back ok=False and tier down to the XLA
+#: decoder; the HBM-streaming windowed variant is the follow-up.
+_VMEM_BUDGET_BYTES = 10 << 20
+
+
+def _sel_const(idx: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+    """Per-lane select from a small static table: out[lane]=table[idx[lane]]
+    as a static compare loop (no gather)."""
+    out = jnp.zeros_like(idx)
+    for k in range(len(table)):
+        out = jnp.where(idx == k, int(table[k]), out)
+    return out
+
+
+def _rev_bits(w: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Reverse the low ``n`` bits of uint32 ``w`` (stream bit 0 → MSB)."""
+    r = jnp.zeros_like(w)
+    for k in range(n):
+        r = r | (((w >> k) & 1) << (n - 1 - k))
+    return r.astype(jnp.int32)
+
+
+def _build_canon(lens: jnp.ndarray, S: int, maxl: int):
+    """Per-lane canonical tables from code lengths (``_canonical_decoder``
+    semantics, lockstep form).
+
+    ``lens``: int32 [S, 128].  Returns ``(first, count, symoff)`` as python
+    lists of [1,128] columns indexed by code length, plus ``sym_sorted``
+    [S,128]: a code of length L and MSB-first value c decodes to
+    ``sym_sorted[symoff[L] + c - first[L]]``.
+    """
+    count = [jnp.zeros((1, LANES), jnp.int32)]
+    for L in range(1, maxl + 1):
+        count.append(
+            jnp.sum((lens == L).astype(jnp.int32), axis=0, keepdims=True)
+        )
+    first = [jnp.zeros((1, LANES), jnp.int32)]
+    code = jnp.zeros((1, LANES), jnp.int32)
+    for L in range(1, maxl + 1):
+        code = (code + count[L - 1]) << 1
+        first.append(code)
+    symoff = []
+    acc = jnp.zeros((1, LANES), jnp.int32)
+    for L in range(0, maxl + 1):
+        symoff.append(acc)
+        acc = acc + count[L]
+    # Canonical symbol ranking: lockstep scan over the symbol axis; each
+    # step places one symbol per lane via a one-hot row scatter.
+    rows_S = lax.broadcasted_iota(jnp.int32, (S, LANES), 0)
+    rows_L = lax.broadcasted_iota(jnp.int32, (maxl + 1, LANES), 0)
+
+    def sbody(s, st):
+        sym_sorted, taken = st
+        len_s = jnp.sum(
+            jnp.where(rows_S == s, lens, 0), axis=0, keepdims=True
+        )
+        rank = jnp.zeros((1, LANES), jnp.int32)
+        for L in range(1, maxl + 1):
+            rank = jnp.where(
+                len_s == L, symoff[L] + taken[L : L + 1, :], rank
+            )
+        use = len_s > 0
+        sym_sorted = jnp.where((rows_S == rank) & use, s, sym_sorted)
+        taken = jnp.where((rows_L == len_s) & use, taken + 1, taken)
+        return sym_sorted, taken
+
+    sym_sorted, _ = lax.fori_loop(
+        0,
+        S,
+        sbody,
+        (
+            jnp.zeros((S, LANES), jnp.int32),
+            jnp.zeros((maxl + 1, LANES), jnp.int32),
+        ),
+    )
+    return first, count, symoff, sym_sorted
+
+
+def _kraft_ok(count, maxl: int, allow_single: bool) -> jnp.ndarray:
+    """Per-lane Kraft validity of a length histogram (``_kraft_valid``
+    semantics: reject over-subscribed and incomplete sets, except zlib's
+    lone length-1 code grace when ``allow_single``)."""
+    kraft = jnp.zeros((1, LANES), jnp.int32)
+    ncodes = jnp.zeros((1, LANES), jnp.int32)
+    for L in range(1, maxl + 1):
+        kraft = kraft + (count[L] << (maxl - L))
+        ncodes = ncodes + count[L]
+    ok = (ncodes == 0) | (kraft == (1 << maxl))
+    if allow_single:
+        ok = ok | ((ncodes == 1) & (count[1] == 1))
+    return ok
+
+
+def _canon_decode(rev, first, count, symoff, sym_sorted, maxl, rows_S):
+    """15-compare canonical decode of MSB-first-reversed windows against
+    per-lane tables.  Returns (sym, L, matched); speculative garbage
+    positions may be unmatched."""
+    S = sym_sorted.shape[0]
+    Lsel = jnp.full((1, LANES), 99, jnp.int32)
+    f_s = jnp.zeros((1, LANES), jnp.int32)
+    o_s = jnp.zeros((1, LANES), jnp.int32)
+    for L in range(maxl, 0, -1):  # downward: smallest L wins last
+        cand = rev >> (maxl - L)
+        match = (cand >= first[L]) & (cand < first[L] + count[L])
+        Lsel = jnp.where(match, L, Lsel)
+        f_s = jnp.where(match, first[L], f_s)
+        o_s = jnp.where(match, symoff[L], o_s)
+    matched = Lsel < 99
+    Ls = jnp.where(matched, Lsel, 1)
+    cand = rev >> (maxl - Ls)
+    idx = jnp.clip(o_s + cand - f_s, 0, S - 1)
+    sym = jnp.sum(
+        jnp.where(rows_S == idx, sym_sorted, 0), axis=0, keepdims=True
+    )
+    return sym, Ls, matched
+
+
+def _kernel_factory(
+    R: int,
+    OUT_ROWS: int,
+    T_ROUND: int,
+    MAX_BLOCKS: int,
+    MAX_FAR: int,
+    FAR_DIST: int,
+):
+    """R stream words/lane; OUT_ROWS packed output words/lane; T_ROUND
+    emit-wave budget per block round."""
+
+    def kernel(
+        streams_ref,
+        nbits_ref,
+        isize_ref,
+        out_ref,
+        nout_ref,
+        ok_ref,
+        farc_ref,
+        fara_ref,
+        farb_ref,
+    ):
+        rows_R = lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+        rows_O = lax.broadcasted_iota(jnp.int32, (OUT_ROWS, LANES), 0)
+        rows_ll = lax.broadcasted_iota(jnp.int32, (288, LANES), 0)
+        rows_dl = lax.broadcasted_iota(jnp.int32, (32, LANES), 0)
+        rows_cl = lax.broadcasted_iota(jnp.int32, (19, LANES), 0)
+        rows_hc = lax.broadcasted_iota(jnp.int32, (_MAX_CODES, LANES), 0)
+        rows_F = lax.broadcasted_iota(jnp.int32, (MAX_FAR, LANES), 0)
+        nbits = nbits_ref[:, :]
+        isize = isize_ref[:, :]
+
+        def word_at(widx):
+            onehot = rows_R == widx
+            return jnp.sum(
+                jnp.where(onehot, streams_ref[:, :], 0),
+                axis=0,
+                keepdims=True,
+            ).astype(jnp.uint32)
+
+        def window(cur):
+            """32 stream bits at per-lane bit cursor ``cur`` [1,128]."""
+            widx = cur >> 5
+            w0 = word_at(widx)
+            w1 = word_at(widx + 1)
+            sh = (cur & 31).astype(jnp.uint32)
+            return jnp.where(sh == 0, w0, (w0 >> sh) | (w1 << (32 - sh)))
+
+        def out_byte_at(out, pos):
+            word = jnp.sum(
+                jnp.where(rows_O == (pos >> 2), out, 0),
+                axis=0,
+                keepdims=True,
+            ).astype(jnp.uint32)
+            return (word >> (8 * (pos & 3)).astype(jnp.uint32)) & 0xFF
+
+        def out_write(out, pos, byte, mask):
+            onehot = (rows_O == (pos >> 2)) & mask
+            shifted = (
+                byte.astype(jnp.uint32)
+                << (8 * (pos & 3)).astype(jnp.uint32)
+            ).astype(jnp.int32)
+            return jnp.where(onehot, out | shifted, out)
+
+        # Fixed-Huffman length vectors (RFC 1951 §3.2.6), built from iota
+        # in-kernel (Pallas kernels cannot capture array constants).
+        fixed_ll = jnp.where(
+            rows_ll < 144,
+            8,
+            jnp.where(rows_ll < 256, 9, jnp.where(rows_ll < 280, 7, 8)),
+        ).astype(jnp.int32)
+        fixed_dl = jnp.full((32, LANES), 5, jnp.int32)
+
+        # ---- member-wide carried state ---------------------------------
+        cur0 = jnp.zeros((1, LANES), jnp.int32)
+        n_out0 = jnp.zeros((1, LANES), jnp.int32)
+        ok0 = jnp.ones((1, LANES), bool)
+        done0 = nbits == 0  # padding lanes finish immediately
+        out0 = jnp.zeros((OUT_ROWS, LANES), jnp.int32)
+        fara0 = jnp.zeros((MAX_FAR, LANES), jnp.int32)
+        farb0 = jnp.zeros((MAX_FAR, LANES), jnp.int32)
+        farc0 = jnp.zeros((1, LANES), jnp.int32)
+        hole0 = jnp.full((1, LANES), jnp.int32(0x7FFFFFFF))
+
+        def round_body(carry):
+            (blk, cur, n_out, ok, done, out,
+             fara, farb, farc, hole_lo) = carry
+            live = ok & ~done
+            hdr = window(cur)
+            bfinal = (hdr & 1) == 1
+            btype = ((hdr >> 1) & 3).astype(jnp.int32)
+            ok = ok & (~live | (btype != 3))
+            is_stored = live & (btype == 0)
+            is_dyn = live & (btype == 2)
+
+            # ---- stored block setup (byte-aligned LEN/NLEN) ------------
+            st_bit = (cur + 3 + 7) & ~7
+            ln_w = window(st_bit)
+            s_len = (ln_w & 0xFFFF).astype(jnp.int32)
+            s_nlen = ((ln_w >> 16) & 0xFFFF).astype(jnp.int32)
+            ok = ok & (
+                ~is_stored
+                | (
+                    (s_len == (s_nlen ^ 0xFFFF))
+                    & (st_bit + 32 + 8 * s_len <= nbits)
+                )
+            )
+
+            # ---- dynamic header parse (btype=10) -----------------------
+            at = cur + 3
+            hlit = (window(at) & 31).astype(jnp.int32) + 257
+            hdist = (window(at + 5) & 31).astype(jnp.int32) + 1
+            hclen = (window(at + 10) & 15).astype(jnp.int32) + 4
+            ok = ok & (~is_dyn | ((hlit <= 286) & (hdist <= 30)))
+            cl_lens = jnp.zeros((19, LANES), jnp.int32)
+            for i in range(19):
+                bits = (window(at + 14 + 3 * i) & 7).astype(jnp.int32)
+                bits = jnp.where(i < hclen, bits, 0)
+                cl_lens = jnp.where(
+                    rows_cl == int(CLC_ORDER[i]), bits, cl_lens
+                )
+            clc = _build_canon(cl_lens, 19, 7)
+            ok = ok & (~is_dyn | _kraft_ok(clc[1], 7, allow_single=False))
+            total_codes = hlit + hdist
+
+            # Code-length RLE: one CLC token per wave, lockstep across
+            # lanes; repeats land as masked row-range writes.
+            def hcond(st):
+                pos, cnt, prev, okh, lens_all, it = st
+                act = is_dyn & okh & (cnt < total_codes)
+                return (it < _MAX_HDR_TOKENS) & jnp.any(act)
+
+            def hbody(st):
+                pos, cnt, prev, okh, lens_all, it = st
+                w = window(pos)
+                r7 = _rev_bits(w, 7)
+                csym, cL, cm = _canon_decode(
+                    r7, clc[0], clc[1], clc[2], clc[3], 7, rows_cl
+                )
+                ext = (w >> cL.astype(jnp.uint32)).astype(jnp.int32)
+                rep = jnp.where(
+                    csym < 16,
+                    1,
+                    jnp.where(
+                        csym == 16,
+                        3 + (ext & 3),
+                        jnp.where(
+                            csym == 17, 3 + (ext & 7), 11 + (ext & 127)
+                        ),
+                    ),
+                )
+                val = jnp.where(
+                    csym < 16, csym, jnp.where(csym == 16, prev, 0)
+                )
+                nb = cL + jnp.where(
+                    csym < 16,
+                    0,
+                    jnp.where(
+                        csym == 16, 2, jnp.where(csym == 17, 3, 7)
+                    ),
+                )
+                act = is_dyn & okh & (cnt < total_codes)
+                okh = okh & (~act | cm)
+                wr = act & okh
+                lens_all = jnp.where(
+                    (rows_hc >= cnt) & (rows_hc < cnt + rep) & wr,
+                    val,
+                    lens_all,
+                )
+                pos = pos + jnp.where(wr, nb, 0)
+                cnt = cnt + jnp.where(wr, rep, 0)
+                prev = jnp.where(wr, val, prev)
+                return pos, cnt, prev, okh, lens_all, it + 1
+
+            hpos, hcnt, _, hok, lens_all, _ = lax.while_loop(
+                hcond,
+                hbody,
+                (
+                    at + 14 + 3 * hclen,
+                    jnp.zeros((1, LANES), jnp.int32),
+                    jnp.zeros((1, LANES), jnp.int32),
+                    jnp.ones((1, LANES), bool),
+                    jnp.zeros((_MAX_CODES, LANES), jnp.int32),
+                    jnp.int32(0),
+                ),
+            )
+            ok = ok & (
+                ~is_dyn | (hok & (hcnt == total_codes) & (hpos <= nbits))
+            )
+
+            dyn_ll = jnp.where(rows_ll < hlit, lens_all[:288, :], 0)
+            dl_cols = []
+            for d in range(32):
+                col = jnp.sum(
+                    jnp.where(rows_hc == hlit + d, lens_all, 0),
+                    axis=0,
+                    keepdims=True,
+                )
+                dl_cols.append(jnp.where(d < hdist, col, 0))
+            dyn_dl = jnp.concatenate(dl_cols, axis=0)
+
+            use_dyn = btype == 2
+            ll_lens = jnp.where(use_dyn, dyn_ll, fixed_ll)
+            dl_lens = jnp.where(use_dyn, dyn_dl, fixed_dl)
+            ll = _build_canon(ll_lens, 288, 15)
+            dl = _build_canon(dl_lens, 32, 15)
+            ok = ok & (
+                ~is_dyn
+                | (
+                    _kraft_ok(ll[1], 15, allow_single=True)
+                    & _kraft_ok(dl[1], 15, allow_single=True)
+                )
+            )
+
+            data_start = jnp.where(
+                use_dyn, hpos, jnp.where(btype == 0, st_bit + 32, cur + 3)
+            )
+
+            # ---- emit loop: one output byte per lane per wave ----------
+            def econd(st):
+                (it, cur, n_out, ok, blk_done, copy_rem, copy_dist,
+                 rem, out, fara, farb, farc, hole_lo) = st
+                return (it < T_ROUND) & jnp.any(live & ok & ~blk_done)
+
+            def ebody(st):
+                (it, cur, n_out, ok, blk_done, copy_rem, copy_dist,
+                 rem, out, fara, farb, farc, hole_lo) = st
+                active = live & ok & ~blk_done
+                in_copy = active & (copy_rem > 0)
+                in_stored = active & is_stored & (rem > 0)
+                decode = active & ~is_stored & ~in_copy
+
+                # 1. LZ77 copy byte (reads before this wave's writes).
+                cb = out_byte_at(out, n_out - copy_dist)
+                # 2. stored byte (cursor is byte-aligned in stored blocks).
+                sb = window(cur) & 0xFF
+                # 3. token decode at the cursor.
+                w = window(cur)
+                sym, L, m = _canon_decode(
+                    _rev_bits(w, 15), ll[0], ll[1], ll[2], ll[3], 15,
+                    rows_ll,
+                )
+                islit = decode & m & (sym < 256)
+                iseob = decode & m & (sym == 256)
+                islen = decode & m & (sym > 256) & (sym < 286)
+                bad = decode & (~m | (sym >= 286))
+                li = jnp.clip(sym - 257, 0, 28)
+                le = _sel_const(li, LEN_EXTRA)
+                lenval = _sel_const(li, LEN_BASE) + (
+                    (w >> L.astype(jnp.uint32)).astype(jnp.int32)
+                    & ((1 << le) - 1)
+                )
+                wd = window(cur + L + le)
+                dsym, Ld, md = _canon_decode(
+                    _rev_bits(wd, 15), dl[0], dl[1], dl[2], dl[3], 15,
+                    rows_dl,
+                )
+                bad = bad | (islen & (~md | (dsym >= 30)))
+                dsym = jnp.clip(dsym, 0, 29)
+                de = _sel_const(dsym, DIST_EXTRA)
+                dist = _sel_const(dsym, DIST_BASE) + (
+                    (wd >> Ld.astype(jnp.uint32)).astype(jnp.int32)
+                    & ((1 << de) - 1)
+                )
+                adv = jnp.where(islit | iseob, L, L + le + Ld + de)
+                bad = bad | (decode & (cur + adv > nbits))
+                bad = bad | (islen & (dist > n_out))
+                islit = islit & ~bad
+                iseob = iseob & ~bad
+                islen = islen & ~bad
+                ok = ok & ~bad
+
+                # Far copies (past the resolve window, or sourcing at/after
+                # a deferred destination) are recorded for the host pass;
+                # their output bytes stay zero and n_out skips ahead.
+                far = islen & (
+                    (dist > FAR_DIST)
+                    | (n_out - dist + lenval > hole_lo)
+                )
+                can_rec = farc < MAX_FAR
+                ok = ok & (~far | can_rec)
+                rec = far & can_rec
+                fara = jnp.where(
+                    (rows_F == farc) & rec, (n_out << 9) | lenval, fara
+                )
+                farb = jnp.where((rows_F == farc) & rec, dist, farb)
+                hole_lo = jnp.where(
+                    rec, jnp.minimum(hole_lo, n_out), hole_lo
+                )
+                farc = farc + rec.astype(jnp.int32)
+                near = islen & ~far
+
+                # Emits: exactly one byte per emitting lane this wave.
+                byte = jnp.where(
+                    in_copy, cb, jnp.where(in_stored, sb, sym & 0xFF)
+                ).astype(jnp.uint32)
+                emit = in_copy | in_stored | islit
+                out = out_write(out, n_out, byte, emit)
+                n_out = (
+                    n_out
+                    + emit.astype(jnp.int32)
+                    + jnp.where(rec, lenval, 0)
+                )
+                copy_rem = jnp.where(
+                    near, lenval, copy_rem - in_copy.astype(jnp.int32)
+                )
+                copy_dist = jnp.where(near, dist, copy_dist)
+                rem = rem - in_stored.astype(jnp.int32)
+                cur = (
+                    cur
+                    + jnp.where(decode & ~bad, adv, 0)
+                    + 8 * in_stored.astype(jnp.int32)
+                )
+                blk_done = blk_done | iseob | (
+                    active & is_stored & (rem == 0)
+                )
+                return (it + 1, cur, n_out, ok, blk_done, copy_rem,
+                        copy_dist, rem, out, fara, farb, farc, hole_lo)
+
+            (_, cur, n_out, ok, blk_done, _, _, _, out,
+             fara, farb, farc, hole_lo) = lax.while_loop(
+                econd,
+                ebody,
+                (
+                    jnp.int32(0),
+                    data_start,
+                    n_out,
+                    ok,
+                    ~live,
+                    jnp.zeros((1, LANES), jnp.int32),
+                    jnp.ones((1, LANES), jnp.int32),
+                    jnp.where(is_stored, s_len, 0),
+                    out,
+                    fara,
+                    farb,
+                    farc,
+                    hole_lo,
+                ),
+            )
+            # A block that did not retire within the wave budget is invalid.
+            ok = ok & (~live | blk_done)
+            done = done | (live & bfinal)
+            return (blk + 1, cur, n_out, ok, done, out,
+                    fara, farb, farc, hole_lo)
+
+        def round_cond(carry):
+            blk, _, _, ok, done = carry[0], carry[1], carry[2], carry[3], carry[4]
+            return (blk < MAX_BLOCKS) & jnp.any(ok & ~done)
+
+        (_, _, n_out, ok, done, out, fara, farb, farc, _) = lax.while_loop(
+            round_cond,
+            round_body,
+            (jnp.int32(0), cur0, n_out0, ok0, done0, out0,
+             fara0, farb0, farc0, hole0),
+        )
+        ok = ok & done & (n_out == isize)
+        out_ref[:, :] = out
+        nout_ref[:, :] = n_out
+        ok_ref[:, :] = ok.astype(jnp.int32)
+        farc_ref[:, :] = farc
+        fara_ref[:, :] = fara
+        farb_ref[:, :] = farb
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "r_words", "out_rows", "t_round", "max_blocks", "max_far",
+        "far_dist", "interpret",
+    ),
+)
+def _launch(
+    streams, nbits, isizes, r_words: int, out_rows: int, t_round: int,
+    max_blocks: int, max_far: int, far_dist: int, interpret: bool,
+):
+    kernel = _kernel_factory(
+        r_words, out_rows, t_round, max_blocks, max_far, far_dist
+    )
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(6)
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((out_rows, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((max_far, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((max_far, LANES), jnp.int32),
+        ),
+        interpret=interpret,
+    )(streams, nbits, isizes)
+
+
+def _apply_far_copies(
+    lane_bytes: np.ndarray, fara: np.ndarray, farb: np.ndarray, n: int
+) -> None:
+    """Replay a lane's deferred far-distance copies in stream order.
+
+    Events are recorded so that every source byte is either kernel-correct
+    or patched by an earlier event, so an in-order byte loop (which also
+    handles overlapping copies) reconstructs the exact LZ77 semantics."""
+    for e in range(n):
+        a = int(fara[e])
+        dst, ln, dist = a >> 9, a & 511, int(farb[e])
+        for k in range(ln):
+            lane_bytes[dst + k] = lane_bytes[dst + k - dist]
+
+
+def inflate_lanes(
+    comp: np.ndarray,
+    clens: np.ndarray,
+    isizes: np.ndarray,
+    max_blocks: int = 12,
+    max_far: int = 64,
+    far_dist: int = 1 << 15,
+    interpret=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched lockstep inflate of general DEFLATE members (any mix of
+    stored/fixed/dynamic blocks), 128 members per kernel launch.
+
+    ``comp`` uint8 [B, C] (rows zero-padded), ``clens``/``isizes`` int32
+    [B].  Returns ``(out uint8 [B, max_isize], ok bool [B])`` — a member
+    that is corrupt, exceeds ``max_blocks`` DEFLATE blocks, overflows the
+    ``max_far`` far-copy budget, or whose geometry exceeds the VMEM budget
+    comes back ``ok=False`` and the caller tiers down to the XLA/host
+    decoders.  ``far_dist`` bounds the in-kernel LZ77 resolve window;
+    copies past it defer to the host-assisted replay pass (the default
+    covers every legal DEFLATE distance, so the pass is exercised only by
+    the windowed configuration)."""
+    from ..flate import _pow2_at_least
+
+    B, C = comp.shape
+    if B == 0:
+        return np.empty((0, 0), np.uint8), np.empty(0, bool)
+    max_out = int(isizes.max()) if len(isizes) else 0
+    out_rows = _pow2_at_least(max(-(-max_out // 4), 1), 32)
+    out_cap = out_rows * 4
+    t_round = out_cap + out_cap // 3 + 64
+    r_words = _pow2_at_least(-(-C // 4) + 2, 32)
+    vmem = (
+        (r_words + 2 * out_rows + _MAX_CODES + 288 + 64 + 2 * max_far + 256)
+        * LANES * 4
+    )
+    out = np.zeros((B, max_out), dtype=np.uint8)
+    ok_all = np.zeros(B, dtype=bool)
+    if vmem > _VMEM_BUDGET_BYTES:
+        return out, ok_all
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    for g0 in range(0, B, LANES):
+        g1 = min(B, g0 + LANES)
+        n = g1 - g0
+        # Transpose the group: member j's words go down lane j.
+        grp = np.zeros((r_words * 4, LANES), dtype=np.uint8)
+        grp[:C, :n] = comp[g0:g1].T
+        words = (
+            grp.reshape(r_words, 4, LANES).astype(np.uint32)
+            * (np.uint32(1) << (8 * np.arange(4, dtype=np.uint32)))[
+                None, :, None
+            ]
+        ).sum(axis=1).astype(np.uint32).view(np.int32)
+        nbits = np.zeros((1, LANES), dtype=np.int32)
+        nbits[0, :n] = clens[g0:g1] * 8
+        isz = np.zeros((1, LANES), dtype=np.int32)
+        isz[0, :n] = isizes[g0:g1]
+        o, nout, okk, farc, fara, farb = _launch(
+            jnp.asarray(words), jnp.asarray(nbits), jnp.asarray(isz),
+            r_words, out_rows, t_round, max_blocks, max_far, far_dist,
+            bool(interpret),
+        )
+        by = np.asarray(o).view(np.uint32)
+        bytes_mat = np.zeros((out_cap, LANES), dtype=np.uint8)
+        for k in range(4):
+            bytes_mat[k::4] = ((by >> np.uint32(8 * k)) & 0xFF).astype(
+                np.uint8
+            )
+        nout = np.asarray(nout)[0]
+        okk = np.asarray(okk)[0].astype(bool)
+        farc = np.asarray(farc)[0]
+        fara = np.asarray(fara)
+        farb = np.asarray(farb)
+        for j in range(n):
+            i = g0 + j
+            okj = okk[j] and int(nout[j]) == int(isizes[i])
+            ok_all[i] = okj
+            if okj:
+                lane = bytes_mat[: isizes[i], j].copy()
+                if farc[j]:
+                    _apply_far_copies(
+                        lane, fara[:, j], farb[:, j], int(farc[j])
+                    )
+                out[i, : isizes[i]] = lane
+    return out, ok_all

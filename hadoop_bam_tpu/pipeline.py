@@ -123,7 +123,14 @@ def sort_bam(
     with ``memory_budget`` (explicit True raises; spill runs sort
     host-side).  Device-derived record counts are validated against the
     host chain walk; any mismatch — or any device-side error — falls back
-    to host-built keys for the whole job."""
+    to host-built keys for the whole job.
+
+    When the lockstep-lane inflate tier is also enabled (the
+    ``hadoopbam.inflate.lanes`` conf key on ``conf``, or the same
+    local-latency auto rule), the split reads feeding this mode upload
+    *compressed* BGZF blocks and inflate them on-device
+    (``io.bam.read_split`` → ``ops.flate.inflate_blocks_device``) — ≈4x
+    fewer h2d bytes than shipping the inflated stream."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -386,32 +393,17 @@ def sort_bam(
     return SortStats(n_records=n, n_splits=len(splits), backend=backend)
 
 
-_DEVICE_RTT_MS: Optional[float] = None
-
-
 def _device_roundtrip_ms() -> float:
     """Median small-transfer host↔device round trip (cached per process).
 
     Local PCIe/ICI chips answer in well under a millisecond; a tunneled
     remote chip (the dev topology here) costs tens of milliseconds per
-    RPC, which changes which sort_bam mode wins."""
-    global _DEVICE_RTT_MS
-    if _DEVICE_RTT_MS is None:
-        import time
+    RPC, which changes which sort_bam mode wins.  Shared with the
+    lockstep-lane inflate tier's auto rule — the probe lives in
+    utils.backend so ops/ and pipeline gate on the same measurement."""
+    from .utils.backend import device_roundtrip_ms
 
-        import jax
-
-        x = np.zeros(256, np.int32)
-        ts = []
-        try:
-            for _ in range(3):
-                t0 = time.perf_counter()
-                np.asarray(jax.device_put(x))
-                ts.append(time.perf_counter() - t0)
-            _DEVICE_RTT_MS = sorted(ts)[1] * 1e3
-        except Exception:
-            _DEVICE_RTT_MS = float("inf")
-    return _DEVICE_RTT_MS
+    return device_roundtrip_ms()
 
 
 def _default_device_parse() -> bool:
@@ -532,16 +524,21 @@ def _unmapped_hash32(b: RecordBatch, mask: np.ndarray) -> np.ndarray:
     """Host murmur3 hash column for a split's unmapped rows (others 0).
 
     Matches :func:`spec.bam.soa_keys`: the hash covers the record body past
-    the 32 fixed bytes, seed 0, truncated to a signed int32.
+    the 32 fixed bytes, seed 0, truncated to a signed int32.  All unmapped
+    rows hash in one vectorized pass (``murmurhash3_int32_batch`` over the
+    sliced offsets + a length column) — the per-record Python loop this
+    replaces was O(records) interpreter work on the sort's hot path.
     """
-    from .utils.murmur3 import murmurhash3_int32
+    from .utils.murmur3 import murmurhash3_int32_batch
 
     h = np.zeros(len(mask), dtype=np.int32)
-    off = b.soa["rec_off"]
-    ln = b.soa["rec_len"]
-    for i in np.nonzero(mask)[0]:
-        blob = b.data[int(off[i]) + 32 : int(off[i]) + int(ln[i])].tobytes()
-        h[i] = murmurhash3_int32(blob, 0)
+    rows = np.nonzero(mask)[0]
+    if len(rows):
+        off = np.asarray(b.soa["rec_off"], dtype=np.int64)[rows] + 32
+        ln = np.maximum(
+            np.asarray(b.soa["rec_len"], dtype=np.int64)[rows] - 32, 0
+        )
+        h[rows] = murmurhash3_int32_batch(b.data, off, ln, 0)
     return h
 
 

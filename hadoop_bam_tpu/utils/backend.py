@@ -24,7 +24,38 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-from typing import Optional
+from typing import Optional, Tuple
+
+_DEVICE_RTT_MS: Optional[float] = None
+
+
+def device_roundtrip_ms() -> float:
+    """Median small-transfer host↔device round trip (cached per process).
+
+    Local PCIe/ICI chips answer in well under a millisecond; a tunneled
+    remote chip (the dev topology here) costs tens of milliseconds per
+    RPC, which changes which codec/parse tiers win — both the
+    device-resident parse (pipeline._default_device_parse) and the
+    lockstep-lane inflate tier (ops.flate.lanes_tier_enabled) gate on it.
+    """
+    global _DEVICE_RTT_MS
+    if _DEVICE_RTT_MS is None:
+        import time
+
+        import jax
+        import numpy as np
+
+        x = np.zeros(256, np.int32)
+        ts = []
+        try:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(jax.device_put(x))
+                ts.append(time.perf_counter() - t0)
+            _DEVICE_RTT_MS = sorted(ts)[1] * 1e3
+        except Exception:
+            _DEVICE_RTT_MS = float("inf")
+    return _DEVICE_RTT_MS
 
 
 def backend_initialized() -> bool:
@@ -88,13 +119,28 @@ def force_cpu(n_devices: Optional[int] = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def probe_platform(timeout_s: float = 300.0) -> Optional[str]:
-    """Default-platform discovery in a watchdogged subprocess.
+def _stderr_tail(stderr, n: int = 5) -> str:
+    """Last ``n`` non-empty stderr lines, joined — the diagnosable part of
+    a failed/wedged probe subprocess."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    lines = [ln for ln in (stderr or "").strip().splitlines() if ln.strip()]
+    return " | ".join(lines[-n:])
 
-    Returns the platform string (e.g. ``"tpu"``/``"cpu"``) of
-    ``jax.devices()[0]`` under the *ambient* configuration, or ``None`` if
-    initialization failed or timed out (wedged plugin).  The subprocess is
-    killed on timeout, so the caller never hangs.
+
+def probe_platform_ex(
+    timeout_s: float = 300.0, retries: int = 1
+) -> Tuple[Optional[str], Optional[str]]:
+    """Default-platform discovery with failure diagnostics.
+
+    Like :func:`probe_platform`, but returns ``(platform, error)``:
+    ``platform`` is ``jax.devices()[0].platform`` under the *ambient*
+    configuration (or ``None``), and ``error`` carries the probe
+    subprocess's stderr tail so a fallback is diagnosable instead of a
+    bare timeout string (BENCH r4/r5 showed two consecutive opaque CPU
+    fallbacks).  A failed or timed-out probe is retried up to ``retries``
+    times, each in a *fresh* subprocess — a transiently wedged plugin or
+    tunnel gets one more chance before the caller tiers down.
     """
     code = (
         "import jax\n"
@@ -104,19 +150,46 @@ def probe_platform(timeout_s: float = 300.0) -> Optional[str]:
     env = dict(os.environ)
     # Probe the *default* stack: drop any CPU forcing we may have added.
     env.pop("JAX_PLATFORMS", None)
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
+    last_err: Optional[str] = None
+    for attempt in range(retries + 1):
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+            )
+        except subprocess.TimeoutExpired as e:
+            tail = _stderr_tail(e.stderr)
+            last_err = (
+                f"probe attempt {attempt + 1} timed out after "
+                f"{timeout_s:.0f}s" + (f"; stderr: {tail}" if tail else "")
+            )
+            continue
+        if res.returncode != 0:
+            tail = _stderr_tail(res.stderr)
+            last_err = (
+                f"probe attempt {attempt + 1} exited rc={res.returncode}"
+                + (f"; stderr: {tail}" if tail else "")
+            )
+            continue
+        for line in res.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip(), None
+        last_err = (
+            f"probe attempt {attempt + 1} produced no PLATFORM line"
         )
-    except subprocess.TimeoutExpired:
-        return None
-    if res.returncode != 0:
-        return None
-    for line in res.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].strip()
-    return None
+    return None, last_err
+
+
+def probe_platform(timeout_s: float = 300.0) -> Optional[str]:
+    """Default-platform discovery in a watchdogged subprocess.
+
+    Returns the platform string (e.g. ``"tpu"``/``"cpu"``) of
+    ``jax.devices()[0]`` under the *ambient* configuration, or ``None`` if
+    initialization failed or timed out (wedged plugin).  The subprocess is
+    killed on timeout, so the caller never hangs.  See
+    :func:`probe_platform_ex` for the retrying variant with diagnostics.
+    """
+    return probe_platform_ex(timeout_s, retries=0)[0]

@@ -91,3 +91,26 @@ def test_bench_emits_json_even_when_probe_fails():
     assert rec["value"] > 0
     assert rec["platform"] == "cpu"
     assert "error" in rec
+
+
+def test_probe_platform_ex_reports_stderr_tail():
+    # A probe that dies must surface the subprocess's stderr tail, not a
+    # bare timeout string (BENCH r4/r5 opaque-fallback regression).
+    env_backup = os.environ.get("JAX_PLATFORMS")
+    plat, err = backend.probe_platform_ex(timeout_s=0.05, retries=1)
+    assert plat is None
+    assert err is not None and "attempt 2" in err  # the retry happened
+    assert os.environ.get("JAX_PLATFORMS") == env_backup  # env untouched
+
+
+def test_stderr_tail_formats():
+    assert backend._stderr_tail(None) == ""
+    assert backend._stderr_tail(b"a\nb\nc\n") == "a | b | c"
+    tail = backend._stderr_tail("\n".join(f"l{i}" for i in range(10)))
+    assert tail.startswith("l5") and tail.endswith("l9")
+
+
+def test_device_roundtrip_ms_cached_and_finite_on_cpu():
+    ms = backend.device_roundtrip_ms()
+    assert ms == backend.device_roundtrip_ms()  # cached
+    assert ms >= 0.0
