@@ -186,6 +186,25 @@ def _measure(platform: str) -> dict:
             out["device_inflate_ns_per_wave"] = round(r["ns_per_wave"], 1)
         except Exception as e:
             out["device_inflate_error"] = str(e)[:120]
+        # Secondary diagnostic: the lockstep-lane DEFLATE *encoder* —
+        # marginal-cost throughput of the match kernel (RTT-free, same
+        # two-point protocol) plus its compression ratio vs zlib level-1
+        # on a BAM-like corpus, so coding-efficiency regressions are
+        # visible per round next to the raw engine pace.
+        try:
+            from hadoop_bam_tpu.ops.pallas.deflate_lanes import (
+                bench_deflate_marginal,
+                bench_deflate_ratio,
+            )
+
+            r = bench_deflate_marginal()
+            out["device_deflate_MBps"] = round(r["projected_mb_s"], 1)
+            out["device_deflate_ns_per_wave"] = round(r["ns_per_wave"], 1)
+            rr = bench_deflate_ratio()
+            out["device_deflate_ratio"] = round(rr["device_ratio"], 4)
+            out["device_deflate_vs_zlib1"] = round(rr["rel_zlib1"], 3)
+        except Exception as e:
+            out["device_deflate_error"] = str(e)[:120]
     return out
 
 

@@ -48,6 +48,11 @@ BACKEND = "hadoopbam.backend"
 # forces it on, "false" off; unset defers to the local-latency auto rule
 # (on for real, local accelerators — see ops.flate.lanes_tier_enabled).
 INFLATE_LANES = "hadoopbam.inflate.lanes"
+# Lockstep-lane Pallas deflate tier (ops/pallas/deflate_lanes.py): the
+# LZ77 match-finding device encoder behind bgzf_compress_device and the
+# part-write path.  Same semantics: "true"/"false" force, unset defers to
+# the local-latency auto rule (ops.flate.deflate_lanes_tier_enabled).
+DEFLATE_LANES = "hadoopbam.deflate.lanes"
 
 _TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
 _FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))

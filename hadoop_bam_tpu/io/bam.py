@@ -763,17 +763,50 @@ def write_part_fast(
     splitting_bai_stream=None,
     granularity: int = indices.DEFAULT_GRANULARITY,
     threads: Optional[int] = None,
+    device_deflate: Optional[bool] = None,
+    conf: Optional[Configuration] = None,
 ) -> int:
     """Write a headerless, terminator-less part from a batch in one shot:
-    vectorized record gather + batched native deflate.  Per-record virtual
+    vectorized record gather + batched deflate.  Per-record virtual
     offsets for the inline `.splitting-bai` are reconstructed analytically
-    from the deterministic blocking (payload cut every MAX_PAYLOAD bytes),
-    so no per-record Python loop runs.  Returns bytes written."""
+    from the deterministic blocking (payload cut every ``block_payload``
+    bytes), so no per-record Python loop runs.  Returns bytes written.
+
+    ``device_deflate`` routes the deflate through the lockstep-lane Pallas
+    encoder (``ops.flate.deflate_blocks_device``): the host gathers the
+    permuted records and does gzip framing + CRC32, the LZ77 match-find
+    and Huffman emit run on chip.  Default: the ``hadoopbam.deflate.lanes``
+    conf key / ``HBAM_DEFLATE_LANES`` env / local-latency auto rule
+    (``ops.flate.deflate_lanes_tier_enabled``).  A device failure falls
+    back to the threaded native zlib tier for the whole part."""
     payload = gather_record_bytes(batch, order)
+    if device_deflate is None:
+        from ..ops.flate import deflate_lanes_tier_enabled
+
+        device_deflate = deflate_lanes_tier_enabled(conf)
     # Explicit block size: the analytic voffset math below depends on it.
-    blob = native.deflate_blocks(
-        payload, level=level, threads=threads, block_payload=bgzf.MAX_PAYLOAD
-    )
+    blob = None
+    block_payload = bgzf.MAX_PAYLOAD
+    if device_deflate:
+        from ..ops import flate as _flate
+
+        try:
+            blob = _flate.deflate_blocks_device(
+                payload,
+                level=level,
+                block_payload=_flate.DEV_LZ_PAYLOAD,
+                use_lanes=True,
+            )
+            block_payload = _flate.DEV_LZ_PAYLOAD
+        except Exception:
+            METRICS.count("bam.device_deflate_fallback", 1)
+            blob = None
+            block_payload = bgzf.MAX_PAYLOAD
+    if blob is None:
+        blob = native.deflate_blocks(
+            payload, level=level, threads=threads,
+            block_payload=block_payload,
+        )
     stream.write(blob)
     if splitting_bai_stream is not None:
         ln = batch.soa["rec_len"].astype(np.int64) + 4
@@ -781,8 +814,8 @@ def write_part_fast(
             ln = ln[order]
         logical = np.cumsum(ln) - ln  # stream offset of each record
         co, _, _ = native.scan_blocks(blob)
-        bi = logical // bgzf.MAX_PAYLOAD
-        voffs = (co[bi] << 16) | (logical % bgzf.MAX_PAYLOAD)
+        bi = logical // block_payload
+        voffs = (co[bi] << 16) | (logical % block_payload)
         b = indices.SplittingBaiBuilder(granularity)
         n = len(voffs)
         pick = np.zeros(n, dtype=bool)

@@ -8,12 +8,23 @@ of BGZF members is decoded *in parallel as one array program* instead of
 bit-serially.
 
 Deflate (compress), device side
-    Literal-only fixed-Huffman DEFLATE (btype=01).  Every input byte maps
-    to an 8- or 9-bit code independently, so the whole emit is a prefix
-    sum over code lengths plus nine masked bit-scatters — embarrassingly
-    parallel, MXU-free but VPU/HBM friendly.  "Fixed Huffman is enough
-    for validity" (SURVEY.md §7 stage 6); ratio is traded for the ability
-    to compress on-device with zero host CPU in the loop.
+    Two tiers.  The top tier is the lockstep-lane Pallas **encoder**
+    (ops/pallas/deflate_lanes.py): up to 128 members in the 128 vector
+    lanes, each running a greedy hash-head LZ77 match-finder (4-byte
+    hash, two-generation probe chain, min match 4) whose token stream is
+    then bit-packed by the same gather-only emit trick as below — real
+    compression, within ~1.05x of zlib level-1 on BAM-class data, wired
+    into the part-write path (``deflate_blocks_device`` /
+    ``io.bam.write_part_fast``) behind ``hadoopbam.deflate.lanes`` /
+    ``HBAM_DEFLATE_LANES`` / the local-latency auto rule.
+
+    The floor tier is literal-only fixed-Huffman DEFLATE (btype=01):
+    every input byte maps to an 8- or 9-bit code independently, so the
+    whole emit is a prefix sum over code lengths plus a per-output-bit
+    searchsorted — embarrassingly parallel, MXU-free but VPU/HBM
+    friendly.  "Fixed Huffman is enough for validity" (SURVEY.md §7
+    stage 6); ratio is traded for zero serial device work.  ``level=0``
+    bypasses both and emits stored blocks (uncompressed parts).
 
 Inflate (decompress), device side
     DEFLATE decode looks inherently bit-serial (each Huffman codeword's
@@ -189,6 +200,14 @@ DEV_MAX_PAYLOAD = 0xDF00  # 57088 → ≤ 64252-byte block, < 0x10000
 # matches, so smaller blocks cost only the ~26-byte header per block
 # (~0.1% at this size), not compression ratio.
 DEV_DEFAULT_PAYLOAD = 24000
+
+# Member payload for the lockstep-lane LZ77 encoder tier
+# (ops/pallas/deflate_lanes.py): the whole member doubles as the match
+# window and must ride VMEM next to the per-lane hash tables and token
+# columns, so members are smaller than the literal-only tier's.  Extra
+# framing cost is ~26 header bytes per 4 KiB (~0.6%); the match window it
+# buys recovers far more on BAM-class data.
+DEV_LZ_PAYLOAD = 4096
 
 # XLA:TPU gathers mis-index when a single launch exceeds 2^24 elements
 # (observed empirically: B*NB == 2^24 exact, 2^24+… corrupt — consistent
@@ -1014,20 +1033,34 @@ def lanes_tier_enabled(conf=None) -> bool:
 
         if INFLATE_LANES in conf:
             return conf.get_boolean(INFLATE_LANES)
-    try:
-        from ..utils.backend import backend_initialized, device_roundtrip_ms
+    from ..utils.backend import local_tpu_ready
 
-        # The auto rule never *initializes* the backend (a wedged TPU
-        # plugin can hang on first touch, and split reads must not): it
-        # fires only in processes where the device pipeline already
-        # brought JAX up.
-        if not backend_initialized():
-            return False
-        if jax.devices()[0].platform != "tpu":
-            return False
-        return device_roundtrip_ms() < 5.0
-    except Exception:
-        return False
+    return local_tpu_ready()
+
+
+def deflate_lanes_tier_enabled(conf=None) -> bool:
+    """Should BGZF deflate route through the lockstep-lane LZ77 encoder?
+
+    The write-side mirror of :func:`lanes_tier_enabled`: resolution order
+    is the ``HBAM_DEFLATE_LANES`` env var (0/1 force) → the
+    ``hadoopbam.deflate.lanes`` conf key → the shared local-latency auto
+    rule (``utils.backend.local_tpu_ready``: a real TPU with a < 5 ms
+    round trip).  On a CPU backend the match kernel runs in (slow)
+    interpret mode and on a tunneled remote chip the per-part transfers
+    pay latency the threaded native zlib does not — both lose, so the
+    auto rule declines.
+    """
+    env = os.environ.get("HBAM_DEFLATE_LANES")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    if conf is not None:
+        from ..conf import DEFLATE_LANES
+
+        if DEFLATE_LANES in conf:
+            return conf.get_boolean(DEFLATE_LANES)
+    from ..utils.backend import local_tpu_ready
+
+    return local_tpu_ready()
 
 
 def _lanes_decode_members(
@@ -1139,41 +1172,13 @@ def _pow2_at_least(n: int, lo: int) -> int:
     return v
 
 
-def bgzf_compress_device(
-    data,
-    block_payload: int = DEV_DEFAULT_PAYLOAD,
-    append_terminator: bool = True,
-) -> bytes:
-    """Compress a byte stream into BGZF using the device deflate kernel.
-
-    Framing (gzip headers, CRC32, ISIZE) is host-side numpy/zlib; the
-    Huffman emit runs on device for all blocks at once."""
-    a = np.frombuffer(data, dtype=np.uint8) if not isinstance(
-        data, np.ndarray
-    ) else data
-    if block_payload > DEV_MAX_PAYLOAD:
-        raise bgzf.BgzfError(
-            f"device codec payload cap is {DEV_MAX_PAYLOAD}, "
-            f"got {block_payload}"
-        )
-    n = len(a)
-    nblk = max(1, -(-n // block_payload))
-    lens = np.full(nblk, block_payload, dtype=np.int32)
-    if n:
-        lens[-1] = n - (nblk - 1) * block_payload
-    else:
-        lens[0] = 0
-    P = max(int(lens.max()), 1)
-    pad_n = nblk * P
-    mat = np.zeros(pad_n, dtype=np.uint8)
-    if n == nblk * P:  # full rows: one reshape, no copy loop
-        mat[:] = a
-    else:
-        mat[: (nblk - 1) * P] = a[: (nblk - 1) * P]
-        mat[(nblk - 1) * P : (nblk - 1) * P + int(lens[-1])] = a[
-            (nblk - 1) * P :
-        ]
-    mat = mat.reshape(nblk, P)
+def _deflate_fixed_rows(
+    mat: np.ndarray, lens: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Literal-only fixed-Huffman emit over padded member rows (the XLA
+    :func:`deflate_fixed` kernel, launch-chunked).  Returns (comp rows,
+    clens)."""
+    nblk, P = mat.shape
     out_bytes = (3 + 9 * P + 7 + 7) // 8 + 1
     step = max(1, _MAX_LAUNCH_ELEMS // (out_bytes * 8))
     comp_rows: List[np.ndarray] = []
@@ -1186,24 +1191,167 @@ def bgzf_compress_device(
         )
         comp_rows.append(np.asarray(c))
         clen_rows.append(np.asarray(cl))
-    comp = np.concatenate(comp_rows)
-    clens = np.concatenate(clen_rows)
-    parts: List[bytes] = []
-    for i in range(nblk):
-        cdata = comp[i, : clens[i]].tobytes()
-        bsize = len(cdata) + 12 + 6 + 8
-        header = bgzf.MAGIC + struct.pack(
-            "<IBBHBBHH", 0, 0, 0xFF, 6, 0x42, 0x43, 2, bsize - 1
+    return np.concatenate(comp_rows), np.concatenate(clen_rows)
+
+
+def _host_raw_deflate(payload: np.ndarray, level: int) -> bytes:
+    """One member's payload through host zlib as a raw DEFLATE stream —
+    the per-member tier-down target when the lanes encoder declines."""
+    co = zlib.compressobj(max(1, min(level, 9)), zlib.DEFLATED, -15)
+    return co.compress(payload.tobytes()) + co.flush()
+
+
+def bgzf_compress_device(
+    data,
+    block_payload: Optional[int] = None,
+    append_terminator: bool = True,
+    level: int = 1,
+    conf=None,
+    use_lanes: Optional[bool] = None,
+) -> bytes:
+    """Compress a byte stream into BGZF using the device deflate tiers.
+
+    Framing (gzip headers, CRC32, ISIZE) is host-side; the DEFLATE emit
+    runs on device for all blocks at once.  Tiers, top to bottom:
+
+    1. ``level == 0``: stored members (one final stored block per
+       member) — no device work, bit-faithful to "uncompressed parts".
+    2. **Lockstep-lane LZ77 encoder** (ops/pallas/deflate_lanes.py), when
+       ``use_lanes`` is True or the :func:`deflate_lanes_tier_enabled`
+       gate fires: real match-finding compression; members the kernel
+       declines (geometry past the VMEM budget) tier down per member to
+       host zlib at ``level``.
+    3. **Literal-only fixed-Huffman** (:func:`deflate_fixed`): the
+       original XLA emit — valid DEFLATE, ratio traded for zero host CPU
+       and zero serial device work.
+
+    ``block_payload`` defaults per tier (``DEV_LZ_PAYLOAD`` for the lanes
+    encoder, ``DEV_DEFAULT_PAYLOAD`` otherwise); per-block CRC32 runs
+    over slices of the original contiguous input, and the stream is
+    assembled in one preallocated buffer."""
+    a = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    if use_lanes is None:
+        use_lanes = level != 0 and deflate_lanes_tier_enabled(conf)
+    if block_payload is None:
+        block_payload = DEV_LZ_PAYLOAD if use_lanes else DEV_DEFAULT_PAYLOAD
+    if block_payload > DEV_MAX_PAYLOAD:
+        raise bgzf.BgzfError(
+            f"device codec payload cap is {DEV_MAX_PAYLOAD}, "
+            f"got {block_payload}"
         )
-        footer = struct.pack(
-            "<II",
-            zlib.crc32(mat[i, : lens[i]]) & 0xFFFFFFFF,
-            int(lens[i]),
-        )
-        parts.append(header + cdata + footer)
+    n = len(a)
+    nblk = max(1, -(-n // block_payload))
+    lens = np.full(nblk, block_payload, dtype=np.int32)
+    if n:
+        lens[-1] = n - (nblk - 1) * block_payload
+    else:
+        lens[0] = 0
+
+    comp: Optional[np.ndarray] = None  # padded rows (device tiers)
+    clens = np.zeros(nblk, dtype=np.int64)
+    overrides: dict = {}  # member index -> bytes (stored / host tiers)
+    if level == 0:
+        # Uncompressed parts: one final stored block per member (LEN/NLEN
+        # framing only; an empty member is the 5-byte empty stored block).
+        for i in range(nblk):
+            s = i * block_payload
+            ln = int(lens[i])
+            overrides[i] = (
+                b"\x01"
+                + struct.pack("<HH", ln, ln ^ 0xFFFF)
+                + a[s : s + ln].tobytes()
+            )
+            clens[i] = 5 + ln
+    else:
+        P = max(int(lens.max()), 1)
+        mat = np.zeros((nblk, P), dtype=np.uint8)
+        for i in range(nblk):
+            s = i * block_payload
+            mat[i, : lens[i]] = a[s : s + lens[i]]
+        done = False
+        if use_lanes:
+            from ..utils.tracing import METRICS
+            from .pallas.deflate_lanes import deflate_lanes
+
+            try:
+                comp, cl, ok = deflate_lanes(mat, lens)
+            except Exception:
+                METRICS.count("flate.deflate_lanes_launch_error", 1)
+                ok = np.zeros(nblk, dtype=bool)
+            if ok.any():
+                clens[:] = cl
+                done = True
+            n_down = int((~ok).sum())
+            if n_down:
+                METRICS.count("flate.deflate_lanes_tierdown", n_down)
+                for i in np.nonzero(~ok)[0]:
+                    overrides[int(i)] = _host_raw_deflate(
+                        mat[i, : lens[i]], level
+                    )
+                    clens[int(i)] = len(overrides[int(i)])
+                done = True
+        if not done:
+            comp, cl = _deflate_fixed_rows(mat, lens)
+            clens[:] = cl
+
+    # ---- framing: one preallocated pass, CRC over the input itself -----
+    total = int((18 + 8) * nblk + clens.sum())
     if append_terminator:
-        parts.append(bgzf.TERMINATOR)
-    return b"".join(parts)
+        total += len(bgzf.TERMINATOR)
+    buf = bytearray(total)
+    pos = 0
+    off_in = 0
+    for i in range(nblk):
+        c = int(clens[i])
+        ln = int(lens[i])
+        bsize = c + 12 + 6 + 8
+        buf[pos : pos + 4] = bgzf.MAGIC
+        struct.pack_into(
+            "<IBBHBBHH", buf, pos + 4, 0, 0, 0xFF, 6, 0x42, 0x43, 2,
+            bsize - 1,
+        )
+        pos += 18
+        od = overrides.get(i)
+        if od is not None:
+            buf[pos : pos + c] = od
+        else:
+            buf[pos : pos + c] = memoryview(comp[i, :c])
+        pos += c
+        struct.pack_into(
+            "<II", buf, pos,
+            zlib.crc32(a[off_in : off_in + ln]) & 0xFFFFFFFF, ln,
+        )
+        pos += 8
+        off_in += ln
+    if append_terminator:
+        buf[pos:] = bgzf.TERMINATOR
+    return bytes(buf)
+
+
+def deflate_blocks_device(
+    payload,
+    level: int = 1,
+    block_payload: Optional[int] = None,
+    conf=None,
+    use_lanes: Optional[bool] = None,
+) -> bytes:
+    """Device-tier drop-in for :func:`native.deflate_blocks` (no
+    terminator appended): the part-write surface of the lockstep-lane
+    encoder.  The caller gathers the sorted records; the LZ77 match-find
+    and Huffman emit run on chip; the host does only gzip framing +
+    CRC32.  Blocking is deterministic (payload cut every
+    ``block_payload`` bytes), so ``write_part_fast``'s analytic
+    splitting-bai voffset math holds with the same ``block_payload``."""
+    return bgzf_compress_device(
+        payload,
+        block_payload=block_payload,
+        append_terminator=False,
+        level=level,
+        conf=conf,
+        use_lanes=use_lanes,
+    )
 
 
 def bgzf_decompress_device(

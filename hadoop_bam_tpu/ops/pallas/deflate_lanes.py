@@ -1,0 +1,542 @@
+"""Lockstep-lane Pallas DEFLATE *encoder*: LZ77 match-finding on chip.
+
+The symmetric counterpart to ops/pallas/inflate_lanes.py, and the removal
+of the last codec stage still host-bound (BENCH_NOTES standing ranking:
+part-write deflate ≈ 38% of host wall at the zlib level-1 ceiling).  Up to
+128 BGZF member payloads ride the 128 vector lanes of one kernel; each
+lane runs a greedy hash-table LZ77 match-finder over its own member, and
+the resulting token streams are bit-packed into fixed-Huffman DEFLATE by
+the same gather-only emit trick :func:`ops.flate.deflate_fixed` uses
+(token bit-lengths → cumsum offsets → per-output-bit searchsorted) —
+lifted from bytes to tokens.
+
+Architecture (probe/inflate register/VMEM-resident style — per-lane row
+selects are dense iota-compare column reductions, never gathers):
+
+- member payloads live TRANSPOSED in VMEM ([words, 128]: member j's words
+  go down lane j); "read 4 bytes at my cursor" is two one-hot row selects;
+- per-lane hash tables (4-byte hash heads, two generations for bounded
+  chain probes) live as [H, 128] columns; probe and insert are one-hot
+  row selects/updates;
+- match-finding is a state machine in lockstep waves: every wave each
+  live lane either (a) hashes the 4 bytes at its cursor, probes the two
+  head generations, and on a 32-bit match enters extend mode, else emits
+  one literal token; or (b) extends its current match word-at-a-time
+  (XOR + leading-equal-byte count) until mismatch / member end /
+  MAX_MATCH, then emits one copy token (min match 4, window = the whole
+  member — members are capped well inside DEFLATE's 32 KiB window);
+- tokens pack one per int32 ([T, 128] columns): literals as the byte
+  value, copies as ``(1<<30) | (len<<15) | dist``;
+- the fixed-Huffman bit pack runs as a plain XLA program on the token
+  columns (device-to-device — tokens never bounce through the host):
+  per-token LSB-first bit patterns (≤31 bits: length code + extra +
+  distance code + extra) → cumsum bit offsets → searchsorted per output
+  bit → byte pack, exactly the :func:`ops.flate.deflate_fixed` shape.
+
+Per-member ``[c_len, ok]`` meta comes back with the payload so a member
+whose geometry exceeds the VMEM budget (or an explicit ``max_clen``
+output budget) tiers down to the literal-only / host-zlib paths without
+dooming its launch.  Output is bit-exact decodable by native zlib and by
+``inflate_lanes`` (fixed-Huffman blocks, in-window distances).
+
+Oracle: zlib via tests/test_deflate_lanes.py; tests run the kernel in
+interpret mode on CPU and cross-check through ``zlib.decompressobj`` and
+the lanes decoder byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..flate import DIST_BASE, DIST_EXTRA, LEN_BASE, LEN_EXTRA
+
+LANES = 128
+
+MIN_MATCH = 4
+MAX_MATCH = 258
+
+#: Hard cap on member payload bytes: the copy-token dist field is 15 bits
+#: and the whole member doubles as the LZ77 window.
+_MAX_MEMBER = 1 << 15
+
+#: Hash-table rows per generation (two generations = bounded chain probes).
+_HASH_ROWS = 2048
+
+#: VMEM budget for one launch (streams + heads + token columns, double
+#: counted for while-loop carry ping-pong).  Members whose geometry
+#: exceeds it come back ok=False and tier down to the literal/host paths.
+_VMEM_BUDGET_BYTES = 10 << 20
+
+
+def _geometry(P: int) -> Tuple[int, int, int, int]:
+    """(W stream words, H hash rows, TOK token rows, T_WAVES) for a pow2
+    member capacity ``P``."""
+    W = P // 4 + 8
+    H = min(_HASH_ROWS, P)
+    TOK = P
+    T_WAVES = P + 8
+    return W, H, TOK, T_WAVES
+
+
+def _vmem_bytes(P: int) -> int:
+    W, H, TOK, _ = _geometry(P)
+    return (W + 2 * H + 2 * TOK + 64) * LANES * 4
+
+
+def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
+    """One lockstep LZ77 match-finding wave per loop step; every live lane
+    emits at most one token per wave, so the wave budget is bounded by the
+    member byte length (literals advance 1 byte/wave; a copy of length L
+    costs ≤ L waves end to end)."""
+    HB = H.bit_length() - 1
+
+    def kernel(streams_ref, plen_ref, tok_ref, ntok_ref, ok_ref):
+        rows_W = lax.broadcasted_iota(jnp.int32, (W, LANES), 0)
+        rows_H = lax.broadcasted_iota(jnp.int32, (H, LANES), 0)
+        rows_T = lax.broadcasted_iota(jnp.int32, (TOK, LANES), 0)
+        plen = plen_ref[:, :]
+
+        def word_at(widx):
+            onehot = rows_W == widx
+            return jnp.sum(
+                jnp.where(onehot, streams_ref[:, :], 0),
+                axis=0,
+                keepdims=True,
+            ).astype(jnp.uint32)
+
+        def bytes4_at(bpos):
+            """32 input bits at per-lane BYTE offset ``bpos`` [1,128]
+            (LE; out-of-range rows read as zero)."""
+            widx = bpos >> 2
+            sh = ((bpos & 3) * 8).astype(jnp.uint32)
+            w0 = word_at(widx)
+            w1 = word_at(widx + 1)
+            return jnp.where(sh == 0, w0, (w0 >> sh) | (w1 << (32 - sh)))
+
+        def body(st):
+            (it, cur, mode, mpos, mlen, ntok, toks, h1, h2, done) = st
+            active = ~done
+            extending = active & mode
+            scanning = active & ~mode
+
+            # Shared window read: scan lanes look at their cursor, extend
+            # lanes at the next 4 bytes past the match so far.
+            wa = bytes4_at(jnp.where(extending, cur + mlen, cur))
+
+            # ---- scan: 4-byte hash, two-generation probe, insert -------
+            canh = scanning & (cur + MIN_MATCH <= plen)
+            hsh = (
+                (wa * jnp.uint32(0x9E3779B1)) >> jnp.uint32(32 - HB)
+            ).astype(jnp.int32)
+            sel1 = jnp.sum(
+                jnp.where(rows_H == hsh, h1, 0), axis=0, keepdims=True
+            )
+            sel2 = jnp.sum(
+                jnp.where(rows_H == hsh, h2, 0), axis=0, keepdims=True
+            )
+            upd = (rows_H == hsh) & canh
+            h2 = jnp.where(upd, sel1, h2)  # age the previous head
+            h1 = jnp.where(upd, cur + 1, h1)  # pos+1; 0 = empty
+            c1 = sel1 - 1
+            c2 = sel2 - 1
+            wc1 = bytes4_at(c1)
+            wc2 = bytes4_at(c2)
+            m1 = canh & (c1 >= 0) & (wc1 == wa)
+            m2 = canh & (c2 >= 0) & (wc2 == wa)
+            mstart = m1 | m2
+            mp_new = jnp.where(m1, c1, c2)  # prefer the nearer candidate
+
+            # ---- extend: word-at-a-time leading-equal-byte count -------
+            wb = bytes4_at(jnp.where(extending, mpos + mlen, 0))
+            x = wa ^ wb
+            nm = jnp.where(
+                (x & 0xFF) != 0,
+                0,
+                jnp.where(
+                    (x & 0xFF00) != 0,
+                    1,
+                    jnp.where(
+                        (x & 0xFF0000) != 0,
+                        2,
+                        jnp.where((x >> 24) != 0, 3, 4),
+                    ),
+                ),
+            )
+            remaining = jnp.minimum(plen - (cur + mlen), MAX_MATCH - mlen)
+            add = jnp.maximum(jnp.minimum(nm, remaining), 0)
+            mlen2 = mlen + add
+            ext_done = extending & (add < 4)
+
+            # ---- token emit (at most one per lane per wave) ------------
+            emit_lit = scanning & ~mstart
+            lit = (wa & 0xFF).astype(jnp.int32)
+            cpy = (jnp.int32(1) << 30) | (mlen2 << 15) | (cur - mpos)
+            tv = jnp.where(ext_done, cpy, lit)
+            emit = emit_lit | ext_done
+            toks = jnp.where((rows_T == ntok) & emit, tv, toks)
+            ntok = ntok + emit.astype(jnp.int32)
+            cur = (
+                cur
+                + jnp.where(emit_lit, 1, 0)
+                + jnp.where(ext_done, mlen2, 0)
+            )
+            mode = jnp.where(mstart, True, jnp.where(ext_done, False, mode))
+            mpos = jnp.where(mstart, mp_new, mpos)
+            mlen = jnp.where(
+                mstart, MIN_MATCH, jnp.where(extending, mlen2, mlen)
+            )
+            done = done | (cur >= plen)
+            return (it + 1, cur, mode, mpos, mlen, ntok, toks, h1, h2, done)
+
+        def cond(st):
+            return (st[0] < T_WAVES) & jnp.any(~st[9])
+
+        zeros = jnp.zeros((1, LANES), jnp.int32)
+        (_, cur, _, _, _, ntok, toks, _, _, done) = lax.while_loop(
+            cond,
+            body,
+            (
+                jnp.int32(0),
+                zeros,
+                jnp.zeros((1, LANES), bool),
+                zeros,
+                zeros,
+                zeros,
+                jnp.zeros((TOK, LANES), jnp.int32),
+                jnp.zeros((H, LANES), jnp.int32),
+                jnp.zeros((H, LANES), jnp.int32),
+                plen <= 0,
+            ),
+        )
+        ok = done & (cur == plen)
+        tok_ref[:, :] = toks
+        ntok_ref[:, :] = ntok
+        ok_ref[:, :] = ok.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("w", "h", "tok", "t_waves", "interpret")
+)
+def _launch(streams, plens, w: int, h: int, tok: int, t_waves: int,
+            interpret: bool):
+    kernel = _kernel_factory(w, h, tok, t_waves)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(3)
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((tok, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+        ),
+        interpret=interpret,
+    )(streams, plens)
+
+
+# --------------------------------------------------------------------------
+# Token → fixed-Huffman bit pack: plain XLA, the deflate_fixed gather-only
+# emit lifted from bytes to tokens.  Runs on the kernel's token columns
+# device-to-device; no Pallas needed (it is embarrassingly parallel).
+# --------------------------------------------------------------------------
+
+
+def _rev_var(code, n, width: int):
+    """Bit-reverse the low ``width`` bits of ``code``, then keep the top
+    ``n`` of them: MSB-first Huffman codes → LSB-first stream patterns."""
+    r = jnp.zeros_like(code)
+    for k in range(width):
+        r = r | (((code >> k) & 1) << (width - 1 - k))
+    return r >> (width - n)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _emit_tokens_fixed(tokens: jax.Array, ntok: jax.Array, out_bytes: int):
+    """Pack token streams into final fixed-Huffman DEFLATE members.
+
+    ``tokens``: int32 [b, T] packed (lit: byte value; copy:
+    ``(1<<30)|(len<<15)|dist``), ``ntok``: int32 [b] live token counts
+    (the EOB is appended at index ntok, so T must be ≥ max(ntok)+1).
+    Returns (comp uint8 [b, out_bytes], clens int32 [b]).
+    """
+    b, T = tokens.shape
+    len_base = jnp.asarray(LEN_BASE)
+    len_extra = jnp.asarray(LEN_EXTRA)
+    dist_base = jnp.asarray(DIST_BASE)
+    dist_extra = jnp.asarray(DIST_EXTRA)
+
+    is_cpy = (tokens >> 30) & 1 == 1
+    v = tokens & 0xFF
+    L = (tokens >> 15) & 0x1FF
+    D = tokens & 0x7FFF
+    # Literal codeword (RFC 1951 §3.2.6).
+    lit_hi = v >= 144
+    lit_code = jnp.where(lit_hi, 0x190 + (v - 144), 0x30 + v)
+    lit_n = jnp.where(lit_hi, 9, 8)
+    pat_lit = _rev_var(lit_code, lit_n, 9)
+    # Copy: length code + extra, 5-bit distance code + extra.
+    li = jnp.clip(
+        jnp.searchsorted(len_base, L, side="right").astype(jnp.int32) - 1,
+        0,
+        28,
+    )
+    sym_l = 257 + li
+    len_code = jnp.where(sym_l <= 279, sym_l - 256, 0xC0 + (sym_l - 280))
+    len_n = jnp.where(sym_l <= 279, 7, 8)
+    e1 = len_extra[li]
+    ev1 = jnp.clip(L - len_base[li], 0, None)
+    di = jnp.clip(
+        jnp.searchsorted(dist_base, D, side="right").astype(jnp.int32) - 1,
+        0,
+        29,
+    )
+    e2 = dist_extra[di]
+    ev2 = jnp.clip(D - dist_base[di], 0, None)
+    pat_cpy = (
+        _rev_var(len_code, len_n, 8)
+        | (ev1 << len_n)
+        | (_rev_var(di, jnp.full_like(di, 5), 5) << (len_n + e1))
+        | (ev2 << (len_n + e1 + 5))
+    )
+    nbits_tok = jnp.where(is_cpy, len_n + e1 + 5 + e2, lit_n)
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    live = t < ntok[:, None]
+    eob = t == ntok[:, None]
+    nbits = jnp.where(live, nbits_tok, jnp.where(eob, 7, 0))
+    pattern = jnp.where(live, jnp.where(is_cpy, pat_cpy, pat_lit), 0)
+
+    cum = jnp.cumsum(nbits, axis=1)
+    ends = cum + 3  # 3 header bits (bfinal=1, btype=01)
+    off = ends - nbits
+    nbits_total = 3 + cum[:, -1]
+    NB = out_bytes * 8
+    j = jnp.arange(NB, dtype=jnp.int32)[None, :]
+    src = jax.vmap(functools.partial(jnp.searchsorted, side="right"))(
+        ends, jnp.broadcast_to(j, (b, NB))
+    ).astype(jnp.int32)
+    src_c = jnp.clip(src, 0, T - 1)
+    pat_j = jnp.take_along_axis(pattern, src_c, axis=1)
+    nb_j = jnp.take_along_axis(nbits, src_c, axis=1)
+    off_j = jnp.take_along_axis(off, src_c, axis=1)
+    k = j - off_j
+    in_code = (src < T) & (k >= 0) & (k < nb_j)
+    bit = jnp.where(in_code, (pat_j >> jnp.clip(k, 0, 31)) & 1, 0)
+    bit = jnp.where(j < 2, 1, bit).astype(jnp.uint8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
+    comp = (
+        (bit.reshape(b, out_bytes, 8) * weights[None, None, :])
+        .sum(axis=2)
+        .astype(jnp.uint8)
+    )
+    clens = (nbits_total + 7) // 8
+    return comp, clens
+
+
+def _out_bytes(P: int) -> int:
+    """Static output width: literals cost ≤9 bits/byte and copies strictly
+    less per covered byte, so the deflate_fixed bound holds for tokens."""
+    return (3 + 9 * P + 7 + 7) // 8 + 1
+
+
+def deflate_lanes(
+    payload: np.ndarray,
+    lens: np.ndarray,
+    max_clen: Optional[int] = None,
+    interpret=None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Batched lockstep LZ77 + fixed-Huffman DEFLATE of member payloads,
+    128 members per kernel launch.
+
+    ``payload`` uint8 [B, P] (rows zero-padded), ``lens`` int32 [B].
+    Returns ``(comp uint8 [B, out_bytes], clens int32 [B], ok bool [B])``
+    — every compressed row is a complete final DEFLATE member (header +
+    tokens + EOB) decodable by ``zlib.decompressobj(-15)`` and by
+    ``inflate_lanes``.  A member whose geometry exceeds the VMEM budget
+    or the 15-bit distance domain, or whose compressed size exceeds
+    ``max_clen``, comes back ``ok=False`` and the caller tiers down to
+    the literal-only / host-zlib encoders.
+    """
+    from ..flate import _MAX_LAUNCH_ELEMS, _pow2_at_least
+
+    B = payload.shape[0]
+    if B == 0:
+        return (
+            np.zeros((0, 0), np.uint8),
+            np.zeros(0, np.int32),
+            np.zeros(0, bool),
+        )
+    lens = np.asarray(lens, dtype=np.int32)
+    max_len = int(lens.max()) if len(lens) else 0
+    P = _pow2_at_least(max(max_len, 1), 256)
+    out_bytes = _out_bytes(P)
+    comp = np.zeros((B, out_bytes), dtype=np.uint8)
+    clens = np.zeros(B, dtype=np.int32)
+    ok_all = np.zeros(B, dtype=bool)
+    if P > _MAX_MEMBER or _vmem_bytes(P) > _VMEM_BUDGET_BYTES:
+        return comp, clens, ok_all
+    W, H, TOK, T_WAVES = _geometry(P)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    NB = out_bytes * 8
+    emit_step = max(1, _MAX_LAUNCH_ELEMS // NB)
+    for g0 in range(0, B, LANES):
+        g1 = min(B, g0 + LANES)
+        n = g1 - g0
+        # Transpose the group: member j's words go down lane j.
+        grp = np.zeros((W * 4, LANES), dtype=np.uint8)
+        grp[: payload.shape[1], :n] = payload[g0:g1].T
+        words = (
+            grp.reshape(W, 4, LANES).astype(np.uint32)
+            * (np.uint32(1) << (8 * np.arange(4, dtype=np.uint32)))[
+                None, :, None
+            ]
+        ).sum(axis=1).astype(np.uint32).view(np.int32)
+        plens = np.zeros((1, LANES), dtype=np.int32)
+        plens[0, :n] = lens[g0:g1]
+        toks, ntok, okk = _launch(
+            jnp.asarray(words), jnp.asarray(plens), W, H, TOK, T_WAVES,
+            bool(interpret),
+        )
+        # Device-side bit pack on the token columns (EOB column appended).
+        tok_bt = jnp.pad(jnp.transpose(toks), ((0, 0), (0, 1)))
+        ntok_vec = ntok[0]
+        for r0 in range(0, n, emit_step):
+            r1 = min(n, r0 + emit_step)
+            c, cl = _emit_tokens_fixed(
+                tok_bt[r0:r1], ntok_vec[r0:r1], out_bytes
+            )
+            comp[g0 + r0 : g0 + r1] = np.asarray(c)
+            clens[g0 + r0 : g0 + r1] = np.asarray(cl)
+        ok_all[g0:g1] = np.asarray(okk)[0, :n].astype(bool)
+    if max_clen is not None:
+        ok_all &= clens <= max_clen
+    return comp, clens, ok_all
+
+
+# --------------------------------------------------------------------------
+# Bench probes (bench.py reports these per round on TPU platforms).
+# --------------------------------------------------------------------------
+
+
+def bench_deflate_marginal(
+    p_small: int = 1024, p_big: int = 4096
+) -> dict:
+    """Marginal per-wave cost of the match kernel via a two-point fit.
+
+    Same RTT-free protocol as ``inflate_probe.bench_marginal``: one
+    geometry (sized for ``p_big``), two live member lengths — the wave
+    count tracks the member length on literal-dominated (random) data, so
+    the slope is the per-wave cost and the intercept absorbs launch/RTT.
+    Reports the literal-path floor (1 byte/lane/wave); matches only go
+    faster.  The XLA bit-pack stage is excluded (it is bandwidth-bound
+    and embarrassingly parallel, not the serial engine being probed).
+    """
+    import time
+
+    from ..flate import _pow2_at_least
+
+    P = _pow2_at_least(p_big, 256)
+    W, H, TOK, T_WAVES = _geometry(P)
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(
+        rng.integers(0, 1 << 31, (W, LANES), dtype=np.int32)
+    )
+
+    def timed(n_bytes: int) -> float:
+        plens = jnp.full((1, LANES), n_bytes, jnp.int32)
+        jax.block_until_ready(
+            _launch(words, plens, W, H, TOK, T_WAVES, False)
+        )
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                _launch(words, plens, W, H, TOK, T_WAVES, False)
+            )
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    dt_s = timed(p_small)
+    dt_b = timed(p_big)
+    per_wave = (dt_b - dt_s) / (p_big - p_small)
+    fixed = dt_s - per_wave * p_small
+    bytes_per_s = LANES / per_wave if per_wave > 0 else float("inf")
+    return {
+        "fixed_ms": fixed * 1e3,
+        "ns_per_wave": per_wave * 1e9,
+        "bytes_per_s": bytes_per_s,
+        "projected_mb_s": bytes_per_s / 1e6,
+        "t_small_ms": dt_s * 1e3,
+        "t_big_ms": dt_b * 1e3,
+    }
+
+
+def _bam_like_corpus(n_members: int, member: int) -> np.ndarray:
+    """Synthetic BAM-class member payloads: a fixed record template tiled
+    with per-record position/name bytes varying — the part-write encoder's
+    real workload shape (high local redundancy, short diverging fields)."""
+    rng = np.random.default_rng(11)
+    rec = bytearray(168)
+    rec[0:4] = (164).to_bytes(4, "little")
+    rec[12:36] = b"\x08\x00\x60\x12\x08\x00\x00\x00" * 3
+    rec[36:45] = b"read0000\x00"
+    rec[45:100] = bytes([7] * 55)
+    rec[100:168] = (b"ACGT" * 17)[:68]
+    n_rec = (n_members * member) // len(rec) + 1
+    stream = np.tile(np.frombuffer(bytes(rec), np.uint8), n_rec)
+    base = np.arange(n_rec, dtype=np.int64) * len(rec)
+    pos = rng.integers(0, 1 << 26, n_rec, dtype=np.int64)
+    for k in range(4):
+        stream[base + 4 + k] = ((pos >> (8 * k)) & 0xFF).astype(np.uint8)
+    idx = np.arange(n_rec, dtype=np.int64)
+    for k in range(4):
+        d = (idx >> (4 * k)) & 0xF
+        stream[base + 40 + k] = (48 + d).astype(np.uint8)
+    return stream[: n_members * member].reshape(n_members, member)
+
+
+def bench_deflate_ratio(
+    n_members: int = 32, member: int = 4096, interpret=None
+) -> dict:
+    """Compression ratio of the lanes encoder vs zlib level-1, same
+    BAM-like corpus, same member split — bench.py tracks the relative
+    ratio per round so coding-efficiency regressions are visible."""
+    import zlib
+
+    mat = _bam_like_corpus(n_members, member)
+    lens = np.full(n_members, member, dtype=np.int32)
+    comp, clens, ok = deflate_lanes(mat, lens, interpret=interpret)
+    n_ok = int(ok.sum())
+    dev_bytes = int(clens[ok].sum())
+    z_bytes = 0
+    orig = 0
+    for i in range(n_members):
+        if not ok[i]:
+            continue
+        co = zlib.compressobj(1, zlib.DEFLATED, -15)
+        z_bytes += len(co.compress(mat[i].tobytes()) + co.flush())
+        orig += member
+    device_ratio = dev_bytes / orig if orig else float("inf")
+    zlib1_ratio = z_bytes / orig if orig else float("inf")
+    return {
+        "device_ratio": device_ratio,
+        "zlib1_ratio": zlib1_ratio,
+        "rel_zlib1": device_ratio / zlib1_ratio if z_bytes else float("inf"),
+        "n_ok": n_ok,
+        "n_members": n_members,
+    }

@@ -130,7 +130,15 @@ def sort_bam(
     local-latency auto rule), the split reads feeding this mode upload
     *compressed* BGZF blocks and inflate them on-device
     (``io.bam.read_split`` → ``ops.flate.inflate_blocks_device``) — ≈4x
-    fewer h2d bytes than shipping the inflated stream."""
+    fewer h2d bytes than shipping the inflated stream.
+
+    The part writes have the symmetric device tier: when the lockstep-lane
+    *deflate* encoder is enabled (``hadoopbam.deflate.lanes`` conf key /
+    ``HBAM_DEFLATE_LANES`` env / the same local-latency auto rule), each
+    part's gathered record stream compresses on-chip
+    (``ops.pallas.deflate_lanes`` LZ77 + fixed-Huffman emit) and the host
+    does only gzip framing + CRC32 — displacing the ~38% of host wall the
+    level-1 zlib part writes cost on the 1-core bench host."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -162,6 +170,8 @@ def sort_bam(
         # split still overshoots).
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
         splits = fmt.get_splits(in_paths, split_size=split_size)
+        from .ops.flate import deflate_lanes_tier_enabled
+
         return _sort_bam_external(
             fmt,
             splits,
@@ -174,6 +184,7 @@ def sort_bam(
             max_attempts=max_attempts,
             part_dir=part_dir,
             write_workers=write_workers,
+            device_deflate=deflate_lanes_tier_enabled(conf),
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -337,7 +348,13 @@ def sort_bam(
     # part writes gather straight from the split payloads (no global
     # concatenation; on a 1-core host that copy dominated the pipeline).
     from .io.bam import write_part_fast
+    from .ops.flate import deflate_lanes_tier_enabled
 
+    # Part-write deflate tier, resolved once per job: the lockstep-lane
+    # Pallas encoder (LZ77 on chip, host does framing + CRC32) behind the
+    # ``hadoopbam.deflate.lanes`` conf key / ``HBAM_DEFLATE_LANES`` env /
+    # the same local-latency auto rule as the inflate tier.
+    use_device_deflate = deflate_lanes_tier_enabled(conf)
     merged = ChunkedRecords.from_batches(batches, with_keys=False)
     with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
         if part_dir is not None:
@@ -376,6 +393,7 @@ def sort_bam(
                         level=level,
                         splitting_bai_stream=sb_stream,
                         threads=deflate_threads,
+                        device_deflate=use_device_deflate,
                     )
             finally:
                 if sb_stream is not None:
@@ -693,6 +711,7 @@ def _sort_bam_external(
     max_attempts: int,
     part_dir: Optional[str],
     write_workers: Optional[int],
+    device_deflate: bool = False,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -833,6 +852,7 @@ def _sort_bam_external(
                         level=level,
                         splitting_bai_stream=sb_stream,
                         threads=deflate_threads,
+                        device_deflate=device_deflate,
                     )
             finally:
                 if sb_stream is not None:

@@ -58,6 +58,29 @@ def device_roundtrip_ms() -> float:
     return _DEVICE_RTT_MS
 
 
+def local_tpu_ready(max_rtt_ms: float = 5.0) -> bool:
+    """Shared auto rule for the device codec tiers: a real TPU whose
+    host↔device round trip is local-class.
+
+    Both lockstep-lane tiers (``ops.flate.lanes_tier_enabled`` for inflate,
+    ``ops.flate.deflate_lanes_tier_enabled`` for the part-write encoder)
+    and the device-resident parse gate on this same measurement, so one
+    probe decides the whole device pipeline.  Never *initializes* the
+    backend (a wedged TPU plugin can hang on first touch): it fires only
+    in processes where the device pipeline already brought JAX up.
+    """
+    try:
+        if not backend_initialized():
+            return False
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            return False
+        return device_roundtrip_ms() < max_rtt_ms
+    except Exception:
+        return False
+
+
 def backend_initialized() -> bool:
     """True if this process has already initialized any JAX backend.
 

@@ -32,6 +32,27 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-second perf/scale tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "device_deflate: needs a real accelerator for the device DEFLATE "
+        "encoder; skipped when JAX_PLATFORMS pins cpu",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip device-deflate accelerator tests cleanly when the environment
+    pins JAX to CPU (the tier-1 invocation runs under JAX_PLATFORMS=cpu):
+    their subprocess children would only rediscover the pin and fail
+    noisily instead of skipping."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    skip = pytest.mark.skip(
+        reason="JAX_PLATFORMS=cpu pins this run to CPU; device-deflate "
+        "TPU tests need a real accelerator"
+    )
+    for item in items:
+        if "device_deflate" in item.keywords:
+            item.add_marker(skip)
 
 
 REFERENCE_RESOURCES = pathlib.Path("/root/reference/src/test/resources")
