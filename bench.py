@@ -100,7 +100,8 @@ def synth_bam(path: str, n: int) -> None:
 
 
 def run_sort(
-    src: str, out: str, backend: str, device_parse=None
+    src: str, out: str, backend: str, device_parse=None,
+    mark_duplicates=False,
 ) -> float:
     """Returns wall seconds for a full sort with the given backend (the
     product pipeline end to end: plan → read → sort → parts → merge)."""
@@ -109,7 +110,7 @@ def run_sort(
     t0 = time.time()
     sort_bam(
         [src], out, split_size=SPLIT_SIZE, level=1, backend=backend,
-        device_parse=device_parse,
+        device_parse=device_parse, mark_duplicates=mark_duplicates,
     )
     return time.time() - t0
 
@@ -159,6 +160,21 @@ def _measure(platform: str) -> dict:
         "platform": platform,
         "n_records": N_RECORDS,
     }
+    # Secondary diagnostic: the dedup fusion stage's marginal cost —
+    # the same device sort with mark_duplicates=True (signature columns
+    # during the read, on-chip grouping, flag patching at write).
+    # markdup_reads_per_sec near the headline value means the fusion is
+    # close to free, which is the subsystem's whole thesis.  One warm-up
+    # run first: the decision program jit-compiles per padded shape, and
+    # the headline numbers are likewise measured warm.
+    try:
+        out_md = os.path.join(tmp, "sorted_markdup.bam")
+        run_sort(src, out_md, "device", mark_duplicates=True)
+        t_md = run_sort(src, out_md, "device", mark_duplicates=True)
+        out["markdup_reads_per_sec"] = round(N_RECORDS / t_md)
+        out["markdup_marginal_cost"] = round(t_md / t_device, 3)
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["markdup_error"] = str(e)[:120]
     if platform == "tpu":
         # Secondary diagnostic: the device-resident parse mode, forced on
         # regardless of the topology auto rule (on a remote tunnel its

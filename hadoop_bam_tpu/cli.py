@@ -120,13 +120,47 @@ def _cmd_sorted_header(args) -> int:
     return 0
 
 
-def _cmd_sort(args) -> int:
-    from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
+def _parse_size(text: str) -> int:
+    """'512m'/'2g'-style byte counts for --memory-budget (plain ints pass
+    through)."""
+    s = text.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30)):
+        if s.endswith(suffix):
+            s, mult = s[: -len(suffix)], m
+            break
+    try:
+        return int(s) * mult
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"invalid size {text!r} (expected bytes or k/m/g suffix)"
+        )
+
+
+def _cmd_sort(args, mark_duplicates: bool = False) -> int:
+    from .conf import (
+        BAM_MARK_DUPLICATES,
+        BAM_WRITE_SPLITTING_BAI,
+        DEFLATE_LANES,
+        INFLATE_LANES,
+        Configuration,
+    )
     from .pipeline import sort_bam
 
     conf = Configuration()
     if args.write_splitting_bai:
         conf.set_boolean(BAM_WRITE_SPLITTING_BAI, True)
+    # Device codec toggles: unset leaves the conf key absent, deferring to
+    # the HBAM_* env vars / local-latency auto rule (ops.flate gates).
+    if args.inflate_lanes is not None:
+        conf.set_boolean(INFLATE_LANES, args.inflate_lanes == "on")
+    if args.deflate_lanes is not None:
+        conf.set_boolean(DEFLATE_LANES, args.deflate_lanes == "on")
+    mark_duplicates = mark_duplicates or getattr(
+        args, "mark_duplicates", False
+    )
+    if mark_duplicates:
+        conf.set_boolean(BAM_MARK_DUPLICATES, True)
     mesh = None
     if args.devices:
         from .parallel.mesh import make_mesh
@@ -149,16 +183,25 @@ def _cmd_sort(args) -> int:
             mesh=mesh,
             level=args.level,
             write_splitting_bai=args.write_splitting_bai,
+            memory_budget=args.memory_budget,
         )
+    dup = (
+        f", {stats.n_duplicates} duplicates flagged" if mark_duplicates
+        else ""
+    )
     print(
         f"{args.output}: {stats.n_records} records from {stats.n_splits} "
-        f"splits via {stats.backend}"
+        f"splits via {stats.backend}{dup}"
     )
     if args.metrics:
         import json
 
         print(json.dumps(METRICS.report(), indent=2, sort_keys=True))
     return 0
+
+
+def _cmd_markdup(args) -> int:
+    return _cmd_sort(args, mark_duplicates=True)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -219,19 +262,48 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("out", nargs="?", default="-")
     s.set_defaults(func=_cmd_sorted_header)
 
+    def add_sort_args(s, markdup: bool) -> None:
+        s.add_argument("bam", nargs="+")
+        s.add_argument("-o", "--output", required=True)
+        s.add_argument("--split-size", type=int, default=32 << 20)
+        s.add_argument("--level", type=int, default=6)
+        s.add_argument("--devices", type=int, default=0,
+                       help="sort over an n-device mesh (0 = single device)")
+        s.add_argument("--write-splitting-bai", action="store_true")
+        s.add_argument(
+            "--memory-budget", type=_parse_size, default=None,
+            metavar="BYTES",
+            help="bounded-memory out-of-core sort: cap materialized record "
+                 "bytes (accepts k/m/g suffixes, e.g. 512m)")
+        s.add_argument(
+            "--inflate-lanes", choices=("on", "off"), default=None,
+            help="force the lockstep-lane device inflate tier "
+                 "(hadoopbam.inflate.lanes; default: auto rule)")
+        s.add_argument(
+            "--deflate-lanes", choices=("on", "off"), default=None,
+            help="force the lockstep-lane device deflate tier "
+                 "(hadoopbam.deflate.lanes; default: auto rule)")
+        if not markdup:
+            s.add_argument(
+                "--mark-duplicates", action="store_true",
+                help="fuse samtools-class duplicate marking into the sort "
+                     "(OR 0x400 into duplicates' flags at write time)")
+        s.add_argument("--metrics", action="store_true",
+                       help="print the span/counter report after the run")
+        s.add_argument("--trace-dir", default=None,
+                       help="capture a JAX profiler (XPlane) trace here")
+
     s = sub.add_parser("sort", help="coordinate-sort BAM file(s) end to end")
-    s.add_argument("bam", nargs="+")
-    s.add_argument("-o", "--output", required=True)
-    s.add_argument("--split-size", type=int, default=32 << 20)
-    s.add_argument("--level", type=int, default=6)
-    s.add_argument("--devices", type=int, default=0,
-                   help="sort over an n-device mesh (0 = single device)")
-    s.add_argument("--write-splitting-bai", action="store_true")
-    s.add_argument("--metrics", action="store_true",
-                   help="print the span/counter report after the run")
-    s.add_argument("--trace-dir", default=None,
-                   help="capture a JAX profiler (XPlane) trace here")
+    add_sort_args(s, markdup=False)
     s.set_defaults(func=_cmd_sort)
+
+    s = sub.add_parser(
+        "markdup",
+        help="coordinate-sort + mark PCR/optical duplicates (0x400) in "
+             "one fused pass (a no-op reorder for already-sorted input)",
+    )
+    add_sort_args(s, markdup=True)
+    s.set_defaults(func=_cmd_markdup)
 
     return p
 

@@ -20,6 +20,10 @@ BAM_ENABLE_BAI_SPLITTER = "hadoopbam.bam.enable-bai-splitter"
 BAM_INTERVALS = "hadoopbam.bam.intervals"
 BAM_TRAVERSE_UNPLACED_UNMAPPED = "hadoopbam.bam.traverse-unplaced-unmapped"
 BAM_WRITE_SPLITTING_BAI = "hadoopbam.bam.write-splitting-bai"
+# Fuse samtools-class duplicate marking into the coordinate sort (the
+# dedup/ subsystem): duplicates get FLAG_DUPLICATE (0x400) ORed into
+# their written flag bytes.  Equivalent to sort_bam(mark_duplicates=True).
+BAM_MARK_DUPLICATES = "hadoopbam.bam.mark-duplicates"
 ANYSAM_TRUST_EXTS = "hadoopbam.anysam.trust-exts"
 ANYSAM_OUTPUT_FORMAT = "hadoopbam.anysam.output-format"
 ANYSAM_WRITE_HEADER = "hadoopbam.anysam.write-header"

@@ -1,0 +1,58 @@
+"""Device-resident duplicate marking, fused into the sorted stream.
+
+Every production WGS pipeline runs duplicate marking straight after the
+coordinate sort (biobambam's whole reason to exist; Sam2bam shows the win
+of fusing such stages into one pass).  The sort already ships every
+record's fixed fields to the chip, so marking duplicates there is nearly
+free: this package adds the samtools-markdup-class decision as a fusion
+stage over the sort's SoA columns, and the write path ORs
+``FLAG_DUPLICATE`` (0x400) into the two flag bytes of each duplicate's
+gathered record just before deflate — the LazyBAMRecord stance holds (the
+sort never mutates the source payload bytes; only the per-part gather
+output is patched).
+
+Semantics (the single definition, shared bit-for-bit by the device path
+and the pure-NumPy/Python oracle in :mod:`.oracle`):
+
+- **Exempt** records are never marked and never participate: secondary
+  (0x100), supplementary (0x800), unmapped (0x4 — or refid/pos < 0).
+- Each participant's **end signature** is ``(refid, unclipped 5′, strand)``
+  where the unclipped 5′ coordinate is ``ops.cigar.unclipped_start`` for
+  forward reads and ``unclipped_end`` for reverse reads (clips restore the
+  pre-trimming fragment boundary, so differently-clipped copies of one
+  fragment collide).
+- **Pair collation** groups candidates (paired, mate mapped) by a 64-bit
+  murmur3 read-name hash; a name group of exactly two candidates is a
+  mated pair, anything else demotes to fragments.  Mates exchange end
+  signature and score along the collation order.
+- **Pairs** sharing both end signatures form a duplicate family; the pair
+  with the highest summed base quality (``ops.quality.sum_base_qualities``
+  over both mates; ties → earliest record) survives, every other pair has
+  both records marked.
+- **Fragments** (unpaired, mate-unmapped, or demoted) sharing an end
+  signature with any mated pair's end are all marked (pairs always beat
+  fragments); otherwise the best-scoring fragment survives its family.
+
+The decision itself runs on device (:mod:`.device`): three ``lax.sort``
+passes over int32 signature columns plus segmented scatter reductions —
+the same key-plumbing style as ``ops/keys.py``/``ops/sort.py``.  Ragged
+inputs (clip spans, qual sums, name hashes) are gathered host-side per
+split during the read, exactly like the unmapped-key ``hash32`` column
+(:mod:`.signature`).
+"""
+
+from .device import mark_duplicates_device
+from .oracle import mark_duplicates_oracle
+from .signature import (
+    DEDUP_EXTRA_FIELDS,
+    concat_columns,
+    signature_columns,
+)
+
+__all__ = [
+    "DEDUP_EXTRA_FIELDS",
+    "concat_columns",
+    "mark_duplicates_device",
+    "mark_duplicates_oracle",
+    "signature_columns",
+]

@@ -1,0 +1,176 @@
+"""The on-device duplicate-marking decision.
+
+Input: the fixed-width int32 signature columns of
+:func:`dedup.signature.signature_columns` for the whole job.  Output: a
+bool mask in original record order — True rows get ``FLAG_DUPLICATE``
+ORed into their written flag bytes.
+
+Everything is 32-bit (TPU-native lanes; no reliance on x64 mode) and
+every ordering is made total by appending the original index as the last
+sort key, so the result is deterministic and bit-identical to
+:func:`dedup.oracle.mark_duplicates_oracle` regardless of platform.
+
+Three passes, all ``lax.sort`` + segmented scatter reductions:
+
+1. **Collation** — sort pair candidates by the 64-bit name hash; a
+   segment of exactly two candidates is a mated pair and the two rows
+   exchange end signature, score, and index by neighbor shift.
+2. **Grouping** — sort everything by (own end signature, mated-first,
+   mate end signature).  Rows with equal (self, mate) signature pairs are
+   exactly the row-side views of duplicate pair families (both mates of a
+   family land in consistent groups, so both sides elect the same
+   winner); rows with equal self signature form the fragment families
+   and see, via a segmented max, whether any mated pair shares their end.
+3. **Election** — segmented lexicographic arg-max: pairs by summed pair
+   score, fragments by own score; fragments lose outright to any pair
+   sharing their end signature.  Ties break on record *content* (the
+   64-bit name hash, then the flag word) before falling back to the
+   original index, so the decision is independent of input order — and
+   therefore idempotent: re-marking a marked, sorted file elects the
+   same winners (``FLAG_DUPLICATE`` itself never enters the signature).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_I32MAX = np.int32(2**31 - 1)
+
+
+def _prev(a: jax.Array) -> jax.Array:
+    """Row i-1's value at row i (row 0 sees itself; callers force the
+    first boundary explicitly)."""
+    return jnp.concatenate([a[:1], a[:-1]])
+
+
+@jax.jit
+def _mark_core(
+    refid, pos5, rev, exempt, cand, score, qh1, qh2, flag
+):
+    n = refid.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    zeros = jnp.zeros(n, jnp.int32)
+    imax = jnp.full(n, _I32MAX, jnp.int32)
+
+    def elect(seg, member, score_col, tie_cols):
+        """True on each segment's winner rows: maximal ``score_col``,
+        ties resolved by successive minima over ``tie_cols``."""
+        best = zeros.at[seg].max(jnp.where(member, score_col, -1))[seg]
+        sel = member & (score_col == best)
+        for c in tie_cols:
+            m = imax.at[seg].min(jnp.where(sel, c, _I32MAX))[seg]
+            sel = sel & (c == m)
+        return sel
+
+    # ---- pass 1: name-hash collation of pair candidates ------------------
+    _, _, _, idxs = lax.sort(
+        (1 - cand, qh1, qh2, idx), num_keys=4
+    )
+    cands = cand[idxs]
+    qh1s, qh2s = qh1[idxs], qh2[idxs]
+    refids, pos5s, revs = refid[idxs], pos5[idxs], rev[idxs]
+    exempts, scores, flags = exempt[idxs], score[idxs], flag[idxs]
+    same = (
+        (cands & _prev(cands)).astype(bool)
+        & (qh1s == _prev(qh1s))
+        & (qh2s == _prev(qh2s))
+    )
+    same = same.at[0].set(False)
+    seg = jnp.cumsum(jnp.where(same, 0, 1)) - 1
+    size = zeros.at[seg].add(1)[seg]
+    mated = (cands == 1) & (size == 2)
+    # A 2-row segment's rows are adjacent: the mate is +1 from the first
+    # row, -1 from the second.
+    nb = jnp.clip(jnp.where(same, pos - 1, pos + 1), 0, n - 1)
+    m_refid = jnp.where(mated, refids[nb], 0)
+    m_pos5 = jnp.where(mated, pos5s[nb], 0)
+    m_rev = jnp.where(mated, revs[nb], 0)
+    pscore = jnp.where(mated, scores + scores[nb], 0)
+    pidx = jnp.where(mated, jnp.minimum(idxs, idxs[nb]), 0)
+    nmated = 1 - mated.astype(jnp.int32)
+
+    # ---- pass 2: signature grouping --------------------------------------
+    srt = lax.sort(
+        (
+            exempts, refids, pos5s, revs, nmated,
+            m_refid, m_pos5, m_rev, idxs, pos,
+        ),
+        num_keys=9,
+    )
+    p2 = srt[9]
+    refid3, pos53, rev3 = refids[p2], pos5s[p2], revs[p2]
+    ex3 = exempts[p2].astype(bool)
+    mated3 = mated[p2]
+    idx3, score3 = idxs[p2], scores[p2]
+    qh1_3, qh2_3, flag3 = qh1s[p2], qh2s[p2], flags[p2]
+    mr3, mp3, mv3 = m_refid[p2], m_pos5[p2], m_rev[p2]
+    pscore3, pidx3 = pscore[p2], pidx[p2]
+
+    ekey_same = (
+        (refid3 == _prev(refid3))
+        & (pos53 == _prev(pos53))
+        & (rev3 == _prev(rev3))
+    )
+    esame = (~ex3) & (~_prev(ex3)) & ekey_same
+    esame = esame.at[0].set(False)
+    eseg = jnp.cumsum(jnp.where(esame, 0, 1)) - 1
+
+    # ---- pass 3: elections -----------------------------------------------
+    any_pair = (
+        zeros.at[eseg].max(mated3.astype(jnp.int32))[eseg] > 0
+    )
+    frag3 = (~ex3) & (~mated3)
+    sel_f = elect(eseg, frag3, score3, (qh1_3, qh2_3, flag3, idx3))
+    frag_dup = frag3 & (any_pair | ~sel_f)
+
+    psame = (
+        mated3
+        & _prev(mated3)
+        & ekey_same
+        & (mr3 == _prev(mr3))
+        & (mp3 == _prev(mp3))
+        & (mv3 == _prev(mv3))
+    )
+    psame = psame.at[0].set(False)
+    pseg = jnp.cumsum(jnp.where(psame, 0, 1)) - 1
+    # Pair tie-break columns are all pair-level (the name hash is shared
+    # by both mates), so the two row-side groups of a family elect
+    # consistently.
+    sel_p = elect(pseg, mated3, pscore3, (qh1_3, qh2_3, pidx3))
+    pair_dup = mated3 & ~sel_p
+
+    return jnp.zeros(n, bool).at[idx3].set(frag_dup | pair_dup)
+
+
+def mark_duplicates_device(cols: Dict[str, np.ndarray]) -> np.ndarray:
+    """bool[N] duplicate mask (original record order) from the job-global
+    signature columns.  Rows are padded to the next power of two as
+    exempt records so only O(log N) program shapes ever compile."""
+    n = len(cols["refid"])
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    padded = 1 << max(3, int(np.ceil(np.log2(n))))
+
+    def pad(a, fill=0):
+        out = np.full(padded, fill, dtype=np.int32)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    dup = _mark_core(
+        pad(cols["refid"]),
+        pad(cols["pos5"]),
+        pad(cols["rev"]),
+        pad(cols["exempt"], fill=1),  # padding never participates
+        pad(cols["cand"]),
+        pad(cols["score"]),
+        pad(cols["qh1"]),
+        pad(cols["qh2"]),
+        pad(cols["flag"]),
+    )
+    return np.asarray(dup[:n])

@@ -1,0 +1,106 @@
+"""Host-side signature columns for duplicate marking.
+
+One call per decoded split while the read loop is still holding the
+batch's ragged sideband: everything ragged (CIGAR clip spans, qual sums,
+read-name hashes) reduces to fixed-width int32 columns here, so the
+global dedup decision downstream is pure device work over ~18 bytes per
+record no matter how large the records are.  The same stance as the
+unmapped-key ``hash32`` column in ``pipeline``: the host owns ragged
+gathers, the chip owns the dense phases.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..ops.cigar import clip_spans_np
+from ..ops.quality import sum_base_qualities_np
+from ..spec.bam import (
+    FLAG_MATE_UNMAPPED,
+    FLAG_PAIRED,
+    FLAG_REVERSE,
+    FLAG_SECONDARY,
+    FLAG_SUPPLEMENTARY,
+    FLAG_UNMAPPED,
+)
+from ..utils.murmur3 import murmurhash3_int32_batch
+
+#: SoA columns the dedup stage needs beyond ``io.bam.SORT_FIELDS``.
+DEDUP_EXTRA_FIELDS = ("l_read_name", "n_cigar_op", "l_seq")
+
+#: Second murmur3 seed for the read-name hash pair (seed 0 is the first);
+#: 64 collation bits total, so accidental name collisions are negligible.
+_QNAME_SEED2 = 0x9747B28C
+
+#: Scores are clamped so a pair sum can never overflow int32 on device.
+_SCORE_CAP = 1 << 30
+
+_EXEMPT_FLAGS = FLAG_SECONDARY | FLAG_SUPPLEMENTARY | FLAG_UNMAPPED
+
+
+def signature_columns(data: np.ndarray, soa: Dict) -> Dict[str, np.ndarray]:
+    """Fixed-width dedup columns for one decoded batch (original order).
+
+    Returns int32 arrays: ``refid``, ``pos5`` (orientation-aware unclipped
+    5′ coordinate), ``rev``, ``exempt``, ``cand`` (pair-collation
+    candidate), ``score``, ``qh1``/``qh2`` (64-bit read-name hash).
+    """
+    n = len(soa["rec_off"])
+    refid = soa["refid"].astype(np.int32)
+    pos = soa["pos"].astype(np.int64)
+    flag = soa["flag"].astype(np.int32)
+    rev = ((flag & FLAG_REVERSE) != 0).astype(np.int32)
+    exempt = (
+        ((flag & _EXEMPT_FLAGS) != 0) | (refid < 0) | (pos < 0)
+    ).astype(np.int32)
+    cand = (
+        (exempt == 0)
+        & ((flag & FLAG_PAIRED) != 0)
+        & ((flag & FLAG_MATE_UNMAPPED) == 0)
+    ).astype(np.int32)
+    lead, trail, span = clip_spans_np(data, soa)
+    pos5 = np.where(
+        rev.astype(bool),
+        pos + np.maximum(span, 1) - 1 + trail,  # unclipped_end
+        pos - lead,  # unclipped_start
+    ).astype(np.int32)
+    score = np.minimum(
+        sum_base_qualities_np(data, soa), _SCORE_CAP
+    ).astype(np.int32)
+    # Name hash over the qname bytes sans the trailing NUL.
+    name_off = soa["rec_off"].astype(np.int64) + 32
+    name_len = np.maximum(soa["l_read_name"].astype(np.int64) - 1, 0)
+    qh1 = murmurhash3_int32_batch(data, name_off, name_len, 0)
+    qh2 = murmurhash3_int32_batch(data, name_off, name_len, _QNAME_SEED2)
+    return {
+        "refid": refid,
+        "pos5": pos5,
+        "rev": rev,
+        "exempt": exempt,
+        "cand": cand,
+        "score": score,
+        "qh1": qh1,
+        "qh2": qh2,
+        "flag": flag,  # content tie-break column for the election
+    }
+
+
+def concat_columns(
+    parts: Sequence[Dict[str, np.ndarray]]
+) -> Dict[str, np.ndarray]:
+    """Concatenate per-split column dicts into the job-global columns."""
+    if not parts:
+        return {
+            k: np.empty(0, np.int32)
+            for k in (
+                "refid", "pos5", "rev", "exempt", "cand", "score",
+                "qh1", "qh2", "flag",
+            )
+        }
+    if len(parts) == 1:
+        return parts[0]
+    return {
+        k: np.concatenate([p[k] for p in parts]) for k in parts[0]
+    }

@@ -755,6 +755,24 @@ def gather_record_bytes(
     return gather_record_array(batch, order).tobytes()
 
 
+def patch_flags(
+    stream: np.ndarray, rec_starts: np.ndarray, bits: int = bam.FLAG_DUPLICATE
+) -> None:
+    """OR ``bits`` into the flag field of the records whose size words sit
+    at ``rec_starts`` in a gathered record stream (in place).
+
+    The flag is the little-endian u16 at body offset 14, i.e. bytes 18-19
+    past each record's block_size word.  This is the dedup write path: the
+    sorted gather output — never the source batch payload — is patched,
+    so the LazyBAMRecord stance (the sort pipeline does not mutate record
+    bytes it read) is preserved.
+    """
+    if len(rec_starts) == 0:
+        return
+    stream[rec_starts + 18] |= np.uint8(bits & 0xFF)
+    stream[rec_starts + 19] |= np.uint8((bits >> 8) & 0xFF)
+
+
 def write_part_fast(
     stream,
     batch: "RecordBatch",
@@ -765,6 +783,7 @@ def write_part_fast(
     threads: Optional[int] = None,
     device_deflate: Optional[bool] = None,
     conf: Optional[Configuration] = None,
+    dup_mask: Optional[np.ndarray] = None,
 ) -> int:
     """Write a headerless, terminator-less part from a batch in one shot:
     vectorized record gather + batched deflate.  Per-record virtual
@@ -778,8 +797,23 @@ def write_part_fast(
     and Huffman emit run on chip.  Default: the ``hadoopbam.deflate.lanes``
     conf key / ``HBAM_DEFLATE_LANES`` env / local-latency auto rule
     (``ops.flate.deflate_lanes_tier_enabled``).  A device failure falls
-    back to the threaded native zlib tier for the whole part."""
-    payload = gather_record_bytes(batch, order)
+    back to the threaded native zlib tier for the whole part.
+
+    ``dup_mask`` (bool per *batch row*, same index space as
+    ``soa['rec_off']``) marks rows whose written copy gets
+    ``FLAG_DUPLICATE`` ORed in via :func:`patch_flags` — the dedup
+    subsystem's flag-rewrite stage, applied to the gathered stream just
+    before deflate."""
+    payload = gather_record_array(batch, order)
+    if dup_mask is not None:
+        dm = dup_mask[order] if order is not None else dup_mask
+        if dm.any():
+            ln = batch.soa["rec_len"].astype(np.int64) + 4
+            if order is not None:
+                ln = ln[order]
+            starts = np.cumsum(ln) - ln
+            patch_flags(payload, starts[dm])
+            METRICS.count("bam.duplicate_flags_patched", int(dm.sum()))
     if device_deflate is None:
         from ..ops.flate import deflate_lanes_tier_enabled
 

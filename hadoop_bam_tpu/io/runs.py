@@ -34,11 +34,17 @@ import numpy as np
 RUN_DATA_EXT = ".run"
 RUN_KEYS_EXT = ".run.keys.npy"
 RUN_OFFS_EXT = ".run.offs.npy"
+RUN_IDX_EXT = ".run.idx.npy"
 
 
-def run_paths(directory: str, idx: int) -> Tuple[str, str, str]:
+def run_paths(directory: str, idx: int) -> Tuple[str, str, str, str]:
     base = os.path.join(directory, f"run-{idx:05d}")
-    return base + RUN_DATA_EXT, base + RUN_KEYS_EXT, base + RUN_OFFS_EXT
+    return (
+        base + RUN_DATA_EXT,
+        base + RUN_KEYS_EXT,
+        base + RUN_OFFS_EXT,
+        base + RUN_IDX_EXT,
+    )
 
 
 def write_run(
@@ -46,6 +52,7 @@ def write_run(
     idx: int,
     batch,
     perm: np.ndarray,
+    orig_idx: Optional[np.ndarray] = None,
 ) -> None:
     """Spill a sorted chunk: permuted raw record stream + key/offset sidebands.
 
@@ -53,21 +60,34 @@ def write_run(
     ``soa['rec_off']/['rec_len']``); ``perm`` is the sort permutation.
     Writes are atomic (tmp + rename) so a crashed spill never leaves a
     half-run behind.
+
+    ``orig_idx`` (int64, batch order) adds a third memmappable sideband:
+    each spilled record's global read-order index, permuted like the
+    keys.  The dedup fusion stage needs it — its duplicate mask is built
+    in read order over the whole job, and the range-merge writes must map
+    every range row back to that mask.  Omitted (the default) the run
+    format is unchanged.
     """
     from .bam import gather_record_array
 
-    data_p, keys_p, offs_p = run_paths(directory, idx)
+    data_p, keys_p, offs_p, idx_p = run_paths(directory, idx)
     stream = gather_record_array(batch, perm)
     keys_sorted = np.ascontiguousarray(batch.keys[perm], dtype=np.int64)
     lens = batch.soa["rec_len"].astype(np.int64)[perm] + 4
     offs = np.empty(len(lens) + 1, dtype=np.int64)
     offs[0] = 0
     np.cumsum(lens, out=offs[1:])
-    for path, writer in (
+    targets = [
         (data_p, lambda f: f.write(stream.tobytes())),
         (keys_p, lambda f: np.save(f, keys_sorted)),
         (offs_p, lambda f: np.save(f, offs)),
-    ):
+    ]
+    if orig_idx is not None:
+        idx_sorted = np.ascontiguousarray(
+            np.asarray(orig_idx, dtype=np.int64)[perm]
+        )
+        targets.append((idx_p, lambda f: np.save(f, idx_sorted)))
+    for path, writer in targets:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             writer(f)
@@ -87,13 +107,17 @@ class Run:
     data_path: str
     keys: np.ndarray  # int64, sorted (memmap)
     offs: np.ndarray  # int64, len n+1, byte offset of each record (memmap)
+    orig_idx: Optional[np.ndarray] = None  # int64, read-order index (memmap)
 
     @classmethod
     def open(cls, directory: str, idx: int) -> "Run":
-        data_p, keys_p, offs_p = run_paths(directory, idx)
+        data_p, keys_p, offs_p, idx_p = run_paths(directory, idx)
         keys = np.load(keys_p, mmap_mode="r")
         offs = np.load(offs_p, mmap_mode="r")
-        return cls(data_path=data_p, keys=keys, offs=offs)
+        orig = (
+            np.load(idx_p, mmap_mode="r") if os.path.exists(idx_p) else None
+        )
+        return cls(data_path=data_p, keys=keys, offs=offs, orig_idx=orig)
 
     @property
     def n(self) -> int:

@@ -1,4 +1,4 @@
-"""CIGAR ops: reference span and interval-overlap masks.
+"""CIGAR ops: reference span, unclipped ends, and interval-overlap masks.
 
 ``reference_length`` (span consumed on the reference: ops M/D/N/=/X, SAM
 spec) feeds alignment ends for the BAI builder and for exact interval
@@ -6,11 +6,27 @@ overlap — the device-side replacement for htsjdk's ``OverlapDetector``
 filtering in the readers (BAMRecordReader.java:171-175 via
 createIndexIterator, VCFRecordReader.java:196-198).
 
-Two implementations:
-- ``reference_lengths_np``: host NumPy over the ragged sideband
-  (flatten-all-cigars + reduceat — no per-record Python loop),
-- ``overlap_mask`` / ``reference_lengths_padded``: jit device version over a
-  padded [N, max_ops] cigar tensor.
+``unclipped_start`` / ``unclipped_end`` back the duplicate-marking
+signature (dedup/): the 5′ fragment coordinate before the aligner clipped
+it, i.e. ``pos`` pushed left by the leading S/H run (start) and the
+alignment end pushed right by the trailing S/H run (end).  Semantics,
+shared bit-for-bit by every implementation and by ``dedup/oracle.py``:
+
+- leading clips  = the maximal *prefix* of S(4)/H(5) ops,
+- trailing clips = the maximal *suffix* of S(4)/H(5) ops (an all-clip
+  CIGAR contributes its full length to both),
+- ``unclipped_start = pos - leading``,
+- ``unclipped_end   = pos + max(ref_span, 1) - 1 + trailing`` (the
+  ``max(·,1)`` matches htsjdk/samtools ``bam_endpos`` treating a mapped
+  record with an empty CIGAR as covering one base),
+- flags are ignored: the functions are pure CIGAR/pos arithmetic (the
+  dedup layer decides which records participate).
+
+Two implementations of each:
+- ``*_np``: host NumPy over the ragged sideband (flatten-all-cigars +
+  scatter-add — no per-record Python loop),
+- ``*_padded`` (with ``overlap_mask`` / ``reference_lengths_padded``): jit
+  device versions over a padded [N, max_ops] cigar tensor.
 """
 
 from __future__ import annotations
@@ -21,6 +37,8 @@ import numpy as np
 
 # ops M(0) D(2) N(3) =(7) X(8) consume reference.
 _REF_CONSUMING = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0])
+# ops S(4) H(5) are clips.
+_IS_CLIP = np.array([0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0])
 
 
 def reference_lengths_np(data: np.ndarray, soa: dict) -> np.ndarray:
@@ -51,6 +69,72 @@ def reference_lengths_np(data: np.ndarray, soa: dict) -> np.ndarray:
     spans = np.zeros(n, dtype=np.int64)
     np.add.at(spans, rec_of_op, oplen * consume)
     return spans
+
+
+def clip_spans_np(
+    data: np.ndarray, soa: dict
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(leading_clip, trailing_clip, ref_span) int64 triple per record,
+    vectorized over the ragged sideband (one flatten + scatter-adds)."""
+    n = len(soa["rec_off"])
+    lead = np.zeros(n, dtype=np.int64)
+    trail = np.zeros(n, dtype=np.int64)
+    span = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return lead, trail, span
+    cigar_off = soa["rec_off"].astype(np.int64) + 32 + soa["l_read_name"]
+    n_ops = soa["n_cigar_op"].astype(np.int64)
+    total_ops = int(n_ops.sum())
+    if total_ops == 0:
+        return lead, trail, span
+    rec_of_op = np.repeat(np.arange(n), n_ops)
+    starts = np.repeat(cigar_off, n_ops)
+    within = np.arange(total_ops) - np.repeat(
+        np.cumsum(n_ops) - n_ops, n_ops
+    )
+    at = starts + 4 * within
+    u32 = (
+        data[at].astype(np.uint32)
+        | (data[at + 1].astype(np.uint32) << 8)
+        | (data[at + 2].astype(np.uint32) << 16)
+        | (data[at + 3].astype(np.uint32) << 24)
+    )
+    oplen = (u32 >> 4).astype(np.int64)
+    code = u32 & 0xF
+    is_clip = _IS_CLIP[code].astype(bool)
+    np.add.at(span, rec_of_op, oplen * _REF_CONSUMING[code])
+    # An op is a *leading* clip iff no non-clip op precedes it in its
+    # record; *trailing* iff none follows.  Per-record prefix counts of
+    # non-clip ops come from one global exclusive cumsum rebased at each
+    # record's first op (same trick as the ragged murmur batch).
+    nonclip = (~is_clip).astype(np.int64)
+    before = np.cumsum(nonclip) - nonclip  # non-clip ops before, global
+    # First-op index per record; 0-op records repeat zero times, so the
+    # clip only guards the (unused) indices past the flattened op space.
+    rec_first = np.clip(np.cumsum(n_ops) - n_ops, 0, total_ops - 1)
+    before -= np.repeat(before[rec_first], n_ops)
+    per_rec_nonclip = np.zeros(n, dtype=np.int64)
+    np.add.at(per_rec_nonclip, rec_of_op, nonclip)
+    after = per_rec_nonclip[rec_of_op] - before - nonclip
+    np.add.at(lead, rec_of_op, oplen * (is_clip & (before == 0)))
+    np.add.at(trail, rec_of_op, oplen * (is_clip & (after == 0)))
+    return lead, trail, span
+
+
+def unclipped_start_np(data: np.ndarray, soa: dict) -> np.ndarray:
+    """0-based unclipped alignment start per record (``pos`` minus the
+    leading S/H run) — the forward-strand 5′ fragment coordinate."""
+    lead, _, _ = clip_spans_np(data, soa)
+    return soa["pos"].astype(np.int64) - lead
+
+
+def unclipped_end_np(data: np.ndarray, soa: dict) -> np.ndarray:
+    """0-based unclipped alignment end per record (alignment end plus the
+    trailing S/H run) — the reverse-strand 5′ fragment coordinate."""
+    _, trail, span = clip_spans_np(data, soa)
+    return (
+        soa["pos"].astype(np.int64) + np.maximum(span, 1) - 1 + trail
+    )
 
 
 def pack_cigars_padded(
@@ -89,6 +173,45 @@ def reference_lengths_padded(cigars: jax.Array) -> jax.Array:
     opcode = (cigars & 0xF).astype(jnp.int32)
     consume = jnp.asarray(_REF_CONSUMING, dtype=jnp.int32)[opcode]
     return jnp.sum(oplen * consume, axis=-1)
+
+
+@jax.jit
+def unclipped_start_padded(
+    cigars: jax.Array,  # uint32[N, max_ops] (pack_cigars_padded)
+    n_ops: jax.Array,  # int32[N]
+    pos: jax.Array,  # int32[N] 0-based
+) -> jax.Array:
+    """Device twin of :func:`unclipped_start_np` over the padded tensor —
+    the dedup signature op's orientation-aware clip adjustment."""
+    lead, _, _ = _clip_spans_padded(cigars, n_ops)
+    return pos - lead
+
+
+@jax.jit
+def unclipped_end_padded(
+    cigars: jax.Array, n_ops: jax.Array, pos: jax.Array
+) -> jax.Array:
+    """Device twin of :func:`unclipped_end_np` over the padded tensor."""
+    _, trail, span = _clip_spans_padded(cigars, n_ops)
+    return pos + jnp.maximum(span, 1) - 1 + trail
+
+
+def _clip_spans_padded(cigars: jax.Array, n_ops: jax.Array):
+    oplen = (cigars >> 4).astype(jnp.int32)
+    code = (cigars & 0xF).astype(jnp.int32)
+    valid = (
+        jnp.arange(cigars.shape[-1], dtype=jnp.int32)[None, :]
+        < n_ops[:, None]
+    )
+    is_clip = jnp.asarray(_IS_CLIP, dtype=jnp.int32)[code].astype(bool) & valid
+    consume = jnp.asarray(_REF_CONSUMING, dtype=jnp.int32)[code]
+    span = jnp.sum(oplen * consume * valid, axis=-1)
+    nonclip = (valid & ~is_clip).astype(jnp.int32)
+    before = jnp.cumsum(nonclip, axis=-1) - nonclip
+    after = jnp.sum(nonclip, axis=-1, keepdims=True) - before - nonclip
+    lead = jnp.sum(oplen * (is_clip & (before == 0)), axis=-1)
+    trail = jnp.sum(oplen * (is_clip & (after == 0)), axis=-1)
+    return lead, trail, span
 
 
 @jax.jit

@@ -20,6 +20,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 SANGER_OFFSET = 33
 SANGER_MAX = 93
@@ -101,3 +102,55 @@ def base_counts(seq_codes: jax.Array, valid: jax.Array) -> jax.Array:
 def unpack_seq_nibbles(packed: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """uint8[B, L/2] packed 4-bit bases → (hi, lo) uint8[B, L/2] nibbles."""
     return packed >> 4, packed & 0xF
+
+
+# ---------------------------------------------------------------------------
+# Duplicate-marking score: summed base quality (Picard/samtools convention)
+# ---------------------------------------------------------------------------
+
+#: Quality threshold for the markdup score (samtools markdup / Picard
+#: MarkDuplicates both sum only bases with quality ≥ 15).
+MARKDUP_MIN_QUALITY = 15
+_QUAL_MISSING = 0xFF  # the spec's "qual absent" fill byte never scores
+
+
+def sum_base_qualities_np(
+    data: np.ndarray, soa: dict, min_quality: int = MARKDUP_MIN_QUALITY
+) -> np.ndarray:
+    """int64[N] markdup score per record: sum of qual bytes ≥ ``min_quality``
+    (0xFF = missing qual never counts), vectorized over the ragged qual
+    sideband — the host-gathered reduction feeding the dedup segmented
+    arg-max, same stance as the unmapped-key ``hash32`` column."""
+    n = len(soa["rec_off"])
+    scores = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return scores
+    l_seq = soa["l_seq"].astype(np.int64)
+    qual_off = (
+        soa["rec_off"].astype(np.int64)
+        + 32
+        + soa["l_read_name"]
+        + 4 * soa["n_cigar_op"].astype(np.int64)
+        + (l_seq + 1) // 2
+    )
+    total = int(l_seq.sum())
+    if total == 0:
+        return scores
+    rec_of_base = np.repeat(np.arange(n), l_seq)
+    within = np.arange(total) - np.repeat(np.cumsum(l_seq) - l_seq, l_seq)
+    q = data[np.repeat(qual_off, l_seq) + within].astype(np.int64)
+    counted = (q >= min_quality) & (q != _QUAL_MISSING)
+    np.add.at(scores, rec_of_base, q * counted)
+    return scores
+
+
+@partial(jax.jit, static_argnames=("min_quality",))
+def sum_base_qualities(
+    qual: jax.Array,  # uint8[B, L]
+    valid: jax.Array,  # bool[B, L]
+    min_quality: int = MARKDUP_MIN_QUALITY,
+) -> jax.Array:
+    """Device twin of :func:`sum_base_qualities_np` over padded rows."""
+    q = qual.astype(jnp.int32)
+    counted = valid & (q >= min_quality) & (q != _QUAL_MISSING)
+    return jnp.sum(jnp.where(counted, q, 0), axis=-1)

@@ -25,7 +25,11 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .conf import BAM_WRITE_SPLITTING_BAI, Configuration
+from .conf import (
+    BAM_MARK_DUPLICATES,
+    BAM_WRITE_SPLITTING_BAI,
+    Configuration,
+)
 from .utils.tracing import METRICS, span
 from .io.bam import (
     SORT_FIELDS,
@@ -52,6 +56,7 @@ class SortStats:
     n_runs: int = 0  # out-of-core path: sorted spill runs written
     n_ranges: int = 0  # out-of-core path: merge key ranges
     peak_bytes: int = 0  # out-of-core path: largest materialized chunk
+    n_duplicates: int = 0  # markdup fusion stage: records flagged 0x400
 
 
 def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
@@ -92,6 +97,7 @@ def sort_bam(
     backend: str = "device",
     memory_budget: Optional[int] = None,
     device_parse: Optional[bool] = None,
+    mark_duplicates: bool = False,
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
 
@@ -138,7 +144,19 @@ def sort_bam(
     part's gathered record stream compresses on-chip
     (``ops.pallas.deflate_lanes`` LZ77 + fixed-Huffman emit) and the host
     does only gzip framing + CRC32 — displacing the ~38% of host wall the
-    level-1 zlib part writes cost on the 1-core bench host."""
+    level-1 zlib part writes cost on the 1-core bench host.
+
+    ``mark_duplicates`` (or the ``hadoopbam.bam.mark-duplicates`` conf
+    key) fuses the dedup subsystem into the sort: each split's ragged
+    sidebands reduce to fixed-width signature columns during the read
+    (clip-adjusted unclipped-5′ ends, summed base qualities, name
+    hashes), the samtools-class decision runs on device over the whole
+    job (:mod:`hadoop_bam_tpu.dedup`), and the part writes OR
+    ``FLAG_DUPLICATE`` into each duplicate's flag bytes just before
+    deflate.  Works on every sort path, including ``memory_budget`` —
+    there the record *bytes* stay budget-bounded while the signature
+    columns (~18 bytes/record, like samtools markdup's per-read state)
+    stay in memory."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -149,6 +167,9 @@ def sort_bam(
     if conf is not None:
         write_splitting_bai = write_splitting_bai or conf.get_boolean(
             BAM_WRITE_SPLITTING_BAI
+        )
+        mark_duplicates = mark_duplicates or conf.get_boolean(
+            BAM_MARK_DUPLICATES
         )
     header = read_header(in_paths[0]).with_sort_order("coordinate")
     if memory_budget is not None:
@@ -185,6 +206,7 @@ def sort_bam(
             part_dir=part_dir,
             write_workers=write_workers,
             device_deflate=deflate_lanes_tier_enabled(conf),
+            mark_duplicates=mark_duplicates,
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -233,6 +255,18 @@ def sort_bam(
     read_fields = (
         ("rec_off", "rec_len") if use_device_parse else SORT_FIELDS
     )
+    sig_cols: List[dict] = []
+    if mark_duplicates:
+        # The dedup signature needs the clip/qual/name geometry columns on
+        # top of the key inputs; they are reduced per split and dropped
+        # with the rest of the SoA, so host peak stays at the extents.
+        from .dedup import DEDUP_EXTRA_FIELDS, signature_columns
+
+        read_fields = tuple(
+            dict.fromkeys(
+                read_fields + SORT_FIELDS + DEDUP_EXTRA_FIELDS
+            )
+        )
     with span("sort_bam.read"):
         for si, b in enumerate(
             _read_splits_pipelined(
@@ -242,6 +276,9 @@ def sort_bam(
                 with_keys=not use_device_parse,
             )
         ):
+            if mark_duplicates:
+                with span("sort_bam.markdup_signature"):
+                    sig_cols.append(signature_columns(b.data, b.soa))
             # Only the record extents stay live (the other fixed-field
             # columns would just inflate host peak).
             b.soa = {
@@ -344,6 +381,20 @@ def sort_bam(
         with span("sort_bam.host_sort"):
             perm = np.argsort(_all_keys(), kind="stable")
 
+    # The dedup fusion stage: one device decision over the job-global
+    # signature columns (read order — the same index space the part
+    # writers' ``order`` slices address, so patching is a plain gather).
+    dup_mask = None
+    n_dup = 0
+    if mark_duplicates and n:
+        from .dedup import concat_columns, mark_duplicates_device
+
+        with span("sort_bam.markdup"):
+            dup_mask = mark_duplicates_device(concat_columns(sig_cols))
+            n_dup = int(dup_mask.sum())
+        METRICS.count("sort_bam.duplicates", n_dup)
+        sig_cols = []
+
     # A zero-copy chunked view over the per-split batches — the permuted
     # part writes gather straight from the split payloads (no global
     # concatenation; on a 1-core host that copy dominated the pipeline).
@@ -394,6 +445,7 @@ def sort_bam(
                         splitting_bai_stream=sb_stream,
                         threads=deflate_threads,
                         device_deflate=use_device_deflate,
+                        dup_mask=dup_mask,
                     )
             finally:
                 if sb_stream is not None:
@@ -408,7 +460,30 @@ def sort_bam(
         merge_bam_parts(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )
-    return SortStats(n_records=n, n_splits=len(splits), backend=backend)
+    return SortStats(
+        n_records=n,
+        n_splits=len(splits),
+        backend=backend,
+        n_duplicates=n_dup,
+    )
+
+
+def markdup_bam(
+    in_paths: Sequence[str] | str,
+    out_path: str,
+    **kwargs,
+) -> SortStats:
+    """Standalone duplicate-marking job: ``sort_bam`` with the dedup
+    fusion stage forced on.
+
+    The sort is stable, so running it over an already coordinate-sorted
+    BAM reproduces the input order — for sorted inputs this is a pure
+    markdup pass (the biobambam ``bammarkduplicates`` role); for unsorted
+    inputs it is sort+markdup in one pipeline (the ``samtools sort |
+    samtools markdup`` pair, fused).  Accepts every ``sort_bam`` keyword
+    (``memory_budget``, ``backend``, ``level``, …)."""
+    kwargs["mark_duplicates"] = True
+    return sort_bam(in_paths, out_path, **kwargs)
 
 
 def _device_roundtrip_ms() -> float:
@@ -712,6 +787,7 @@ def _sort_bam_external(
     part_dir: Optional[str],
     write_workers: Optional[int],
     device_deflate: bool = False,
+    mark_duplicates: bool = False,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -728,9 +804,24 @@ def _sort_bam_external(
     (reported in ``SortStats.peak_bytes``); everything else stays on disk
     behind memmaps.  Reference contract: the streaming record iterator
     (BAMRecordReader.java:223-232) + Hadoop's sort-spill-merge shuffle.
+
+    With ``mark_duplicates``, runs carry a third sideband (each record's
+    global read-order index) so the range-merge writes can address the
+    job-global duplicate mask; the decision itself is identical to the
+    in-core path's (same columns, same device program), so the two paths
+    produce byte-identical marked output.
     """
     from .io.bam import write_part_fast
     from .io.runs import Run, plan_ranges, write_run
+
+    if mark_duplicates:
+        from .dedup import DEDUP_EXTRA_FIELDS, signature_columns
+
+        read_fields = tuple(
+            dict.fromkeys(SORT_FIELDS + DEDUP_EXTRA_FIELDS)
+        )
+    else:
+        read_fields = SORT_FIELDS
 
     with contextlib.ExitStack() as stack:
         out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
@@ -750,21 +841,34 @@ def _sort_bam_external(
         run_count = 0
         acc: List[RecordBatch] = []
         acc_bytes = 0
+        sig_cols: List[dict] = []
+        flushed_n = 0  # records already spilled (read-order index base)
 
         def flush() -> None:
-            nonlocal run_count, acc, acc_bytes, peak
+            nonlocal run_count, acc, acc_bytes, peak, flushed_n
             if not acc:
                 return
             merged = ChunkedRecords.from_batches(acc)
             peak = max(peak, acc_bytes)
             perm = _sort_perm(merged.keys, backend)
-            write_run(spill_dir, run_count, merged, perm)
+            orig = None
+            k = merged.n_records
+            if mark_duplicates:
+                # Global read-order index of each spilled record: runs are
+                # flushed in read order, so this chunk covers exactly
+                # [flushed_n, flushed_n + k).
+                orig = np.arange(flushed_n, flushed_n + k, dtype=np.int64)
+            write_run(spill_dir, run_count, merged, perm, orig_idx=orig)
+            flushed_n += k
             run_count += 1
             acc = []
             acc_bytes = 0
 
         with span("sort_bam.spill"):
-            for b in _read_splits_pipelined(fmt, splits, fields=SORT_FIELDS):
+            for b in _read_splits_pipelined(fmt, splits, fields=read_fields):
+                if mark_duplicates:
+                    with span("sort_bam.markdup_signature"):
+                        sig_cols.append(signature_columns(b.data, b.soa))
                 b.soa = {
                     "rec_off": b.soa["rec_off"],
                     "rec_len": b.soa["rec_len"],
@@ -780,6 +884,19 @@ def _sort_bam_external(
         METRICS.count("sort_bam.records", n)
         METRICS.count("sort_bam.splits", len(splits))
         METRICS.count("sort_bam.runs", run_count)
+
+        dup_mask = None
+        n_dup = 0
+        if mark_duplicates and n:
+            from .dedup import concat_columns, mark_duplicates_device
+
+            with span("sort_bam.markdup"):
+                dup_mask = mark_duplicates_device(
+                    concat_columns(sig_cols)
+                )
+                n_dup = int(dup_mask.sum())
+            METRICS.count("sort_bam.duplicates", n_dup)
+            sig_cols = []
 
         # ---- Phase 2: exact key-range merge ------------------------------
         runs = [Run.open(spill_dir, k) for k in range(run_count)]
@@ -805,6 +922,7 @@ def _sort_bam_external(
             keys_l: List[np.ndarray] = []
             off_l: List[np.ndarray] = []
             len_l: List[np.ndarray] = []
+            orig_l: List[np.ndarray] = []
             base = 0
             for r, (i0, i1) in enumerate(cuts):
                 if i1 <= i0:
@@ -819,6 +937,10 @@ def _sort_bam_external(
                 keys_l.append(
                     np.asarray(runs[r].keys[i0:i1], dtype=np.int64)
                 )
+                if dup_mask is not None:
+                    orig_l.append(
+                        np.asarray(runs[r].orig_idx[i0:i1], dtype=np.int64)
+                    )
                 datas.append(sl)
                 base += len(sl)
             if not datas:
@@ -828,6 +950,7 @@ def _sort_bam_external(
                     "rec_off": np.empty(0, np.int64),
                     "rec_len": np.empty(0, np.int64),
                 }
+                dup_rows = None
             else:
                 data = np.concatenate(datas)
                 keys = np.concatenate(keys_l)
@@ -835,6 +958,13 @@ def _sort_bam_external(
                     "rec_off": np.concatenate(off_l),
                     "rec_len": np.concatenate(len_l),
                 }
+                # Range rows → job-global duplicate mask, via the runs'
+                # read-order index sideband.
+                dup_rows = (
+                    dup_mask[np.concatenate(orig_l)]
+                    if dup_mask is not None
+                    else None
+                )
             peak = max(peak, len(data))
             batch = RecordBatch(soa=soa, data=data, keys=keys)
             # Slices are each sorted; the stable sort merges them, keeping
@@ -853,6 +983,7 @@ def _sort_bam_external(
                         splitting_bai_stream=sb_stream,
                         threads=deflate_threads,
                         device_deflate=device_deflate,
+                        dup_mask=dup_rows,
                     )
             finally:
                 if sb_stream is not None:
@@ -877,6 +1008,7 @@ def _sort_bam_external(
         n_runs=run_count,
         n_ranges=len(ranges),
         peak_bytes=peak,
+        n_duplicates=n_dup,
     )
 
 

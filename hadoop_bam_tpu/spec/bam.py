@@ -249,6 +249,12 @@ class BamRecord:
         return bool(self.flag & FLAG_UNMAPPED)
 
     @property
+    def is_duplicate(self) -> bool:
+        """PCR/optical duplicate flag (0x400) — set by the dedup
+        subsystem's write-time patch, never by the decoder."""
+        return bool(self.flag & FLAG_DUPLICATE)
+
+    @property
     def alignment_start(self) -> int:
         """1-based leftmost coordinate (htsjdk getAlignmentStart), 0 if unplaced."""
         return self.pos + 1

@@ -37,21 +37,29 @@ def pytest_configure(config):
         "device_deflate: needs a real accelerator for the device DEFLATE "
         "encoder; skipped when JAX_PLATFORMS pins cpu",
     )
+    config.addinivalue_line(
+        "markers",
+        "dedup: duplicate-marking subsystem (dedup/) tests; combined "
+        "with `tpu` they need a real accelerator and skip under a cpu pin",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
-    """Skip device-deflate accelerator tests cleanly when the environment
-    pins JAX to CPU (the tier-1 invocation runs under JAX_PLATFORMS=cpu):
-    their subprocess children would only rediscover the pin and fail
-    noisily instead of skipping."""
+    """Skip accelerator-only tests cleanly when the environment pins JAX
+    to CPU (the tier-1 invocation runs under JAX_PLATFORMS=cpu): their
+    subprocess children would only rediscover the pin and fail noisily
+    instead of skipping.  Covers the device-deflate suite and any
+    TPU-marked dedup tests (the plain dedup tests run everywhere)."""
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
         return
     skip = pytest.mark.skip(
-        reason="JAX_PLATFORMS=cpu pins this run to CPU; device-deflate "
-        "TPU tests need a real accelerator"
+        reason="JAX_PLATFORMS=cpu pins this run to CPU; this test needs "
+        "a real accelerator"
     )
     for item in items:
-        if "device_deflate" in item.keywords:
+        if "device_deflate" in item.keywords or (
+            "dedup" in item.keywords and "tpu" in item.keywords
+        ):
             item.add_marker(skip)
 
 
