@@ -221,7 +221,59 @@ def _measure(platform: str) -> dict:
             out["device_deflate_vs_zlib1"] = round(rr["rel_zlib1"], 3)
         except Exception as e:
             out["device_deflate_error"] = str(e)[:120]
+        # Tier hit rates on a corpus of FULL-SIZE members (the BGZF
+        # blocking real writers emit): the fraction of members the
+        # streaming lanes tier actually took.  1.0 means the cap lift
+        # holds — no size-based tier-downs — independent of MB/s.
+        try:
+            out.update(_codec_tier_hit_rates())
+        except Exception as e:
+            out["device_codec_tier_error"] = str(e)[:120]
     return out
+
+
+def _codec_tier_hit_rates(n_members: int = 8) -> dict:
+    """Round-trip ``n_members`` full-size BGZF members through both device
+    codec wrappers with the lanes tiers forced on, and report the fraction
+    each lanes tier took (``CodecTierStats.lanes_hit_rate``)."""
+    from hadoop_bam_tpu.conf import (
+        Configuration, DEFLATE_LANES, INFLATE_LANES,
+    )
+    from hadoop_bam_tpu.ops import flate
+    from hadoop_bam_tpu.ops.pallas.deflate_lanes import _bam_like_corpus
+
+    conf = Configuration(
+        {INFLATE_LANES: "true", DEFLATE_LANES: "true"}
+    )
+    member = flate.DEV_LZ_PAYLOAD  # the part writer's full-size blocking
+    data = _bam_like_corpus(1, n_members * member).tobytes()
+    blob = flate.bgzf_compress_device(
+        data, level=1, conf=conf, use_lanes=True
+    )
+    res = {
+        "device_deflate_tier_hit_rate": round(
+            flate.LAST_DEFLATE_STATS.lanes_hit_rate(), 4
+        ),
+        "device_deflate_tierdowns": sum(
+            (flate.LAST_DEFLATE_STATS.tierdown_size,
+             flate.LAST_DEFLATE_STATS.tierdown_vmem,
+             flate.LAST_DEFLATE_STATS.tierdown_ok0)
+        ),
+    }
+    assert flate.bgzf_decompress_device(blob, conf=conf) == data
+    res.update(
+        {
+            "device_inflate_tier_hit_rate": round(
+                flate.LAST_INFLATE_STATS.lanes_hit_rate(), 4
+            ),
+            "device_inflate_tierdowns": sum(
+                (flate.LAST_INFLATE_STATS.tierdown_size,
+                 flate.LAST_INFLATE_STATS.tierdown_vmem,
+                 flate.LAST_INFLATE_STATS.tierdown_ok0)
+            ),
+        }
+    )
+    return res
 
 
 def _child(platform: str) -> None:

@@ -196,7 +196,19 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     if args.metrics:
         import json
 
-        print(json.dumps(METRICS.report(), indent=2, sort_keys=True))
+        report = METRICS.report()
+        # Device codec tier accounting, explicit even when every counter
+        # is zero (publish() skips zeros): members per tier plus the
+        # size/vmem/ok0 tier-down taxonomy of the most recent call to
+        # each wrapper.  Cumulative totals ride in the flate.inflate.* /
+        # flate.deflate.* counters above.
+        from .ops import flate
+
+        report["codec_tiers"] = {
+            "inflate_last_call": flate.LAST_INFLATE_STATS.as_dict(),
+            "deflate_last_call": flate.LAST_DEFLATE_STATS.as_dict(),
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 
 
@@ -289,7 +301,10 @@ def build_parser() -> argparse.ArgumentParser:
                 help="fuse samtools-class duplicate marking into the sort "
                      "(OR 0x400 into duplicates' flags at write time)")
         s.add_argument("--metrics", action="store_true",
-                       help="print the span/counter report after the run")
+                       help="print the span/counter report after the run "
+                            "(includes the device codec tier counters: "
+                            "flate.inflate.* / flate.deflate.* members "
+                            "per tier and size/vmem/ok0 tier-downs)")
         s.add_argument("--trace-dir", default=None,
                        help="capture a JAX profiler (XPlane) trace here")
 

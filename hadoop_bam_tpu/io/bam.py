@@ -51,11 +51,18 @@ class RecordBatch:
 
     ``data`` holds the uncompressed record stream for this batch; per-record
     bodies live at ``soa['rec_off'] .. +soa['rec_len']`` (the lazy sideband).
+
+    ``device_data``, when set, is a device-resident (jax) uint8 copy of the
+    same byte window, left in HBM by the lockstep-lane inflate tier (the
+    on-chip output-residency handoff): the device-parse path feeds it to
+    the chain kernel directly instead of re-uploading ``data``.  It is
+    only attached when byte-for-byte identical to ``data``.
     """
 
     soa: dict
     data: np.ndarray  # uint8
     keys: np.ndarray  # int64
+    device_data: Optional[object] = None  # jax uint8, same bytes as data
 
     @property
     def n_records(self) -> int:
@@ -561,17 +568,22 @@ def read_virtual_range(
         pos += csize
     spill_pos = pos
 
+    dev_cell: List = [None]  # device-resident copy of the inflated window
+
     def inflate(co, cs, us):
         if device_inflate:
             from ..ops import flate
 
             try:
-                return flate.inflate_blocks_device(
+                out, offs, dev = flate.inflate_blocks_device(
                     data,
                     np.asarray(co, dtype=np.int64),
                     np.asarray(cs, dtype=np.int32),
                     np.asarray(us, dtype=np.int32),
+                    return_device=True,
                 )
+                dev_cell[0] = dev
+                return out, offs
             except Exception:
                 # Device tier failure is never fatal to a read — tier
                 # down to the native host codec for the whole window.
@@ -698,7 +710,13 @@ def read_virtual_range(
     METRICS.count("bam.records_decoded", len(offsets))
     if interval_chunks is not None:
         METRICS.count("bam.records_kept", len(soa["rec_off"]))
-    return RecordBatch(soa=soa, data=arr, keys=keys)
+    # The device-resident copy is only exact on the no-spill fast path
+    # (spill blocks are host-inflated into a grown buffer the device
+    # never saw).
+    device_data = dev_cell[0] if plen == len(out) else None
+    return RecordBatch(
+        soa=soa, data=arr, keys=keys, device_data=device_data
+    )
 
 
 def _voffset_mask(offsets, block_uoffs, block_voffs, us_l, chunks):

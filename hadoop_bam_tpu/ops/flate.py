@@ -92,11 +92,15 @@ Device codec tiers, top to bottom (each tier falls through per member):
 4. **Native host zlib** (spec/bgzf.py + native/): the unconditional
    correctness tier; nothing above it is load-bearing for correctness.
 
-NEXT: whole-member VMEM residency caps lanes-tier member size
-(inflate_lanes._VMEM_BUDGET_BYTES); the HBM-streaming windowed variant
-(sliding output window + the already-built far-copy host pass) lifts it,
-and on-chip output residency feeds the parsed stream straight to the
-chain kernel without the d2h/h2d bounce.
+Both lanes kernels are HBM-STREAMING: they grid over fixed-size chunks
+(output chunks for the decoder, input chunks for the encoder) with
+per-lane state — bit cursors, canonical tables, a 32 KiB LZ77 resolve
+ring, hash-head chains, token tiles — carried across grid steps in VMEM
+scratch, so full 64 KiB BGZF members ride the lanes tiers instead of
+tiering down at a whole-member-VMEM cap.  On-chip output residency is
+wired too: ``inflate_blocks_device(return_device=True)`` leaves the
+inflated split in HBM and the device-parse chain kernel consumes it
+without the d2h/h2d bounce (``RecordBatch.device_data``).
 
 Caveat for all launches: XLA:TPU gathers silently mis-index above 2^24
 elements per launch (f32 index precision); wrappers chunk accordingly.
@@ -202,12 +206,13 @@ DEV_MAX_PAYLOAD = 0xDF00  # 57088 → ≤ 64252-byte block, < 0x10000
 DEV_DEFAULT_PAYLOAD = 24000
 
 # Member payload for the lockstep-lane LZ77 encoder tier
-# (ops/pallas/deflate_lanes.py): the whole member doubles as the match
-# window and must ride VMEM next to the per-lane hash tables and token
-# columns, so members are smaller than the literal-only tier's.  Extra
-# framing cost is ~26 header bytes per 4 KiB (~0.6%); the match window it
-# buys recovers far more on BAM-class data.
-DEV_LZ_PAYLOAD = 4096
+# (ops/pallas/deflate_lanes.py).  The streaming geometry (token tiles
+# chunked out to HBM, persistent hash heads) lifted the old 4 KiB
+# whole-member-VMEM cap, so the lanes tier now emits full-size members:
+# DEV_MAX_PAYLOAD is the largest payload whose worst-case (all-literal)
+# fixed-Huffman emit still fits the u16 BSIZE field — the same blocking
+# real BGZF writers target.
+DEV_LZ_PAYLOAD = DEV_MAX_PAYLOAD
 
 # XLA:TPU gathers mis-index when a single launch exceeds 2^24 elements
 # (observed empirically: B*NB == 2^24 exact, 2^24+… corrupt — consistent
@@ -1014,6 +1019,81 @@ def inflate_dynamic(
 # --------------------------------------------------------------------------
 
 
+class CodecTierStats:
+    """Per-call tier accounting for the device codec wrappers.
+
+    ``bgzf_decompress_device`` / ``bgzf_compress_device`` populate a fresh
+    instance per call (module globals ``LAST_INFLATE_STATS`` /
+    ``LAST_DEFLATE_STATS``) and mirror every field into METRICS counters
+    (``flate.inflate.*`` / ``flate.deflate.*``), which the CLI's
+    ``--metrics`` JSON report surfaces next to the sort/markdup spans.
+
+    Fields: members taken per tier (``lanes`` / ``xla`` / ``host``) and
+    tier-down causes out of the lanes tier (``tierdown_size`` — member
+    shape past the streaming caps, ``tierdown_vmem`` — launch geometry
+    past the VMEM budget, ``tierdown_ok0`` — the kernel itself declined,
+    i.e. corrupt data or an in-kernel budget overflow).
+    """
+
+    __slots__ = (
+        "lanes", "xla", "host",
+        "tierdown_size", "tierdown_vmem", "tierdown_ok0",
+    )
+
+    def __init__(self) -> None:
+        self.lanes = 0
+        self.xla = 0
+        self.host = 0
+        self.tierdown_size = 0
+        self.tierdown_vmem = 0
+        self.tierdown_ok0 = 0
+
+    @property
+    def total(self) -> int:
+        return self.lanes + self.xla + self.host
+
+    def lanes_hit_rate(self) -> float:
+        """Fraction of members the lanes tier actually took (1.0 = no
+        tier-downs) — the bench artifact's ``device_*_tier_hit_rate``."""
+        t = self.total
+        return self.lanes / t if t else 0.0
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def publish(self, prefix: str) -> None:
+        from ..utils.tracing import METRICS
+
+        for k in self.__slots__:
+            v = getattr(self, k)
+            if v:
+                METRICS.count(f"{prefix}.{k}", v)
+
+
+#: Tier accounting of the most recent wrapper call (read by bench.py).
+LAST_INFLATE_STATS = CodecTierStats()
+LAST_DEFLATE_STATS = CodecTierStats()
+
+
+def inflate_lanes_accepts(max_clen: int, max_isize: int) -> Tuple[bool, str]:
+    """Pure-host tier selection for the streaming lanes decoder: would a
+    member of this compressed/inflated shape ride the lanes tier?  Returns
+    ``(True, "")`` or ``(False, "size"|"vmem")``.  A full 64 KiB BGZF
+    member is accepted — the point of the HBM-streaming geometry."""
+    from .pallas.inflate_lanes import accepts
+
+    return accepts(max_clen, max_isize)
+
+
+def deflate_lanes_accepts(max_plen: int) -> Tuple[bool, str]:
+    """Pure-host tier selection for the streaming lanes encoder (mirror of
+    :func:`inflate_lanes_accepts`); payloads up to the part writer's
+    ``DEV_MAX_PAYLOAD`` blocking are accepted."""
+    from .pallas.deflate_lanes import accepts
+
+    return accepts(max_plen)
+
+
 def lanes_tier_enabled(conf=None) -> bool:
     """Should BGZF inflate route through the lockstep-lane Pallas tier?
 
@@ -1064,38 +1144,88 @@ def deflate_lanes_tier_enabled(conf=None) -> bool:
 
 
 def _lanes_decode_members(
-    raw: np.ndarray, co, cs, xlen, idx: List[int], us
-) -> Tuple[dict, int]:
+    raw: np.ndarray, co, cs, xlen, idx: List[int], us,
+    stats: Optional[CodecTierStats] = None,
+    keep_device: bool = False,
+) -> Tuple[dict, int, Optional[object]]:
     """Run the lockstep-lane decoder over the members in ``idx``.
 
-    Returns ``({member_index: payload_bytes}, n_tierdown)`` — members the
-    lanes tier could not decode are simply absent and flow to the next
+    Returns ``({member_index: payload_bytes}, n_tierdown, dev)`` — members
+    the lanes tier could not decode are simply absent and flow to the next
     tier.  Never raises: a launch failure counts every member as a
-    tier-down (visible in METRICS, like the fixed-slice tier).
-    """
+    tier-down (visible in METRICS, like the fixed-slice tier).  Members
+    whose shape the streaming geometry rejects are filtered host-side
+    (``inflate_lanes_accepts``) so one oversized member no longer tiers
+    down its whole launch; ``stats`` (when given) records the tier-down
+    taxonomy.  With ``keep_device`` the per-lane device byte view rides
+    back for the on-chip output-residency handoff (None unless every
+    member of a single 128-lane launch decoded clean)."""
     from ..utils.tracing import METRICS
-    from .pallas.inflate_lanes import inflate_lanes
+    from .pallas.inflate_lanes import inflate_lanes_ex
 
-    clens = np.asarray([cs[i] - 20 - xlen[i] for i in idx], dtype=np.int32)
-    isz = np.asarray([us[i] for i in idx], dtype=np.int32)
-    comp = np.zeros((len(idx), max(int(clens.max()), 1)), dtype=np.uint8)
-    for k, i in enumerate(idx):
+    clens_all = np.asarray(
+        [cs[i] - 20 - xlen[i] for i in idx], dtype=np.int32
+    )
+    isz_all = np.asarray([us[i] for i in idx], dtype=np.int32)
+    take: List[int] = []
+    for k in range(len(idx)):
+        ok_k, reason = inflate_lanes_accepts(
+            int(clens_all[k]), int(isz_all[k])
+        )
+        if ok_k:
+            take.append(k)
+        elif stats is not None:
+            if reason == "size":
+                stats.tierdown_size += 1
+            else:
+                stats.tierdown_vmem += 1
+    if not take:
+        if len(idx):
+            METRICS.count("flate.lanes_tierdown", len(idx))
+        return {}, len(idx), None
+    clens = clens_all[take]
+    isz = isz_all[take]
+    comp = np.zeros((len(take), max(int(clens.max()), 1)), dtype=np.uint8)
+    for k2, k in enumerate(take):
+        i = idx[k]
         s = int(co[i]) + 12 + int(xlen[i])
-        comp[k, : clens[k]] = raw[s : s + clens[k]]
+        comp[k2, : clens[k2]] = raw[s : s + clens[k2]]
     try:
-        out_l, ok_l = inflate_lanes(comp, clens, isz)
+        out_l, ok_l, dev = inflate_lanes_ex(
+            comp, clens, isz, keep_device=keep_device
+        )
     except Exception:
         METRICS.count("flate.lanes_launch_error", 1)
-        return {}, len(idx)
+        if stats is not None:
+            stats.tierdown_ok0 += len(idx)
+        return {}, len(idx), None
     decoded = {
-        i: out_l[k, : isz[k]].tobytes()
-        for k, i in enumerate(idx)
-        if ok_l[k]
+        idx[take[k2]]: out_l[k2, : isz[k2]].tobytes()
+        for k2 in range(len(take))
+        if ok_l[k2]
     }
+    if stats is not None:
+        stats.tierdown_ok0 += int((~ok_l).sum())
     n_down = len(idx) - len(decoded)
     if n_down:
         METRICS.count("flate.lanes_tierdown", n_down)
-    return decoded, n_down
+        dev = None  # the device view is only exact when everything decoded
+    if dev is not None and len(take) != len(idx):
+        dev = None
+    return decoded, n_down, dev
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _device_flatten(bytes2d, lane_of, start_of, local0, n_total: int):
+    """Concatenate ragged per-lane payload slices into one device-resident
+    byte stream: position p of the flat stream reads
+    ``bytes2d[lane_of[m], p - start_of[m]]`` for its covering member m.
+    ``lane_of``/``start_of`` expand from small per-member columns on
+    device (``jnp.repeat``), so only O(members) data is uploaded."""
+    lanes = jnp.repeat(lane_of, local0, total_repeat_length=n_total)
+    starts = jnp.repeat(start_of, local0, total_repeat_length=n_total)
+    p = jnp.arange(n_total, dtype=jnp.int32)
+    return bytes2d[lanes, p - starts]
 
 
 def inflate_blocks_device(
@@ -1104,7 +1234,8 @@ def inflate_blocks_device(
     csizes: np.ndarray,
     usizes: np.ndarray,
     check_crc: bool = True,
-) -> Tuple[np.ndarray, np.ndarray]:
+    return_device: bool = False,
+):
     """Device-tier drop-in for :func:`native.inflate_blocks`.
 
     Same contract — ``(out, out_offsets)`` with block i's payload at
@@ -1114,6 +1245,13 @@ def inflate_blocks_device(
     tier rejects fall back to native host zlib per member.  This is the
     split-read surface: ``io.bam.read_virtual_range(device_inflate=True)``
     routes its batched block inflate here when the lanes tier is enabled.
+
+    ``return_device`` adds a third return value: a device-resident uint8
+    array holding the same concatenated payload stream (the on-chip
+    output-residency handoff — the device-parse chain kernel can consume
+    it without the d2h→h2d bounce), or ``None`` whenever the device copy
+    would not be byte-exact (any tier-down, CRC retry, host-replayed far
+    copy, or more members than one 128-lane launch).
     """
     from .. import native
 
@@ -1129,10 +1267,13 @@ def inflate_blocks_device(
         raw[co64 + 11].astype(np.int32) << 8
     )
     live = [i for i in range(n) if usizes[i] > 0]
-    decoded, _ = (
-        _lanes_decode_members(raw, coffsets, csizes, xlen, live, usizes)
+    decoded, _, dev2d = (
+        _lanes_decode_members(
+            raw, coffsets, csizes, xlen, live, usizes,
+            keep_device=return_device,
+        )
         if live
-        else ({}, 0)
+        else ({}, 0, None)
     )
     fallback: List[int] = []
     for i in live:
@@ -1151,6 +1292,7 @@ def inflate_blocks_device(
             payload, dtype=np.uint8
         )
     if fallback:
+        dev2d = None  # host bytes diverge from the device copy
         f_out, f_offs = native.inflate_blocks(
             raw,
             co64[fallback],
@@ -1162,7 +1304,30 @@ def inflate_blocks_device(
             out[out_offsets[i] : out_offsets[i + 1]] = f_out[
                 f_offs[k] : f_offs[k + 1]
             ]
-    return out, out_offsets
+    if not return_device:
+        return out, out_offsets
+    dev_flat = None
+    if dev2d is not None and len(out):
+        # Lanes of the (single) launch are the live members in order;
+        # empty members contribute zero bytes and need no lane.
+        lane_of = np.asarray(
+            [live.index(i) for i in range(n) if usizes[i] > 0],
+            dtype=np.int32,
+        )
+        isz = np.asarray(
+            [usizes[i] for i in range(n) if usizes[i] > 0], np.int32
+        )
+        starts = np.asarray(
+            [out_offsets[i] for i in range(n) if usizes[i] > 0], np.int32
+        )
+        from ..utils.tracing import METRICS
+
+        dev_flat = _device_flatten(
+            dev2d, jnp.asarray(lane_of), jnp.asarray(starts),
+            jnp.asarray(isz), int(out_offsets[-1]),
+        )
+        METRICS.count("flate.inflate_device_residency", 1)
+    return out, out_offsets, dev_flat
 
 
 def _pow2_at_least(n: int, lo: int) -> int:
@@ -1225,10 +1390,19 @@ def bgzf_compress_device(
        original XLA emit — valid DEFLATE, ratio traded for zero host CPU
        and zero serial device work.
 
-    ``block_payload`` defaults per tier (``DEV_LZ_PAYLOAD`` for the lanes
-    encoder, ``DEV_DEFAULT_PAYLOAD`` otherwise); per-block CRC32 runs
-    over slices of the original contiguous input, and the stream is
-    assembled in one preallocated buffer."""
+    ``block_payload`` defaults per tier (``DEV_LZ_PAYLOAD`` — full-size
+    streaming members — for the lanes encoder, ``DEV_DEFAULT_PAYLOAD``
+    otherwise); per-block CRC32 runs over slices of the original
+    contiguous input, and the stream is assembled in one preallocated
+    buffer.
+
+    Per-call tier accounting lands in :data:`LAST_DEFLATE_STATS` (and the
+    ``flate.deflate.*`` METRICS counters): members per tier plus the
+    size/vmem/ok0 tier-down taxonomy out of the lanes tier."""
+    global LAST_DEFLATE_STATS
+
+    stats = CodecTierStats()
+    LAST_DEFLATE_STATS = stats
     a = np.frombuffer(data, dtype=np.uint8) if not isinstance(
         data, np.ndarray
     ) else data
@@ -1264,6 +1438,7 @@ def bgzf_compress_device(
                 + a[s : s + ln].tobytes()
             )
             clens[i] = 5 + ln
+        stats.host += nblk
     else:
         P = max(int(lens.max()), 1)
         mat = np.zeros((nblk, P), dtype=np.uint8)
@@ -1275,17 +1450,28 @@ def bgzf_compress_device(
             from ..utils.tracing import METRICS
             from .pallas.deflate_lanes import deflate_lanes
 
-            try:
-                comp, cl, ok = deflate_lanes(mat, lens)
-            except Exception:
-                METRICS.count("flate.deflate_lanes_launch_error", 1)
+            accepted, reason = deflate_lanes_accepts(int(lens.max()))
+            if not accepted:
+                if reason == "size":
+                    stats.tierdown_size += nblk
+                else:
+                    stats.tierdown_vmem += nblk
                 ok = np.zeros(nblk, dtype=bool)
+            else:
+                try:
+                    comp, cl, ok = deflate_lanes(mat, lens)
+                except Exception:
+                    METRICS.count("flate.deflate_lanes_launch_error", 1)
+                    ok = np.zeros(nblk, dtype=bool)
+                stats.tierdown_ok0 += int((~ok).sum())
+            stats.lanes += int(ok.sum())
             if ok.any():
                 clens[:] = cl
                 done = True
             n_down = int((~ok).sum())
             if n_down:
                 METRICS.count("flate.deflate_lanes_tierdown", n_down)
+                stats.host += n_down
                 for i in np.nonzero(~ok)[0]:
                     overrides[int(i)] = _host_raw_deflate(
                         mat[i, : lens[i]], level
@@ -1295,6 +1481,8 @@ def bgzf_compress_device(
         if not done:
             comp, cl = _deflate_fixed_rows(mat, lens)
             clens[:] = cl
+            stats.xla += nblk
+    stats.publish("flate.deflate")
 
     # ---- framing: one preallocated pass, CRC over the input itself -----
     total = int((18 + 8) * nblk + clens.sum())
@@ -1376,8 +1564,16 @@ def bgzf_decompress_device(
     zlib — same data, same result, tiered like the split planner
     (BAMInputFormat.java:244-258).  The chain is lanes → XLA → host and
     correctness never depends on a device tier.  ``_force_no_host`` turns
-    the last tier into an error (device-only mode, used by tests)."""
+    the last tier into an error (device-only mode, used by tests).
+
+    Per-call tier accounting lands in :data:`LAST_INFLATE_STATS` (and the
+    ``flate.inflate.*`` METRICS counters): members per tier plus the
+    size/vmem/ok0 tier-down taxonomy out of the lanes tier."""
+    global LAST_INFLATE_STATS
     from .. import native
+
+    stats = CodecTierStats()
+    LAST_INFLATE_STATS = stats
 
     raw = np.frombuffer(data, dtype=np.uint8) if not isinstance(
         data, np.ndarray
@@ -1420,7 +1616,10 @@ def bgzf_decompress_device(
         else []
     )
     if lanes_idx:
-        decoded, _ = _lanes_decode_members(raw, co, cs, xlen, lanes_idx, us)
+        decoded, _, _ = _lanes_decode_members(
+            raw, co, cs, xlen, lanes_idx, us, stats=stats
+        )
+        stats.lanes += len(decoded)
         for i, payload in decoded.items():
             outs[i] = payload
         for kind in groups:
@@ -1477,6 +1676,7 @@ def bgzf_decompress_device(
                 for k, i in enumerate(gi):
                     if ok_l[k]:
                         outs[i] = out_l[k, : gz[k]].tobytes()
+                        stats.lanes += 1
                 if all_ok:
                     continue
                 METRICS.count(
@@ -1505,6 +1705,7 @@ def bgzf_decompress_device(
                     continue
                 if ok[k]:
                     outs[i] = out_d[k, : gz[k]].tobytes()
+                    stats.xla += 1
                 elif kind != "dyn":
                     # Routing by the first block's btype is best-effort:
                     # zlib may mix block flavors inside one member (e.g. a
@@ -1524,6 +1725,8 @@ def bgzf_decompress_device(
                         member.tobytes(), 0, check_crc
                     )
                     outs[i] = payload
+                    stats.host += 1
+    stats.publish("flate.inflate")
     if check_crc:
         for i in range(nblk):
             if us[i] == 0:

@@ -1,4 +1,5 @@
-"""Lockstep-lane Pallas DEFLATE *encoder*: LZ77 match-finding on chip.
+"""Lockstep-lane Pallas DEFLATE *encoder*: LZ77 match-finding on chip,
+HBM-streaming token emit.
 
 The symmetric counterpart to ops/pallas/inflate_lanes.py, and the removal
 of the last codec stage still host-bound (BENCH_NOTES standing ranking:
@@ -16,32 +17,45 @@ selects are dense iota-compare column reductions, never gathers):
 - member payloads live TRANSPOSED in VMEM ([words, 128]: member j's words
   go down lane j); "read 4 bytes at my cursor" is two one-hot row selects;
 - per-lane hash tables (4-byte hash heads, two generations for bounded
-  chain probes) live as [H, 128] columns; probe and insert are one-hot
-  row selects/updates;
+  chain probes) live as [H, 128] scratch columns that persist across grid
+  steps, so the match window spans everything already scanned (clamped to
+  DEFLATE's 32 KiB distance domain at probe time);
 - match-finding is a state machine in lockstep waves: every wave each
   live lane either (a) hashes the 4 bytes at its cursor, probes the two
   head generations, and on a 32-bit match enters extend mode, else emits
   one literal token; or (b) extends its current match word-at-a-time
   (XOR + leading-equal-byte count) until mismatch / member end /
-  MAX_MATCH, then emits one copy token (min match 4, window = the whole
-  member — members are capped well inside DEFLATE's 32 KiB window);
-- tokens pack one per int32 ([T, 128] columns): literals as the byte
-  value, copies as ``(1<<30) | (len<<15) | dist``;
-- the fixed-Huffman bit pack runs as a plain XLA program on the token
-  columns (device-to-device — tokens never bounce through the host):
-  per-token LSB-first bit patterns (≤31 bits: length code + extra +
-  distance code + extra) → cumsum bit offsets → searchsorted per output
-  bit → byte pack, exactly the :func:`ops.flate.deflate_fixed` shape.
+  MAX_MATCH, then emits one copy token (min match 4);
+- **streaming geometry**: the kernel grids over fixed-size INPUT chunks
+  (``chunk_bytes`` of payload per lane per grid step).  Tokens emitted
+  during a step land in that step's token tile (one per int32 row:
+  literals as the byte value, copies as ``(1<<30)|(len<<16)|dist``) which
+  streams out to the HBM-backed token array as the grid advances; a
+  per-step count row records how many rows of each tile are live.  The
+  per-lane cursor/match state persists in scratch, so a match may start
+  in one chunk and emit in the next — only the token *tiles* are bounded,
+  never the member;
+- the ragged per-chunk token segments are re-compacted device-side (a
+  cumsum + searchsorted + one take_along_axis gather — no host bounce)
+  and the fixed-Huffman bit pack runs as a plain XLA program on the
+  compacted token rows, exactly the :func:`ops.flate.deflate_fixed`
+  shape.
 
-Per-member ``[c_len, ok]`` meta comes back with the payload so a member
-whose geometry exceeds the VMEM budget (or an explicit ``max_clen``
-output budget) tiers down to the literal-only / host-zlib paths without
-dooming its launch.  Output is bit-exact decodable by native zlib and by
-``inflate_lanes`` (fixed-Huffman blocks, in-window distances).
+A full-size BGZF member payload (up to ``_MAX_MEMBER`` = 64 KiB, which
+covers the ~57 KiB ``DEV_MAX_PAYLOAD`` blocking the part writer uses) now
+encodes on the lanes tier; the old whole-member token-column geometry
+capped members at 32 KiB and in practice tiered everything past 4 KiB
+down to host zlib.  Per-member ``[c_len, ok]`` meta still comes back with
+the payload so a member past the cap or the VMEM budget (or an explicit
+``max_clen`` output budget) tiers down to the literal-only / host-zlib
+paths without dooming its launch.  Output is bit-exact decodable by
+native zlib and by ``inflate_lanes`` (fixed-Huffman blocks, in-window
+distances).
 
-Oracle: zlib via tests/test_deflate_lanes.py; tests run the kernel in
-interpret mode on CPU and cross-check through ``zlib.decompressobj`` and
-the lanes decoder byte-for-byte.
+Oracle: zlib via tests/test_deflate_lanes.py and the streaming corpus in
+tests/test_stream_codecs.py; tests run the kernel in interpret mode on
+CPU and cross-check through ``zlib.decompressobj`` and the lanes decoder
+byte-for-byte.
 """
 
 from __future__ import annotations
@@ -64,46 +78,107 @@ LANES = 128
 MIN_MATCH = 4
 MAX_MATCH = 258
 
-#: Hard cap on member payload bytes: the copy-token dist field is 15 bits
-#: and the whole member doubles as the LZ77 window.
-_MAX_MEMBER = 1 << 15
+#: DEFLATE's distance domain: matches may reach at most 32 KiB back.
+_MAX_DIST = 1 << 15
+
+#: Hard cap on member payload bytes: the copy-token dist field is 16 bits
+#: (distances themselves are clamped to ``_MAX_DIST`` at probe time), so
+#: the member size is bounded only by the token field widths and the
+#: streaming geometry — 64 KiB covers the BGZF payload maximum.
+_MAX_MEMBER = 1 << 16
 
 #: Hash-table rows per generation (two generations = bounded chain probes).
 _HASH_ROWS = 2048
 
-#: VMEM budget for one launch (streams + heads + token columns, double
-#: counted for while-loop carry ping-pong).  Members whose geometry
-#: exceeds it come back ok=False and tier down to the literal/host paths.
-_VMEM_BUDGET_BYTES = 10 << 20
+#: VMEM budget for one launch (streams + heads + one token tile).
+#: ~16 MiB/core physical on the target parts; leave compiler headroom.
+#: Members whose geometry exceeds it come back ok=False and tier down.
+_VMEM_BUDGET_BYTES = 14 << 20
+
+#: Default input chunk per lane per grid step.
+_DEFAULT_CHUNK = 4096
+
+# Packed per-lane register rows in the ``st`` scratch bank.
+_R_CUR = 0    # input byte cursor
+_R_MODE = 1   # 1 = extending a match
+_R_MPOS = 2   # match source position
+_R_MLEN = 3   # match length so far
+_R_NTOK = 4   # tokens emitted (member total)
+_ST_ROWS = 8
 
 
-def _geometry(P: int) -> Tuple[int, int, int, int]:
-    """(W stream words, H hash rows, TOK token rows, T_WAVES) for a pow2
-    member capacity ``P``."""
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _geometry(P: int, chunk: int) -> dict:
+    """Static launch geometry for a member capacity ``P`` (a multiple of
+    ``chunk``): resident stream words, hash rows, token tile rows, grid
+    depth and the per-step wave budget."""
     W = P // 4 + 8
-    H = min(_HASH_ROWS, P)
-    TOK = P
-    T_WAVES = P + 8
-    return W, H, TOK, T_WAVES
+    H = min(_HASH_ROWS, max(256, P))
+    n_chunks = max(1, P // chunk)
+    tok_tile = chunk + 8
+    t_step = 2 * chunk + 96
+    return {
+        "w": W,
+        "h": H,
+        "n_chunks": n_chunks,
+        "tok_tile": tok_tile,
+        "t_step": t_step,
+        "chunk": chunk,
+    }
 
 
-def _vmem_bytes(P: int) -> int:
-    W, H, TOK, _ = _geometry(P)
-    return (W + 2 * H + 2 * TOK + 64) * LANES * 4
+def _vmem_bytes(P: int, chunk: int = _DEFAULT_CHUNK) -> int:
+    g = _geometry(P, chunk)
+    return (
+        g["w"] + 2 * g["h"] + g["tok_tile"] + _ST_ROWS + 512
+    ) * LANES * 4
 
 
-def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
+def accepts(max_plen: int, chunk_bytes: int = _DEFAULT_CHUNK) -> Tuple[bool, str]:
+    """Would the streaming lanes encoder take a member of this payload
+    size?  Pure host logic; ``(True, "")`` or ``(False, reason)`` with
+    reason in ``{"size", "vmem"}``.  Full-size BGZF payloads (up to the
+    part writer's ``DEV_MAX_PAYLOAD`` blocking) are accepted."""
+    if max_plen > _MAX_MEMBER:
+        return False, "size"
+    P = _round_up(max(max_plen, 1), chunk_bytes)
+    if _vmem_bytes(P, chunk_bytes) > _VMEM_BUDGET_BYTES:
+        return False, "vmem"
+    return True, ""
+
+
+def _kernel_factory(
+    W: int, H: int, TOK_TILE: int, IC_BYTES: int, T_STEP: int
+):
     """One lockstep LZ77 match-finding wave per loop step; every live lane
-    emits at most one token per wave, so the wave budget is bounded by the
-    member byte length (literals advance 1 byte/wave; a copy of length L
-    costs ≤ L waves end to end)."""
+    emits at most one token per wave.  Per grid step a lane advances its
+    cursor to the step's input chunk boundary (matches may overrun it);
+    the wave budget is bounded by the chunk size (literals advance 1
+    byte/wave; a copy of length L costs ≤ L/4 + 2 waves end to end)."""
     HB = H.bit_length() - 1
 
-    def kernel(streams_ref, plen_ref, tok_ref, ntok_ref, ok_ref):
+    def kernel(
+        streams_ref, plen_ref, tok_ref, cnt_ref, ntok_ref, ok_ref,
+        h1_ref, h2_ref, st_ref,
+    ):
+        k = pl.program_id(0)
         rows_W = lax.broadcasted_iota(jnp.int32, (W, LANES), 0)
         rows_H = lax.broadcasted_iota(jnp.int32, (H, LANES), 0)
-        rows_T = lax.broadcasted_iota(jnp.int32, (TOK, LANES), 0)
+        rows_T = lax.broadcasted_iota(jnp.int32, (TOK_TILE, LANES), 0)
+        rows_st = lax.broadcasted_iota(jnp.int32, (_ST_ROWS, LANES), 0)
         plen = plen_ref[:, :]
+
+        @pl.when(k == 0)
+        def _init():
+            h1_ref[:, :] = jnp.zeros((H, LANES), jnp.int32)
+            h2_ref[:, :] = jnp.zeros((H, LANES), jnp.int32)
+            st_ref[:, :] = jnp.zeros((_ST_ROWS, LANES), jnp.int32)
+
+        tok_ref[:, :] = jnp.zeros((TOK_TILE, LANES), jnp.int32)
+        chunk_end = (k + 1) * IC_BYTES
 
         def word_at(widx):
             onehot = rows_W == widx
@@ -122,11 +197,20 @@ def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
             w1 = word_at(widx + 1)
             return jnp.where(sh == 0, w0, (w0 >> sh) | (w1 << (32 - sh)))
 
-        def body(st):
-            (it, cur, mode, mpos, mlen, ntok, toks, h1, h2, done) = st
-            active = ~done
-            extending = active & mode
-            scanning = active & ~mode
+        st = st_ref[:, :]
+        cur0 = st[_R_CUR : _R_CUR + 1, :]
+        mode0 = st[_R_MODE : _R_MODE + 1, :] == 1
+        mpos0 = st[_R_MPOS : _R_MPOS + 1, :]
+        mlen0 = st[_R_MLEN : _R_MLEN + 1, :]
+        ntok0 = st[_R_NTOK : _R_NTOK + 1, :]
+        tok_base = ntok0
+
+        def body(s):
+            (it, cur, mode, mpos, mlen, ntok) = s
+            finished = cur >= plen
+            capacity = (ntok - tok_base) < TOK_TILE
+            extending = ~finished & capacity & mode
+            scanning = ~finished & capacity & ~mode & (cur < chunk_end)
 
             # Shared window read: scan lanes look at their cursor, extend
             # lanes at the next 4 bytes past the match so far.
@@ -137,21 +221,24 @@ def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
             hsh = (
                 (wa * jnp.uint32(0x9E3779B1)) >> jnp.uint32(32 - HB)
             ).astype(jnp.int32)
+            h1v = h1_ref[:, :]
+            h2v = h2_ref[:, :]
             sel1 = jnp.sum(
-                jnp.where(rows_H == hsh, h1, 0), axis=0, keepdims=True
+                jnp.where(rows_H == hsh, h1v, 0), axis=0, keepdims=True
             )
             sel2 = jnp.sum(
-                jnp.where(rows_H == hsh, h2, 0), axis=0, keepdims=True
+                jnp.where(rows_H == hsh, h2v, 0), axis=0, keepdims=True
             )
             upd = (rows_H == hsh) & canh
-            h2 = jnp.where(upd, sel1, h2)  # age the previous head
-            h1 = jnp.where(upd, cur + 1, h1)  # pos+1; 0 = empty
+            h2_ref[:, :] = jnp.where(upd, sel1, h2v)  # age the prev head
+            h1_ref[:, :] = jnp.where(upd, cur + 1, h1v)  # pos+1; 0 = empty
             c1 = sel1 - 1
             c2 = sel2 - 1
             wc1 = bytes4_at(c1)
             wc2 = bytes4_at(c2)
-            m1 = canh & (c1 >= 0) & (wc1 == wa)
-            m2 = canh & (c2 >= 0) & (wc2 == wa)
+            # Candidates must sit inside DEFLATE's 32 KiB distance window.
+            m1 = canh & (c1 >= 0) & (cur - c1 <= _MAX_DIST) & (wc1 == wa)
+            m2 = canh & (c2 >= 0) & (cur - c2 <= _MAX_DIST) & (wc2 == wa)
             mstart = m1 | m2
             mp_new = jnp.where(m1, c1, c2)  # prefer the nearer candidate
 
@@ -179,10 +266,13 @@ def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
             # ---- token emit (at most one per lane per wave) ------------
             emit_lit = scanning & ~mstart
             lit = (wa & 0xFF).astype(jnp.int32)
-            cpy = (jnp.int32(1) << 30) | (mlen2 << 15) | (cur - mpos)
+            cpy = (jnp.int32(1) << 30) | (mlen2 << 16) | (cur - mpos)
             tv = jnp.where(ext_done, cpy, lit)
             emit = emit_lit | ext_done
-            toks = jnp.where((rows_T == ntok) & emit, tv, toks)
+            trow = ntok - tok_base
+            tok_ref[:, :] = jnp.where(
+                (rows_T == trow) & emit, tv, tok_ref[:, :]
+            )
             ntok = ntok + emit.astype(jnp.int32)
             cur = (
                 cur
@@ -194,66 +284,119 @@ def _kernel_factory(W: int, H: int, TOK: int, T_WAVES: int):
             mlen = jnp.where(
                 mstart, MIN_MATCH, jnp.where(extending, mlen2, mlen)
             )
-            done = done | (cur >= plen)
-            return (it + 1, cur, mode, mpos, mlen, ntok, toks, h1, h2, done)
+            return (it + 1, cur, mode, mpos, mlen, ntok)
 
-        def cond(st):
-            return (st[0] < T_WAVES) & jnp.any(~st[9])
+        def cond(s):
+            (it, cur, mode, mpos, mlen, ntok) = s
+            act = (cur < plen) & ((ntok - tok_base) < TOK_TILE) & (
+                mode | (cur < chunk_end)
+            )
+            return (it < T_STEP) & jnp.any(act)
 
-        zeros = jnp.zeros((1, LANES), jnp.int32)
-        (_, cur, _, _, _, ntok, toks, _, _, done) = lax.while_loop(
-            cond,
-            body,
-            (
-                jnp.int32(0),
-                zeros,
-                jnp.zeros((1, LANES), bool),
-                zeros,
-                zeros,
-                zeros,
-                jnp.zeros((TOK, LANES), jnp.int32),
-                jnp.zeros((H, LANES), jnp.int32),
-                jnp.zeros((H, LANES), jnp.int32),
-                plen <= 0,
-            ),
+        (_, cur, mode, mpos, mlen, ntok) = lax.while_loop(
+            cond, body, (jnp.int32(0), cur0, mode0, mpos0, mlen0, ntok0)
         )
-        ok = done & (cur == plen)
-        tok_ref[:, :] = toks
+
+        stw = jnp.zeros((_ST_ROWS, LANES), jnp.int32)
+
+        def setreg(stw, r, v):
+            return jnp.where(
+                rows_st == r, jnp.broadcast_to(v, stw.shape), stw
+            )
+
+        stw = setreg(stw, _R_CUR, cur)
+        stw = setreg(stw, _R_MODE, mode.astype(jnp.int32))
+        stw = setreg(stw, _R_MPOS, mpos)
+        stw = setreg(stw, _R_MLEN, mlen)
+        stw = setreg(stw, _R_NTOK, ntok)
+        st_ref[:, :] = stw
+        cnt_ref[:, :] = ntok - tok_base
         ntok_ref[:, :] = ntok
-        ok_ref[:, :] = ok.astype(jnp.int32)
+        ok_ref[:, :] = (cur == plen).astype(jnp.int32)
 
     return kernel
 
 
 @functools.partial(
-    jax.jit, static_argnames=("w", "h", "tok", "t_waves", "interpret")
+    jax.jit,
+    static_argnames=("w", "h", "n_chunks", "tok_tile", "chunk", "t_step",
+                     "interpret"),
 )
-def _launch(streams, plens, w: int, h: int, tok: int, t_waves: int,
-            interpret: bool):
-    kernel = _kernel_factory(w, h, tok, t_waves)
+def _launch(streams, plens, w: int, h: int, n_chunks: int, tok_tile: int,
+            chunk: int, t_step: int, interpret: bool):
+    kernel = _kernel_factory(w, h, tok_tile, chunk, t_step)
     return pl.pallas_call(
         kernel,
+        grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
-        out_specs=tuple(
-            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(3)
+        out_specs=(
+            pl.BlockSpec(
+                (tok_tile, LANES), lambda k: (k, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, LANES), lambda k: (k, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, LANES), lambda k: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, LANES), lambda k: (0, 0), memory_space=pltpu.VMEM
+            ),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((tok, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks * tok_tile, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks, LANES), jnp.int32),
             jax.ShapeDtypeStruct((1, LANES), jnp.int32),
             jax.ShapeDtypeStruct((1, LANES), jnp.int32),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((h, LANES), jnp.int32),
+            pltpu.VMEM((h, LANES), jnp.int32),
+            pltpu.VMEM((_ST_ROWS, LANES), jnp.int32),
+        ],
         interpret=interpret,
     )(streams, plens)
 
 
 # --------------------------------------------------------------------------
-# Token → fixed-Huffman bit pack: plain XLA, the deflate_fixed gather-only
-# emit lifted from bytes to tokens.  Runs on the kernel's token columns
-# device-to-device; no Pallas needed (it is embarrassingly parallel).
+# Ragged token compaction + fixed-Huffman bit pack: plain XLA on the
+# kernel's chunked token tiles, device-to-device — tokens never bounce
+# through the host.
 # --------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _compact_tokens(tok_flat: jax.Array, cnts: jax.Array, tok_tile: int,
+                    T: int) -> jax.Array:
+    """Gather the per-chunk ragged token segments into dense per-lane
+    rows.
+
+    ``tok_flat``: int32 [n_chunks*tok_tile, 128] (chunk k's tokens for
+    lane j at rows [k*tok_tile, k*tok_tile+cnts[k,j])), ``cnts``: int32
+    [n_chunks, 128].  Returns int32 [128, T] (rows = lanes; garbage past
+    each lane's total count — the emit masks by ntok)."""
+    cum = jnp.cumsum(cnts, axis=0)  # [n_chunks, 128]
+    t = jnp.arange(T, dtype=jnp.int32)
+    # Chunk holding token t of each lane, then its offset inside it.
+    ch = jax.vmap(functools.partial(jnp.searchsorted, side="right"))(
+        cum.T, jnp.broadcast_to(t, (LANES, T))
+    ).astype(jnp.int32)  # [128, T]
+    n_chunks = cnts.shape[0]
+    ch_c = jnp.clip(ch, 0, n_chunks - 1)
+    prev = jnp.where(
+        ch_c > 0,
+        jnp.take_along_axis(
+            cum.T, jnp.maximum(ch_c - 1, 0), axis=1
+        ),
+        0,
+    )
+    row = ch_c * tok_tile + (t[None, :] - prev)
+    row = jnp.clip(row, 0, tok_flat.shape[0] - 1)
+    return jnp.take_along_axis(tok_flat, row.T, axis=0).T
 
 
 def _rev_var(code, n, width: int):
@@ -270,7 +413,7 @@ def _emit_tokens_fixed(tokens: jax.Array, ntok: jax.Array, out_bytes: int):
     """Pack token streams into final fixed-Huffman DEFLATE members.
 
     ``tokens``: int32 [b, T] packed (lit: byte value; copy:
-    ``(1<<30)|(len<<15)|dist``), ``ntok``: int32 [b] live token counts
+    ``(1<<30)|(len<<16)|dist``), ``ntok``: int32 [b] live token counts
     (the EOB is appended at index ntok, so T must be ≥ max(ntok)+1).
     Returns (comp uint8 [b, out_bytes], clens int32 [b]).
     """
@@ -282,8 +425,8 @@ def _emit_tokens_fixed(tokens: jax.Array, ntok: jax.Array, out_bytes: int):
 
     is_cpy = (tokens >> 30) & 1 == 1
     v = tokens & 0xFF
-    L = (tokens >> 15) & 0x1FF
-    D = tokens & 0x7FFF
+    L = (tokens >> 16) & 0x1FF
+    D = tokens & 0xFFFF
     # Literal codeword (RFC 1951 §3.2.6).
     lit_hi = v >= 144
     lit_code = jnp.where(lit_hi, 0x190 + (v - 144), 0x30 + v)
@@ -353,25 +496,33 @@ def _out_bytes(P: int) -> int:
     return (3 + 9 * P + 7 + 7) // 8 + 1
 
 
+def _pow2_at_least(n: int, lo: int) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
 def deflate_lanes(
     payload: np.ndarray,
     lens: np.ndarray,
     max_clen: Optional[int] = None,
+    chunk_bytes: int = _DEFAULT_CHUNK,
     interpret=None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Batched lockstep LZ77 + fixed-Huffman DEFLATE of member payloads,
-    128 members per kernel launch.
+    128 members per kernel launch, token stream chunked out to HBM.
 
     ``payload`` uint8 [B, P] (rows zero-padded), ``lens`` int32 [B].
     Returns ``(comp uint8 [B, out_bytes], clens int32 [B], ok bool [B])``
     — every compressed row is a complete final DEFLATE member (header +
     tokens + EOB) decodable by ``zlib.decompressobj(-15)`` and by
-    ``inflate_lanes``.  A member whose geometry exceeds the VMEM budget
-    or the 15-bit distance domain, or whose compressed size exceeds
-    ``max_clen``, comes back ``ok=False`` and the caller tiers down to
-    the literal-only / host-zlib encoders.
-    """
-    from ..flate import _MAX_LAUNCH_ELEMS, _pow2_at_least
+    ``inflate_lanes``.  Full-size BGZF payloads (≤ ``_MAX_MEMBER``) ride
+    the streaming geometry; a member past the cap or the VMEM budget, or
+    whose compressed size exceeds ``max_clen``, comes back ``ok=False``
+    and the caller tiers down to the literal-only / host-zlib encoders.
+    ``chunk_bytes`` sets the per-lane input chunk per grid step."""
+    from ..flate import _MAX_LAUNCH_ELEMS
 
     B = payload.shape[0]
     if B == 0:
@@ -382,14 +533,14 @@ def deflate_lanes(
         )
     lens = np.asarray(lens, dtype=np.int32)
     max_len = int(lens.max()) if len(lens) else 0
-    P = _pow2_at_least(max(max_len, 1), 256)
+    P = _round_up(max(max_len, 1), chunk_bytes)
     out_bytes = _out_bytes(P)
     comp = np.zeros((B, out_bytes), dtype=np.uint8)
     clens = np.zeros(B, dtype=np.int32)
     ok_all = np.zeros(B, dtype=bool)
-    if P > _MAX_MEMBER or _vmem_bytes(P) > _VMEM_BUDGET_BYTES:
+    if max_len > _MAX_MEMBER or _vmem_bytes(P, chunk_bytes) > _VMEM_BUDGET_BYTES:
         return comp, clens, ok_all
-    W, H, TOK, T_WAVES = _geometry(P)
+    g = _geometry(P, chunk_bytes)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     NB = out_bytes * 8
@@ -398,22 +549,26 @@ def deflate_lanes(
         g1 = min(B, g0 + LANES)
         n = g1 - g0
         # Transpose the group: member j's words go down lane j.
-        grp = np.zeros((W * 4, LANES), dtype=np.uint8)
+        grp = np.zeros((g["w"] * 4, LANES), dtype=np.uint8)
         grp[: payload.shape[1], :n] = payload[g0:g1].T
         words = (
-            grp.reshape(W, 4, LANES).astype(np.uint32)
+            grp.reshape(g["w"], 4, LANES).astype(np.uint32)
             * (np.uint32(1) << (8 * np.arange(4, dtype=np.uint32)))[
                 None, :, None
             ]
         ).sum(axis=1).astype(np.uint32).view(np.int32)
         plens = np.zeros((1, LANES), dtype=np.int32)
         plens[0, :n] = lens[g0:g1]
-        toks, ntok, okk = _launch(
-            jnp.asarray(words), jnp.asarray(plens), W, H, TOK, T_WAVES,
+        toks, cnts, ntok, okk = _launch(
+            jnp.asarray(words), jnp.asarray(plens), g["w"], g["h"],
+            g["n_chunks"], g["tok_tile"], g["chunk"], g["t_step"],
             bool(interpret),
         )
-        # Device-side bit pack on the token columns (EOB column appended).
-        tok_bt = jnp.pad(jnp.transpose(toks), ((0, 0), (0, 1)))
+        # Device-side ragged compaction + bit pack (only the small token
+        # counts round-trip to the host, for the static T bucket).
+        ntok_np = np.asarray(ntok)[0]
+        T = _pow2_at_least(int(ntok_np.max()) + 1, 256)
+        tok_bt = _compact_tokens(toks, cnts, g["tok_tile"], T)
         ntok_vec = ntok[0]
         for r0 in range(0, n, emit_step):
             r1 = min(n, r0 + emit_step)
@@ -448,26 +603,22 @@ def bench_deflate_marginal(
     """
     import time
 
-    from ..flate import _pow2_at_least
-
-    P = _pow2_at_least(p_big, 256)
-    W, H, TOK, T_WAVES = _geometry(P)
+    P = _round_up(p_big, _DEFAULT_CHUNK)
+    g = _geometry(P, _DEFAULT_CHUNK)
     rng = np.random.default_rng(0)
     words = jnp.asarray(
-        rng.integers(0, 1 << 31, (W, LANES), dtype=np.int32)
+        rng.integers(0, 1 << 31, (g["w"], LANES), dtype=np.int32)
     )
 
     def timed(n_bytes: int) -> float:
         plens = jnp.full((1, LANES), n_bytes, jnp.int32)
-        jax.block_until_ready(
-            _launch(words, plens, W, H, TOK, T_WAVES, False)
-        )
+        args = (words, plens, g["w"], g["h"], g["n_chunks"],
+                g["tok_tile"], g["chunk"], g["t_step"], False)
+        jax.block_until_ready(_launch(*args))
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
-            jax.block_until_ready(
-                _launch(words, plens, W, H, TOK, T_WAVES, False)
-            )
+            jax.block_until_ready(_launch(*args))
             ts.append(time.perf_counter() - t0)
         return min(ts)
 

@@ -285,6 +285,10 @@ def sort_bam(
                 "rec_off": b.soa["rec_off"],
                 "rec_len": b.soa["rec_len"],
             }
+            if not use_device_parse:
+                # Only the device-parse path consumes the residency
+                # handoff; don't pin HBM with unused split windows.
+                b.device_data = None
             batches.append(b)
             if use_device_parse:
                 # The split's record stream ships to the chip as raw bytes;
@@ -305,6 +309,10 @@ def sort_bam(
                     # the failure and let the sort fall back to host keys.
                     METRICS.count("sort_bam.device_parse_error", 1)
                     parsed.append(False)
+                # The chain kernel has consumed (or declined) the
+                # device-resident window; drop the reference so HBM frees
+                # as the read proceeds instead of pinning every split.
+                b.device_data = None
             elif use_device:
                 pending.append(b.keys)
                 if (si + 1) % upload_every == 0:
@@ -553,8 +561,17 @@ def _device_parse_split(b: RecordBatch):
         # a multi-GiB split_size): host keys for the whole job.
         return False
     n_chunks = max(1, -(-n_bytes // CHUNK))
-    padded = np.zeros(n_chunks * CHUNK + 256 * 4, dtype=np.uint8)
-    padded[:n_bytes] = b.data[s0:s1]
+    pad_len = n_chunks * CHUNK + 256 * 4
+    dd = getattr(b, "device_data", None)
+    if dd is not None:
+        # On-chip output residency: the split's inflated bytes are
+        # already in HBM (left there by the lockstep-lane inflate tier),
+        # so slice+pad on device and skip the h2d upload entirely.
+        padded = jnp.pad(dd[s0:s1], (0, pad_len - n_bytes))
+        METRICS.count("sort_bam.device_parse_residency", 1)
+    else:
+        padded = np.zeros(pad_len, dtype=np.uint8)
+        padded[:n_bytes] = b.data[s0:s1]
     hi, lo, unm, count, ok = keys_from_stream_device(padded, n_bytes)
     meta = jnp.stack(
         [

@@ -39,6 +39,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "device_stream: full-size-member streaming codec kernels "
+        "(HBM-streaming lanes geometry); needs a real accelerator, "
+        "skipped when JAX_PLATFORMS pins cpu",
+    )
+    config.addinivalue_line(
+        "markers",
         "dedup: duplicate-marking subsystem (dedup/) tests; combined "
         "with `tpu` they need a real accelerator and skip under a cpu pin",
     )
@@ -48,8 +54,12 @@ def pytest_collection_modifyitems(config, items):
     """Skip accelerator-only tests cleanly when the environment pins JAX
     to CPU (the tier-1 invocation runs under JAX_PLATFORMS=cpu): their
     subprocess children would only rediscover the pin and fail noisily
-    instead of skipping.  Covers the device-deflate suite and any
-    TPU-marked dedup tests (the plain dedup tests run everywhere)."""
+    instead of skipping.  Covers the device-deflate suite, the
+    full-size-member streaming-kernel suite (``device_stream`` — a 64 KiB
+    member is minutes of interpret-mode emulation but milliseconds on a
+    chip; the interpret-mode smoke in tests/test_stream_codecs.py keeps
+    the streaming geometry covered under the CPU pin), and any TPU-marked
+    dedup tests (the plain dedup tests run everywhere)."""
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
         return
     skip = pytest.mark.skip(
@@ -57,8 +67,10 @@ def pytest_collection_modifyitems(config, items):
         "a real accelerator"
     )
     for item in items:
-        if "device_deflate" in item.keywords or (
-            "dedup" in item.keywords and "tpu" in item.keywords
+        if (
+            "device_deflate" in item.keywords
+            or "device_stream" in item.keywords
+            or ("dedup" in item.keywords and "tpu" in item.keywords)
         ):
             item.add_marker(skip)
 

@@ -104,10 +104,27 @@ def test_output_overflow_tiers_down_ok0():
     assert not ok[0] and ok[1], (ok, clens)
 
 
-def test_geometry_past_vmem_budget_declines():
-    mat = np.zeros((1, 1 << 15), np.uint8)
+def test_geometry_past_member_cap_declines():
+    """The streaming geometry accepts full-size BGZF payloads (the old
+    32 KiB whole-member cap is gone); only members past the 64 KiB token
+    domain decline — cheaply, before any launch."""
+    from hadoop_bam_tpu.ops.pallas.deflate_lanes import _MAX_MEMBER, accepts
+
+    assert accepts(1 << 15)[0]          # old cap now well inside the tier
+    assert accepts(_MAX_MEMBER)[0]
+    n = _MAX_MEMBER + 8
+    mat = np.zeros((1, n), np.uint8)
+    _, _, ok = deflate_lanes(mat, np.array([n], np.int32), interpret=True)
+    assert not ok[0]
+
+
+def test_geometry_past_vmem_budget_declines(monkeypatch):
+    from hadoop_bam_tpu.ops.pallas import deflate_lanes as dl_mod
+
+    monkeypatch.setattr(dl_mod, "_VMEM_BUDGET_BYTES", 1 << 10)
+    mat = np.zeros((1, 2048), np.uint8)
     _, _, ok = deflate_lanes(
-        mat, np.array([1 << 15], np.int32), interpret=True
+        mat, np.array([2048], np.int32), interpret=True
     )
     assert not ok[0]
 
@@ -148,15 +165,18 @@ class TestBgzfCompressDevice:
         # The device decode chain reads its own encoder's output.
         assert flate.bgzf_decompress_device(blob, _force_no_host=True) == data
 
-    def test_lanes_geometry_tierdown_to_host_zlib(self):
+    def test_lanes_geometry_tierdown_to_host_zlib(self, monkeypatch):
+        from hadoop_bam_tpu.ops.pallas import deflate_lanes as dl_mod
         from hadoop_bam_tpu.utils.tracing import METRICS
 
-        data = b"tier down please " * 800  # one ~13.6 KB member
+        data = b"tier down please " * 300  # one ~5.1 KB member
         before = METRICS.report()["counters"].get(
             "flate.deflate_lanes_tierdown", 0
         )
-        # 24000-byte members exceed the encoder's VMEM geometry: every
-        # member must tier down to host zlib, bit-faithfully.
+        # Shrink the VMEM budget so the (otherwise in-cap, post streaming
+        # lift) geometry declines: every member must tier down to host
+        # zlib, bit-faithfully, with the vmem reason counted.
+        monkeypatch.setattr(dl_mod, "_VMEM_BUDGET_BYTES", 1 << 10)
         blob = flate.bgzf_compress_device(
             data, block_payload=24000, conf=LANES_CONF
         )
@@ -165,6 +185,8 @@ class TestBgzfCompressDevice:
             "flate.deflate_lanes_tierdown", 0
         )
         assert after > before
+        assert flate.LAST_DEFLATE_STATS.tierdown_vmem > 0
+        assert flate.LAST_DEFLATE_STATS.lanes == 0
 
     def test_env_var_forces_tier_off(self, monkeypatch):
         monkeypatch.setenv("HBAM_DEFLATE_LANES", "0")
@@ -292,10 +314,13 @@ class TestFuzzZlibOracle:
         payloads = [mat[i].tobytes() for i in range(3)]
         _assert_both_oracles(payloads)
 
-    def test_member_at_lz_payload_cap(self):
-        """A member exactly at DEV_LZ_PAYLOAD (the part-write blocking)."""
-        pat = (b"part-write-cap!!" * 256)[: flate.DEV_LZ_PAYLOAD]
-        assert len(pat) == flate.DEV_LZ_PAYLOAD
+    def test_member_at_chunk_multiple(self):
+        """A member exactly at a streaming-chunk multiple (zero padded
+        slack in the last input tile; the full DEV_LZ_PAYLOAD blocking is
+        covered on-chip by tests/test_stream_codecs.py's device_stream
+        class — ~57 KiB is out of interpret-mode reach)."""
+        pat = (b"part-write-cap!!" * 1024)[:8192]
+        assert len(pat) == 8192
         _assert_both_oracles([pat])
 
 

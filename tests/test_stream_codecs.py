@@ -1,0 +1,482 @@
+"""HBM-streaming lockstep-lane codec geometry (the whole-member-VMEM cap
+lift): zlib is the external oracle throughout, and each direction is also
+oracled through the opposite-direction kernel.
+
+Split per the CI contract: the always-on smoke runs the streaming kernels
+in interpret mode with SMALL chunks (256-1024 bytes), so multi-chunk
+grids, ring wraps, cross-tile LZ77 copies and chunk-boundary block
+retirement — the new failure surface — are exercised cheaply; the
+tier-selection logic is asserted as pure host code (no kernel run); the
+full-size 65,535-byte corpus rides ``slow`` + ``device_stream`` (a 64 KiB
+member is minutes of interpret emulation but milliseconds on a chip, and
+the conftest guard skips it under a JAX_PLATFORMS=cpu pin).
+"""
+
+import io
+import zlib
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.conf import Configuration, DEFLATE_LANES, INFLATE_LANES
+from hadoop_bam_tpu.ops import flate
+from hadoop_bam_tpu.ops.pallas import deflate_lanes as dl_mod
+from hadoop_bam_tpu.ops.pallas import inflate_lanes as il_mod
+from hadoop_bam_tpu.ops.pallas.deflate_lanes import deflate_lanes
+from hadoop_bam_tpu.ops.pallas.inflate_lanes import inflate_lanes
+from hadoop_bam_tpu.spec import bgzf
+
+LANES_CONF = Configuration(
+    {INFLATE_LANES: "true", DEFLATE_LANES: "true"}
+)
+
+
+def _raw_deflate(payload: bytes, level: int = 6) -> bytes:
+    co = zlib.compressobj(level, zlib.DEFLATED, -15)
+    return co.compress(payload) + co.flush()
+
+
+def _inflate_batch(comps, payloads, **kw):
+    C = max(len(c) for c in comps)
+    comp = np.zeros((len(comps), C), np.uint8)
+    clens = np.zeros(len(comps), np.int32)
+    isz = np.zeros(len(comps), np.int32)
+    for i, c in enumerate(comps):
+        comp[i, : len(c)] = np.frombuffer(c, np.uint8)
+        clens[i] = len(c)
+        isz[i] = len(payloads[i])
+    return inflate_lanes(comp, clens, isz, interpret=True, **kw)
+
+
+def _assert_inflate_oracle(comps, payloads, **kw):
+    out, ok = _inflate_batch(comps, payloads, **kw)
+    assert ok.all(), ok
+    for i, p in enumerate(payloads):
+        assert out[i, : len(p)].tobytes() == p, f"member {i} mismatch"
+
+
+def _deflate_batch(payloads, **kw):
+    P = max(max((len(p) for p in payloads), default=1), 1)
+    mat = np.zeros((len(payloads), P), np.uint8)
+    lens = np.zeros(len(payloads), np.int32)
+    for i, p in enumerate(payloads):
+        mat[i, : len(p)] = np.frombuffer(p, np.uint8)
+        lens[i] = len(p)
+    return deflate_lanes(mat, lens, interpret=True, **kw)
+
+
+def _assert_deflate_both_oracles(payloads, chunk_bytes=1024):
+    comp, clens, ok = _deflate_batch(payloads, chunk_bytes=chunk_bytes)
+    assert ok.all(), ok
+    for i, p in enumerate(payloads):
+        d = zlib.decompressobj(-15)
+        assert d.decompress(comp[i, : clens[i]].tobytes()) == p, i
+        assert d.eof, i
+    isz = np.asarray([len(p) for p in payloads], np.int32)
+    out2, ok2 = inflate_lanes(
+        comp[:, : max(int(clens.max()), 1)], clens.astype(np.int32), isz,
+        interpret=True, chunk_bytes=chunk_bytes,
+    )
+    assert ok2.all(), ok2
+    for i, p in enumerate(payloads):
+        assert out2[i, : len(p)].tobytes() == p, i
+    return comp, clens
+
+
+class TestStreamingDecoderSmoke:
+    """Multi-chunk decode paths at chunk_bytes=512.  The whole corpus —
+    block mixes, chunk-edge EOBs, flush-chain seams, a corrupt member —
+    rides ONE batch so the launch geometry compiles once; only the
+    windowed far-copy config (different ring) needs a second."""
+
+    def test_multi_chunk_corpus_zlib_oracle(self):
+        rng = np.random.default_rng(0)
+        a = b"ACGTACGT" * 90
+        b_ = bytes(rng.integers(0, 256, 700, dtype=np.uint8))
+        co = zlib.compressobj(6, zlib.DEFLATED, -15)
+        flush_chain = (
+            co.compress(a) + co.flush(zlib.Z_FULL_FLUSH)
+            + co.compress(b_) + co.flush()
+        )
+        good = b"good data here " * 70
+        cg = _raw_deflate(good)
+        payloads = [
+            (b"@SQ\tSN:chr7\tLN:10000\n" * 80),            # text, copies
+            bytes(rng.integers(0, 256, 1700, dtype=np.uint8)),  # stored-ish
+            b"\x00" * 1650,                                 # RLE dist-1 runs
+            (b"GATTACA-" * 220)[:1760],                      # periodic motif
+            # Exactly chunk-aligned output: the final EOB lands past the
+            # last full tile, so only the epilogue grid step retires it.
+            bytes(rng.integers(0, 256, 1024, dtype=np.uint8)),
+            a + b_,                                          # block seams
+            good,                                            # batch mate
+            good,  # slot 7 gets the corrupted copy of cg below
+        ]
+        comps = [
+            _raw_deflate(payloads[0], 9),
+            _raw_deflate(payloads[1], 0),
+            _raw_deflate(payloads[2], 1),
+            _raw_deflate(payloads[3], 6),
+            _raw_deflate(payloads[4], 6),
+            flush_chain,
+            cg,
+            bytes([0b111]) + cg[1:],  # reserved BTYPE: must tier down
+        ]
+        out, ok = _inflate_batch(comps, payloads, chunk_bytes=512)
+        assert ok[:7].all() and not ok[7], ok
+        for i in range(7):
+            p = payloads[i]
+            assert out[i, : len(p)].tobytes() == p, f"member {i} mismatch"
+
+    def test_copy_spans_tile_boundary(self):
+        """An LZ77 copy whose destination crosses the output tile edge —
+        the copy state must carry across the grid step."""
+        rng = np.random.default_rng(1)
+        lits = bytes(rng.integers(0, 256, 500, dtype=np.uint8))
+        toks = [("lit", b) for b in lits]
+        toks.append(("copy", 200, 450))  # dest 500..700 crosses 512
+        toks.append(("copy", 30, 10))    # overlapping copy after the seam
+        comp = flate.encode_tokens_fixed(toks)
+        oracle = zlib.decompressobj(-15).decompress(comp)
+        # Pad the batch to the corpus test's geometry (max isize bucket)
+        # so the launch signature — and its compile — is reused.
+        filler = b"\x00" * 1760
+        _assert_inflate_oracle(
+            [comp, _raw_deflate(filler, 1)], [oracle, filler],
+            chunk_bytes=512,
+        )
+
+    def test_ring_wraps_under_long_member(self):
+        """Resolve ring (512 B here) smaller than the member: the window
+        wraps repeatedly and every tile copy reads a rotated ring slice —
+        the modular-indexing path full 64 KiB members take on chip."""
+        motif = bytes(
+            np.random.default_rng(9).integers(0, 256, 48, dtype=np.uint8)
+        )
+        payload = (motif * 30)[:1200]  # dists ≤ 48, well inside the ring
+        comp = _raw_deflate(payload, 6)
+        _assert_inflate_oracle(
+            [comp], [payload], far_dist=512, chunk_bytes=256
+        )
+
+    def test_windowed_far_copy_replay(self):
+        """far_dist smaller than the member: far copies defer to the
+        host-assisted replay, including across tile seams."""
+        rng = np.random.default_rng(4)
+        head = b"0123456789ABCDEF" * 6
+        mid = bytes(rng.integers(0, 256, 400, dtype=np.uint8))
+        payload = head + mid + head + mid[:100]
+        comp = _raw_deflate(payload, 9)
+        _assert_inflate_oracle(
+            [comp], [payload], far_dist=64, chunk_bytes=256
+        )
+
+
+class TestStreamingEncoderSmoke:
+    """Multi-chunk encode paths at chunk_bytes=1024 (shared geometry)."""
+
+    def test_multi_chunk_corpus_both_oracles(self):
+        rng = np.random.default_rng(5)
+        # A match that starts before an input-chunk seam and keeps
+        # extending past it must emit one token with the full length.
+        head = bytes(rng.integers(0, 256, 990, dtype=np.uint8))
+        cross = head + head[:300] + head[500:900]
+        payloads = [
+            (b"@SQ\tSN:chr1\tLN:12345\n" * 150)[:2500],   # compressible
+            bytes(rng.integers(0, 256, 1800, dtype=np.uint8)),  # random
+            b"\x00" * 2100,                                # zero run
+            b"",                                           # empty member
+            b"ACG",                                        # < MIN_MATCH
+            (b"0123456789ABCDEF" * 200)[:2048],            # exact chunks
+            cross,                                         # seam match
+            b"ping-pong" * 300,                            # tile counts
+        ]
+        comp, clens = _assert_deflate_both_oracles(payloads)
+        assert clens[0] < len(payloads[0]) // 2  # matches actually found
+        assert clens[2] < 32                     # overlap copies, chunked
+        assert clens[3] == 2                     # empty fixed block
+        # The seam-crossing repeat is found, not re-emitted as literals.
+        assert clens[6] < len(cross) - 200
+
+    def test_max_clen_budget_tiers_down_ok0(self):
+        rng = np.random.default_rng(7)
+        rand = bytes(rng.integers(0, 256, 1300, dtype=np.uint8))
+        # Padding member keeps the launch in the corpus test's geometry
+        # bucket (P=3072) so the compile is reused.
+        comp, clens, ok = _deflate_batch(
+            [rand, b"easy " * 260, b"\x00" * 2600], max_clen=600,
+            chunk_bytes=1024,
+        )
+        assert not ok[0] and ok[1] and ok[2], (ok, clens)
+
+
+class TestTierSelection:
+    """Pure host tier-selection logic — no kernel launch, tier-1-safe:
+    the acceptance criterion that a full 64 KiB member routes to the
+    lanes tier instead of tiering down."""
+
+    def test_full_size_member_routes_to_inflate_lanes(self):
+        # The BGZF maximum: 65,535-byte payload, near-incompressible
+        # (compressed stream ~64 KiB) — must be accepted.
+        ok, reason = flate.inflate_lanes_accepts(65516, 65535)
+        assert ok and reason == "", (ok, reason)
+
+    def test_full_size_payload_routes_to_deflate_lanes(self):
+        ok, reason = flate.deflate_lanes_accepts(flate.DEV_LZ_PAYLOAD)
+        assert ok and reason == "", (ok, reason)
+        ok, reason = flate.deflate_lanes_accepts(65535)
+        assert ok, (ok, reason)
+
+    def test_part_write_blocking_is_full_size(self):
+        # The part-write path now cuts members at the BSIZE-safe maximum,
+        # not the old 4 KiB whole-member-VMEM cap.
+        assert flate.DEV_LZ_PAYLOAD == flate.DEV_MAX_PAYLOAD
+        assert flate.DEV_LZ_PAYLOAD > 50000
+
+    def test_oversized_shapes_decline_with_reasons(self):
+        ok, reason = flate.deflate_lanes_accepts((1 << 16) + 1)
+        assert not ok and reason == "size"
+        ok, reason = flate.inflate_lanes_accepts(1000, 2 << 20)
+        assert not ok and reason == "size"
+
+    def test_vmem_budget_declines(self, monkeypatch):
+        monkeypatch.setattr(il_mod, "_VMEM_BUDGET_BYTES", 1 << 10)
+        ok, reason = flate.inflate_lanes_accepts(65516, 65535)
+        assert not ok and reason == "vmem"
+        monkeypatch.setattr(dl_mod, "_VMEM_BUDGET_BYTES", 1 << 10)
+        ok, reason = flate.deflate_lanes_accepts(65535)
+        assert not ok and reason == "vmem"
+
+    def test_stream_geometry_full_size_fits_budget(self):
+        geo = il_mod.stream_geometry(65516, 65535)
+        assert geo["vmem_bytes"] <= il_mod._VMEM_BUDGET_BYTES
+        assert geo["ring_rows"] * 4 == 1 << 15  # full DEFLATE window
+        assert dl_mod._vmem_bytes(1 << 16) <= dl_mod._VMEM_BUDGET_BYTES
+
+
+class TestTierStats:
+    """Per-call tier counters on the codec wrappers (small members, so
+    the interpret-mode kernels stay cheap)."""
+
+    def test_compress_stats_and_counters(self):
+        from hadoop_bam_tpu.utils.tracing import METRICS
+
+        before = METRICS.report()["counters"].get("flate.deflate.lanes", 0)
+        data = (b"@SQ\tSN:chr1\tLN:12345\n" * 150)[:3000]
+        blob = flate.bgzf_compress_device(
+            data, conf=LANES_CONF, block_payload=2048
+        )
+        assert bgzf.decompress_all(blob) == data
+        st = flate.LAST_DEFLATE_STATS
+        assert st.lanes == 2 and st.total == 2
+        assert st.lanes_hit_rate() == 1.0
+        after = METRICS.report()["counters"].get("flate.deflate.lanes", 0)
+        assert after == before + 2
+
+    def test_decompress_stats_hit_rate_one(self):
+        data = (b"@SQ\tSN:chr1\tLN:12345\n" * 150)[:3000]
+        blob = flate.bgzf_compress_device(
+            data, conf=LANES_CONF, block_payload=2048
+        )
+        out = flate.bgzf_decompress_device(blob, conf=LANES_CONF)
+        assert out == data
+        st = flate.LAST_INFLATE_STATS
+        assert st.lanes == 2 and st.lanes_hit_rate() == 1.0
+        assert st.tierdown_size == st.tierdown_vmem == st.tierdown_ok0 == 0
+
+    def test_vmem_tierdown_reason_counted(self, monkeypatch):
+        payload = b"spill to the next tier " * 50
+        blob = bgzf.compress_block(payload, level=6) + bgzf.TERMINATOR
+        monkeypatch.setattr(il_mod, "_VMEM_BUDGET_BYTES", 1 << 10)
+        assert (
+            flate.bgzf_decompress_device(blob, conf=LANES_CONF) == payload
+        )
+        st = flate.LAST_INFLATE_STATS
+        assert st.lanes == 0
+        assert st.tierdown_vmem == 1
+        assert st.xla + st.host == 1  # the member still decoded below
+
+
+class TestDeviceResidency:
+    """The on-chip output-residency handoff: inflated bytes stay in HBM
+    and feed the device-parse chain kernel without a d2h→h2d bounce."""
+
+    def _mini_bam(self):
+        from hadoop_bam_tpu.spec import bam
+
+        refs = [("chr1", 100000)]
+        hdr = bam.BamHeader("@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:100000", refs)
+        recs = [
+            bam.build_record(
+                name=f"r{i}", refid=0, pos=7 * i, mapq=60, flag=0,
+                cigar=[(10, "M")], seq="ACGTACGTAC", qual=bytes([30] * 10),
+            )
+            for i in range(30)
+        ]
+        buf = io.BytesIO()
+        w = bgzf.BgzfWriter(buf, level=1)
+        w.write(hdr.encode())
+        w.write(b"".join(r.encode() for r in recs))
+        w.close()
+        return buf.getvalue()
+
+    def test_inflate_blocks_device_returns_device_copy(self):
+        from hadoop_bam_tpu import native
+
+        data = (b"residency " * 400)[:3500]
+        blob = flate.bgzf_compress_device(
+            data, conf=LANES_CONF, block_payload=2048
+        )
+        raw = np.frombuffer(blob, np.uint8)
+        co, cs, us = native.scan_blocks(raw)
+        live = us > 0
+        out, offs, dev = flate.inflate_blocks_device(
+            raw, co[live], cs[live], us[live], return_device=True
+        )
+        assert out.tobytes() == data
+        assert dev is not None
+        assert np.asarray(dev).tobytes() == data
+
+    def test_read_split_attaches_device_data(self, tmp_path):
+        from hadoop_bam_tpu.io.bam import BamInputFormat
+
+        p = tmp_path / "t.bam"
+        p.write_bytes(self._mini_bam())
+        fmt = BamInputFormat(LANES_CONF)
+        (split,) = fmt.get_splits([str(p)])
+        b = fmt.read_split(split, device_inflate=True)
+        assert b.device_data is not None
+        assert np.asarray(b.device_data).tobytes() == b.data.tobytes()
+
+    def test_device_parse_consumes_residency(self, tmp_path):
+        from hadoop_bam_tpu.io.bam import BamInputFormat
+        from hadoop_bam_tpu.pipeline import _device_parse_split
+        from hadoop_bam_tpu.utils.tracing import METRICS
+
+        p = tmp_path / "t.bam"
+        p.write_bytes(self._mini_bam())
+        fmt = BamInputFormat(LANES_CONF)
+        (split,) = fmt.get_splits([str(p)])
+        b = fmt.read_split(
+            split, device_inflate=True, fields=("rec_off", "rec_len"),
+            with_keys=False,
+        )
+        assert b.device_data is not None
+        before = METRICS.report()["counters"].get(
+            "sort_bam.device_parse_residency", 0
+        )
+        res = _device_parse_split(b)
+        assert res not in (None, False)
+        hi, lo, unm, meta = res
+        meta = np.asarray(meta)
+        assert meta[1] == 1  # chain kernel validated the stream
+        assert meta[0] == b.n_records
+        after = METRICS.report()["counters"].get(
+            "sort_bam.device_parse_residency", 0
+        )
+        assert after == before + 1
+
+
+@pytest.mark.slow
+@pytest.mark.device_stream
+class TestFullSizeMembers:
+    """The acceptance corpus: bit-exact vs native zlib on members up to
+    and including 65,535-byte payloads (the BGZF maximum), including
+    LZ77 copies that cross chunk/tile boundaries.  Needs a real chip —
+    interpret-mode emulation of a 64 KiB member takes minutes, so the
+    conftest guard skips this class under a JAX_PLATFORMS=cpu pin."""
+
+    def _corpus(self):
+        rng = np.random.default_rng(8)
+        from hadoop_bam_tpu.ops.pallas.deflate_lanes import _bam_like_corpus
+
+        bam_like = _bam_like_corpus(1, 65535)[0].tobytes()
+        zero_run = b"\x00" * 65535
+        # Keep the compressed stream inside the u16 BSIZE domain: real
+        # BGZF writers only emit near-full members when they compress.
+        incompressible = bytes(
+            rng.integers(0, 256, 60000, dtype=np.uint8)
+        )
+        far = (bam_like[:32768] + bam_like[:16384] + zero_run)[:65535]
+        return [bam_like, zero_run, incompressible, far]
+
+    def test_decoder_full_size_bit_exact(self):
+        payloads = self._corpus()
+        comps = [
+            _raw_deflate(p, lvl) for p, lvl in zip(payloads, (1, 6, 1, 9))
+        ]
+        C = max(len(c) for c in comps)
+        comp = np.zeros((len(comps), C), np.uint8)
+        clens = np.zeros(len(comps), np.int32)
+        isz = np.zeros(len(comps), np.int32)
+        for i, c in enumerate(comps):
+            comp[i, : len(c)] = np.frombuffer(c, np.uint8)
+            clens[i] = len(c)
+            isz[i] = len(payloads[i])
+        out, ok = inflate_lanes(comp, clens, isz, interpret=False)
+        assert ok.all(), ok
+        for i, p in enumerate(payloads):
+            assert out[i, : len(p)].tobytes() == p, f"member {i}"
+
+    def test_encoder_full_size_bit_exact(self):
+        payloads = self._corpus()
+        P = max(len(p) for p in payloads)
+        mat = np.zeros((len(payloads), P), np.uint8)
+        lens = np.zeros(len(payloads), np.int32)
+        for i, p in enumerate(payloads):
+            mat[i, : len(p)] = np.frombuffer(p, np.uint8)
+            lens[i] = len(p)
+        comp, clens, ok = deflate_lanes(mat, lens, interpret=False)
+        assert ok.all(), ok
+        for i, p in enumerate(payloads):
+            d = zlib.decompressobj(-15)
+            assert d.decompress(comp[i, : clens[i]].tobytes()) == p, i
+            assert d.eof, i
+
+    def test_roundtrip_full_size_through_wrappers(self):
+        data = self._corpus()[0] * 4  # several full-size members
+        blob = flate.bgzf_compress_device(
+            data, conf=LANES_CONF, use_lanes=True
+        )
+        assert flate.LAST_DEFLATE_STATS.lanes_hit_rate() == 1.0
+        assert (
+            flate.bgzf_decompress_device(blob, conf=LANES_CONF) == data
+        )
+        assert flate.LAST_INFLATE_STATS.lanes_hit_rate() == 1.0
+
+
+@pytest.mark.slow
+class TestStreamingFuzz:
+    """Heavier interpret-mode fuzz of the streaming geometry (still small
+    members — the full-size corpus is the device_stream class above)."""
+
+    def test_fuzz_decoder_shapes(self):
+        rng = np.random.default_rng(100)
+        payloads, comps = [], []
+        for t in range(10):
+            n = int(rng.integers(600, 2600))
+            kind = t % 3
+            if kind == 0:
+                p = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+            elif kind == 1:
+                p = (b"GATTACA-" * (n // 8 + 1))[:n]
+            else:
+                p = bytes(rng.integers(0, 4, n, dtype=np.uint8))
+            payloads.append(p)
+            comps.append(_raw_deflate(p, int(rng.choice([1, 6, 9]))))
+        _assert_inflate_oracle(comps, payloads, chunk_bytes=512)
+
+    def test_fuzz_encoder_shapes(self):
+        rng = np.random.default_rng(101)
+        payloads = []
+        for t in range(10):
+            n = int(rng.integers(600, 2600))
+            kind = t % 3
+            if kind == 0:
+                p = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+            elif kind == 1:
+                p = (b"deflate-me!" * (n // 11 + 1))[:n]
+            else:
+                p = bytes([int(rng.integers(0, 256))]) * n
+            payloads.append(p)
+        _assert_deflate_both_oracles(payloads, chunk_bytes=1024)
