@@ -143,6 +143,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
         BAM_WRITE_SPLITTING_BAI,
         DEFLATE_LANES,
         INFLATE_LANES,
+        WRITE_DEVICE,
         Configuration,
     )
     from .pipeline import sort_bam
@@ -156,6 +157,8 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
         conf.set_boolean(INFLATE_LANES, args.inflate_lanes == "on")
     if args.deflate_lanes is not None:
         conf.set_boolean(DEFLATE_LANES, args.deflate_lanes == "on")
+    if getattr(args, "device_write", None) is not None:
+        conf.set_boolean(WRITE_DEVICE, args.device_write == "on")
     mark_duplicates = mark_duplicates or getattr(
         args, "mark_duplicates", False
     )
@@ -208,6 +211,12 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
             "inflate_last_call": flate.LAST_INFLATE_STATS.as_dict(),
             "deflate_last_call": flate.LAST_DEFLATE_STATS.as_dict(),
         }
+        # Transfer ledger: the h2d/d2h byte totals (and per-kind splits)
+        # the hot paths reported — the write-side "only compressed bytes
+        # cross PCIe" claim is a number here, not an inference.
+        from .utils.tracing import transfers_report
+
+        report["transfers"] = transfers_report(report["counters"])
         print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 
@@ -295,6 +304,11 @@ def build_parser() -> argparse.ArgumentParser:
             "--deflate-lanes", choices=("on", "off"), default=None,
             help="force the lockstep-lane device deflate tier "
                  "(hadoopbam.deflate.lanes; default: auto rule)")
+        s.add_argument(
+            "--device-write", choices=("on", "off"), default=None,
+            help="force the device-resident part writes (on-chip sorted "
+                 "gather + flag patch + CRC32 feeding the deflate lanes "
+                 "from HBM; hadoopbam.write.device, default: auto rule)")
         if not markdup:
             s.add_argument(
                 "--mark-duplicates", action="store_true",
@@ -304,7 +318,8 @@ def build_parser() -> argparse.ArgumentParser:
                        help="print the span/counter report after the run "
                             "(includes the device codec tier counters: "
                             "flate.inflate.* / flate.deflate.* members "
-                            "per tier and size/vmem/ok0 tier-downs)")
+                            "per tier and size/vmem/ok0 tier-downs, plus "
+                            "the transfers block: h2d/d2h bytes by kind)")
         s.add_argument("--trace-dir", default=None,
                        help="capture a JAX profiler (XPlane) trace here")
 

@@ -57,6 +57,14 @@ INFLATE_LANES = "hadoopbam.inflate.lanes"
 # part-write path.  Same semantics: "true"/"false" force, unset defers to
 # the local-latency auto rule (ops.flate.deflate_lanes_tier_enabled).
 DEFLATE_LANES = "hadoopbam.deflate.lanes"
+# Device-resident part writes (ops/pallas/gather_stream.py + crc32.py):
+# the sorted record gather, markdup flag patch and per-member CRC32 all
+# run on chip over the HBM-resident split payloads, feeding the deflate
+# lanes device-to-device so only compressed bytes come back d2h.  Same
+# semantics as the codec tiers: "true"/"false" force, unset defers to the
+# local-latency auto rule (ops.flate.device_write_enabled); parts whose
+# batch lacks residency tier down to the host gather per part.
+WRITE_DEVICE = "hadoopbam.write.device"
 
 _TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
 _FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))

@@ -11,6 +11,15 @@ gathered record just before deflate — the LazyBAMRecord stance holds (the
 sort never mutates the source payload bytes; only the per-part gather
 output is patched).
 
+Mask handoff to the writers: :func:`mark_duplicates_device` returns the
+job-global bool mask in read order — the same index space the part
+writers' ``order`` slices address.  On the host gather path the patch is
+``io.bam.patch_flags`` over the gathered stream; on the device-resident
+write path the per-part mask column rides up with the gather's offset
+columns and the patch fuses into the on-chip gather itself
+(``ops.pallas.gather_stream``: a compare against the flag-byte offsets,
+no scatter) — both paths emit bit-identical parts.
+
 Semantics (the single definition, shared bit-for-bit by the device path
 and the pure-NumPy/Python oracle in :mod:`.oracle`):
 

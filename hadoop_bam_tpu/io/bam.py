@@ -106,14 +106,29 @@ class ChunkedRecords:
     soa: dict  # {"rec_off": int64 (chunk-local body offs), "rec_len": int64}
     keys: Optional[np.ndarray] = None  # int64; None when keys live on-device
     _validated: bool = False  # extent bounds checked once, then trusted
+    #: Device-resident flat copy of the concatenated chunk payloads (jax
+    #: uint8), present only when EVERY source batch carried
+    #: ``device_data`` and the caller asked to keep it — the
+    #: device-resident write path gathers parts straight from it.
+    device_flat: Optional[object] = None
+    chunk_base: Optional[np.ndarray] = None  # int64 chunk offsets in flat
 
     @property
     def n_records(self) -> int:
         return len(self.soa["rec_off"])
 
+    def release_device(self) -> None:
+        """Drop the HBM-resident flat payload so it frees once the part
+        writes are done (the write-path residency lifetime)."""
+        self.device_flat = None
+        self.chunk_base = None
+
     @classmethod
     def from_batches(
-        cls, batches: Sequence[RecordBatch], with_keys: bool = True
+        cls,
+        batches: Sequence[RecordBatch],
+        with_keys: bool = True,
+        keep_device: bool = False,
     ) -> "ChunkedRecords":
         if not batches:
             return cls(
@@ -131,6 +146,30 @@ class ChunkedRecords:
                 for i, b in enumerate(batches)
             ]
         )
+        device_flat = None
+        chunk_base = None
+        if keep_device and all(
+            b.device_data is not None for b in batches
+        ):
+            # One device-to-device concat up front: the per-split buffers
+            # can then free (callers drop their ``device_data`` refs) and
+            # every part write gathers from this single resident stream.
+            # Built eagerly so concurrent part writers never race a lazy
+            # concat.
+            try:
+                import jax.numpy as jnp
+
+                parts = [b.device_data for b in batches]
+                device_flat = (
+                    parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                )
+                chunk_base = np.cumsum(
+                    [0] + [len(b.data) for b in batches[:-1]]
+                ).astype(np.int64)
+                METRICS.count("bam.write_residency_kept", 1)
+            except Exception:
+                device_flat = None
+                chunk_base = None
         return cls(
             chunks=[b.data for b in batches],
             chunk_id=chunk_id,
@@ -147,6 +186,8 @@ class ChunkedRecords:
                 if with_keys
                 else None
             ),
+            device_flat=device_flat,
+            chunk_base=chunk_base,
         )
 
 
@@ -791,6 +832,78 @@ def patch_flags(
     stream[rec_starts + 19] |= np.uint8((bits >> 8) & 0xFF)
 
 
+def _write_part_device(
+    batch,
+    order: Optional[np.ndarray],
+    dup_mask: Optional[np.ndarray],
+    level: int,
+    conf: Optional[Configuration],
+) -> Optional[bytes]:
+    """The device-resident part assembly: sorted gather + markdup flag
+    patch on chip (``ops.pallas.gather_stream``), per-member CRC32 on
+    chip (``ops.pallas.crc32``), deflate lanes fed device-to-device —
+    the only d2h traffic is the compressed part blob (+ CRC column).
+
+    Returns the part blob (always lanes-blocked at ``DEV_LZ_PAYLOAD``),
+    or ``None`` to tier down to the host gather path; every tier-down
+    records its reason (``bam.device_write_tierdown.{no_residency,size}``
+    / ``bam.device_write_fallback``) so a silently-dead path shows up in
+    the round artifacts."""
+    from ..ops import flate as _flate
+
+    if isinstance(batch, ChunkedRecords):
+        if batch.device_flat is None:
+            METRICS.count("bam.device_write_tierdown.no_residency", 1)
+            return None
+        stream_dev = batch.device_flat
+        base = batch.chunk_base[
+            np.asarray(batch.chunk_id, dtype=np.int64)
+        ]
+        src = base + np.asarray(batch.soa["rec_off"], np.int64) - 4
+    else:
+        if getattr(batch, "device_data", None) is None:
+            METRICS.count("bam.device_write_tierdown.no_residency", 1)
+            return None
+        stream_dev = batch.device_data
+        src = np.asarray(batch.soa["rec_off"], np.int64) - 4
+    lens = np.asarray(batch.soa["rec_len"], np.int64) + 4
+    if order is not None:
+        src = src[order]
+        lens = lens[order]
+    if len(src) == 0:
+        return None  # empty part: the host path writes its canonical form
+    dm = None
+    if dup_mask is not None:
+        dm = dup_mask[order] if order is not None else dup_mask
+        if not dm.any():
+            dm = None
+    try:
+        from ..ops.pallas.gather_stream import gather_stream_device
+
+        gathered, _ = gather_stream_device(
+            stream_dev, src, lens, dup_mask=dm
+        )
+        blob = _flate.deflate_blocks_device(
+            None,
+            level=level,
+            block_payload=_flate.DEV_LZ_PAYLOAD,
+            use_lanes=True,
+            conf=conf,
+            device_input=gathered,
+        )
+    except ValueError:
+        METRICS.count("bam.device_write_tierdown.size", 1)
+        return None
+    except Exception:
+        # Never fatal to a write — the host gather path is bit-correct.
+        METRICS.count("bam.device_write_fallback", 1)
+        return None
+    if dm is not None:
+        METRICS.count("bam.duplicate_flags_patched", int(dm.sum()))
+    METRICS.count("bam.device_write_parts", 1)
+    return blob
+
+
 def write_part_fast(
     stream,
     batch: "RecordBatch",
@@ -802,6 +915,7 @@ def write_part_fast(
     device_deflate: Optional[bool] = None,
     conf: Optional[Configuration] = None,
     dup_mask: Optional[np.ndarray] = None,
+    device_write: Optional[bool] = None,
 ) -> int:
     """Write a headerless, terminator-less part from a batch in one shot:
     vectorized record gather + batched deflate.  Per-record virtual
@@ -809,56 +923,83 @@ def write_part_fast(
     from the deterministic blocking (payload cut every ``block_payload``
     bytes), so no per-record Python loop runs.  Returns bytes written.
 
-    ``device_deflate`` routes the deflate through the lockstep-lane Pallas
-    encoder (``ops.flate.deflate_blocks_device``): the host gathers the
-    permuted records and does gzip framing + CRC32, the LZ77 match-find
-    and Huffman emit run on chip.  Default: the ``hadoopbam.deflate.lanes``
-    conf key / ``HBAM_DEFLATE_LANES`` env / local-latency auto rule
-    (``ops.flate.deflate_lanes_tier_enabled``).  A device failure falls
-    back to the threaded native zlib tier for the whole part.
+    ``device_write`` selects the fully device-resident assembly
+    (:func:`_write_part_device`): when the batch carries HBM residency
+    (``RecordBatch.device_data`` / ``ChunkedRecords.device_flat``), the
+    sorted gather, the markdup flag patch, the per-member CRC32 and the
+    LZ77+Huffman emit all run on chip and the host only frames the
+    compressed bytes — no uncompressed-stream upload at all.  Default:
+    the ``hadoopbam.write.device`` conf key / ``HBAM_DEVICE_WRITE`` env /
+    local-latency auto rule (``ops.flate.device_write_enabled``).  Output
+    is byte-identical to the host gather + lanes-deflate path; any
+    tier-down (missing residency, int32 domain, device failure) falls
+    through to that path with its reason counted.
+
+    ``device_deflate`` routes the (host-gathered) deflate through the
+    lockstep-lane Pallas encoder (``ops.flate.deflate_blocks_device``):
+    the host gathers the permuted records and does gzip framing + CRC32,
+    the LZ77 match-find and Huffman emit run on chip.  Default: the
+    ``hadoopbam.deflate.lanes`` conf key / ``HBAM_DEFLATE_LANES`` env /
+    local-latency auto rule (``ops.flate.deflate_lanes_tier_enabled``).
+    A device failure falls back to the threaded native zlib tier for the
+    whole part.
 
     ``dup_mask`` (bool per *batch row*, same index space as
     ``soa['rec_off']``) marks rows whose written copy gets
     ``FLAG_DUPLICATE`` ORed in via :func:`patch_flags` — the dedup
     subsystem's flag-rewrite stage, applied to the gathered stream just
     before deflate."""
-    payload = gather_record_array(batch, order)
-    if dup_mask is not None:
-        dm = dup_mask[order] if order is not None else dup_mask
-        if dm.any():
-            ln = batch.soa["rec_len"].astype(np.int64) + 4
-            if order is not None:
-                ln = ln[order]
-            starts = np.cumsum(ln) - ln
-            patch_flags(payload, starts[dm])
-            METRICS.count("bam.duplicate_flags_patched", int(dm.sum()))
-    if device_deflate is None:
-        from ..ops.flate import deflate_lanes_tier_enabled
+    if device_write is None:
+        from ..ops.flate import device_write_enabled
 
-        device_deflate = deflate_lanes_tier_enabled(conf)
-    # Explicit block size: the analytic voffset math below depends on it.
+        device_write = device_write_enabled(conf)
     blob = None
     block_payload = bgzf.MAX_PAYLOAD
-    if device_deflate:
+    if device_write:
         from ..ops import flate as _flate
 
-        try:
-            blob = _flate.deflate_blocks_device(
-                payload,
-                level=level,
-                block_payload=_flate.DEV_LZ_PAYLOAD,
-                use_lanes=True,
-            )
+        blob = _write_part_device(batch, order, dup_mask, level, conf)
+        if blob is not None:
             block_payload = _flate.DEV_LZ_PAYLOAD
-        except Exception:
-            METRICS.count("bam.device_deflate_fallback", 1)
-            blob = None
-            block_payload = bgzf.MAX_PAYLOAD
     if blob is None:
-        blob = native.deflate_blocks(
-            payload, level=level, threads=threads,
-            block_payload=block_payload,
-        )
+        payload = gather_record_array(batch, order)
+        if dup_mask is not None:
+            dm = dup_mask[order] if order is not None else dup_mask
+            if dm.any():
+                ln = batch.soa["rec_len"].astype(np.int64) + 4
+                if order is not None:
+                    ln = ln[order]
+                starts = np.cumsum(ln) - ln
+                patch_flags(payload, starts[dm])
+                METRICS.count(
+                    "bam.duplicate_flags_patched", int(dm.sum())
+                )
+        if device_deflate is None:
+            from ..ops.flate import deflate_lanes_tier_enabled
+
+            device_deflate = deflate_lanes_tier_enabled(conf)
+        # Explicit block size: the analytic voffset math below depends
+        # on it.
+        if device_deflate:
+            from ..ops import flate as _flate
+
+            try:
+                blob = _flate.deflate_blocks_device(
+                    payload,
+                    level=level,
+                    block_payload=_flate.DEV_LZ_PAYLOAD,
+                    use_lanes=True,
+                )
+                block_payload = _flate.DEV_LZ_PAYLOAD
+            except Exception:
+                METRICS.count("bam.device_deflate_fallback", 1)
+                blob = None
+                block_payload = bgzf.MAX_PAYLOAD
+        if blob is None:
+            blob = native.deflate_blocks(
+                payload, level=level, threads=threads,
+                block_payload=block_payload,
+            )
     stream.write(blob)
     if splitting_bai_stream is not None:
         ln = batch.soa["rec_len"].astype(np.int64) + 4

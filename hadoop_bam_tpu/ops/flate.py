@@ -1143,6 +1143,33 @@ def deflate_lanes_tier_enabled(conf=None) -> bool:
     return local_tpu_ready()
 
 
+def device_write_enabled(conf=None) -> bool:
+    """Should part writes assemble on device — the sorted record gather,
+    markdup flag patch and per-member CRC32 running over the HBM-resident
+    split payloads, feeding the deflate lanes device-to-device so only
+    compressed bytes come back d2h (``io.bam.write_part_fast``'s device
+    variant)?
+
+    Resolution order mirrors the codec tiers: ``HBAM_DEVICE_WRITE`` env
+    var (0/1 force) → the ``hadoopbam.write.device`` conf key → the
+    shared local-latency auto rule (``utils.backend.local_tpu_ready``).
+    The gate answers "should we try"; per-part the path still tiers down
+    to the host gather when the batch lacks residency or the geometry
+    leaves the device domain (reasons in ``bam.device_write_tierdown.*``).
+    """
+    env = os.environ.get("HBAM_DEVICE_WRITE")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    if conf is not None:
+        from ..conf import WRITE_DEVICE
+
+        if WRITE_DEVICE in conf:
+            return conf.get_boolean(WRITE_DEVICE)
+    from ..utils.backend import local_tpu_ready
+
+    return local_tpu_ready()
+
+
 def _lanes_decode_members(
     raw: np.ndarray, co, cs, xlen, idx: List[int], us,
     stats: Optional[CodecTierStats] = None,
@@ -1190,6 +1217,9 @@ def _lanes_decode_members(
         i = idx[k]
         s = int(co[i]) + 12 + int(xlen[i])
         comp[k2, : clens[k2]] = raw[s : s + clens[k2]]
+    from ..utils.tracing import count_d2h, count_h2d
+
+    count_h2d(comp.nbytes, "inflate_comp")
     try:
         out_l, ok_l, dev = inflate_lanes_ex(
             comp, clens, isz, keep_device=keep_device
@@ -1204,6 +1234,7 @@ def _lanes_decode_members(
         for k2 in range(len(take))
         if ok_l[k2]
     }
+    count_d2h(int(sum(len(v) for v in decoded.values())), "inflate_out")
     if stats is not None:
         stats.tierdown_ok0 += int((~ok_l).sum())
     n_down = len(idx) - len(decoded)
@@ -1373,6 +1404,7 @@ def bgzf_compress_device(
     level: int = 1,
     conf=None,
     use_lanes: Optional[bool] = None,
+    device_input=None,
 ) -> bytes:
     """Compress a byte stream into BGZF using the device deflate tiers.
 
@@ -1396,18 +1428,45 @@ def bgzf_compress_device(
     contiguous input, and the stream is assembled in one preallocated
     buffer.
 
+    ``device_input`` (a device-resident uint8 array, exclusive with
+    ``data``) is the device-resident write path's handoff: the lanes
+    encoder reads its member windows straight from HBM
+    (``deflate_lanes_stream``) and the per-member CRC32 runs on chip
+    (``ops.pallas.crc32``), so the uncompressed stream never visits the
+    host — only the compressed rows, the 4-byte CRC column and any
+    tier-down members' payloads come back d2h (ledgered under
+    ``transfers.d2h.*``).  Output is byte-identical to the host-input
+    path on the same bytes.
+
     Per-call tier accounting lands in :data:`LAST_DEFLATE_STATS` (and the
     ``flate.deflate.*`` METRICS counters): members per tier plus the
     size/vmem/ok0 tier-down taxonomy out of the lanes tier."""
     global LAST_DEFLATE_STATS
+    from ..utils.tracing import METRICS, count_d2h
 
     stats = CodecTierStats()
     LAST_DEFLATE_STATS = stats
-    a = np.frombuffer(data, dtype=np.uint8) if not isinstance(
-        data, np.ndarray
-    ) else data
+    a: Optional[np.ndarray]
+    if device_input is not None:
+        if data is not None:
+            raise ValueError("pass data or device_input, not both")
+        a = None
+        n = int(device_input.shape[0])
+    else:
+        a = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+            data, np.ndarray
+        ) else data
+        n = len(a)
     if use_lanes is None:
         use_lanes = level != 0 and deflate_lanes_tier_enabled(conf)
+    if device_input is not None and (level == 0 or not use_lanes):
+        # Device-resident input only pays off on the lanes tier; the
+        # stored/XLA tiers need the bytes host-side anyway — spill once,
+        # visibly, and continue exactly as the host-input path.
+        a = np.asarray(device_input)
+        count_d2h(a.nbytes, "write_spill")
+        METRICS.count("flate.deflate.device_input_spill", 1)
+        device_input = None
     if block_payload is None:
         block_payload = DEV_LZ_PAYLOAD if use_lanes else DEV_DEFAULT_PAYLOAD
     if block_payload > DEV_MAX_PAYLOAD:
@@ -1415,7 +1474,6 @@ def bgzf_compress_device(
             f"device codec payload cap is {DEV_MAX_PAYLOAD}, "
             f"got {block_payload}"
         )
-    n = len(a)
     nblk = max(1, -(-n // block_payload))
     lens = np.full(nblk, block_payload, dtype=np.int32)
     if n:
@@ -1426,6 +1484,19 @@ def bgzf_compress_device(
     comp: Optional[np.ndarray] = None  # padded rows (device tiers)
     clens = np.zeros(nblk, dtype=np.int64)
     overrides: dict = {}  # member index -> bytes (stored / host tiers)
+
+    def _member_payload(i: int) -> np.ndarray:
+        """Member i's raw payload, host-side — the per-member tier-down
+        target.  On the device-input path this is the only payload d2h,
+        and only for members the lanes tier declined."""
+        s = i * block_payload
+        ln = int(lens[i])
+        if a is not None:
+            return a[s : s + ln]
+        sl = np.asarray(device_input[s : s + ln])
+        count_d2h(sl.nbytes, "write_tierdown")
+        return sl
+
     if level == 0:
         # Uncompressed parts: one final stored block per member (LEN/NLEN
         # framing only; an empty member is the 5-byte empty stored block).
@@ -1440,15 +1511,19 @@ def bgzf_compress_device(
             clens[i] = 5 + ln
         stats.host += nblk
     else:
-        P = max(int(lens.max()), 1)
-        mat = np.zeros((nblk, P), dtype=np.uint8)
-        for i in range(nblk):
-            s = i * block_payload
-            mat[i, : lens[i]] = a[s : s + lens[i]]
+        mat: Optional[np.ndarray] = None
+        if a is not None:
+            P = max(int(lens.max()), 1)
+            mat = np.zeros((nblk, P), dtype=np.uint8)
+            for i in range(nblk):
+                s = i * block_payload
+                mat[i, : lens[i]] = a[s : s + lens[i]]
         done = False
         if use_lanes:
-            from ..utils.tracing import METRICS
-            from .pallas.deflate_lanes import deflate_lanes
+            from .pallas.deflate_lanes import (
+                deflate_lanes,
+                deflate_lanes_stream,
+            )
 
             accepted, reason = deflate_lanes_accepts(int(lens.max()))
             if not accepted:
@@ -1459,7 +1534,14 @@ def bgzf_compress_device(
                 ok = np.zeros(nblk, dtype=bool)
             else:
                 try:
-                    comp, cl, ok = deflate_lanes(mat, lens)
+                    if device_input is not None:
+                        # HBM-resident payload: member windows are the
+                        # deterministic blocking cuts, read on device.
+                        comp, cl, ok = deflate_lanes_stream(
+                            device_input, lens
+                        )
+                    else:
+                        comp, cl, ok = deflate_lanes(mat, lens)
                 except Exception:
                     METRICS.count("flate.deflate_lanes_launch_error", 1)
                     ok = np.zeros(nblk, dtype=bool)
@@ -1474,17 +1556,45 @@ def bgzf_compress_device(
                 stats.host += n_down
                 for i in np.nonzero(~ok)[0]:
                     overrides[int(i)] = _host_raw_deflate(
-                        mat[i, : lens[i]], level
+                        _member_payload(int(i)), level
                     )
                     clens[int(i)] = len(overrides[int(i)])
                 done = True
         if not done:
+            if mat is None:
+                # The lanes tier never engaged and the XLA emit needs the
+                # payload rows host-side: spill the device input.
+                a = np.asarray(device_input)
+                count_d2h(a.nbytes, "write_spill")
+                METRICS.count("flate.deflate.device_input_spill", 1)
+                device_input = None
+                P = max(int(lens.max()), 1)
+                mat = np.zeros((nblk, P), dtype=np.uint8)
+                for i in range(nblk):
+                    s = i * block_payload
+                    mat[i, : lens[i]] = a[s : s + lens[i]]
             comp, cl = _deflate_fixed_rows(mat, lens)
             clens[:] = cl
             stats.xla += nblk
     stats.publish("flate.deflate")
 
     # ---- framing: one preallocated pass, CRC over the input itself -----
+    # Host input: zlib.crc32 over slices of the contiguous stream.
+    # Device input: the on-chip slice-by-4 kernel over the HBM-resident
+    # stream — the framing never touches the uncompressed bytes, only a
+    # 4-byte CRC column comes back d2h.
+    dev_crcs: Optional[np.ndarray] = None
+    if a is None:
+        from .pallas.crc32 import crc32_device
+
+        dev_crcs = np.asarray(
+            crc32_device(
+                device_input,
+                np.arange(nblk, dtype=np.int64) * block_payload,
+                lens.astype(np.int64),
+            )
+        )
+        count_d2h(dev_crcs.nbytes, "write_crc")
     total = int((18 + 8) * nblk + clens.sum())
     if append_terminator:
         total += len(bgzf.TERMINATOR)
@@ -1507,10 +1617,12 @@ def bgzf_compress_device(
         else:
             buf[pos : pos + c] = memoryview(comp[i, :c])
         pos += c
-        struct.pack_into(
-            "<II", buf, pos,
-            zlib.crc32(a[off_in : off_in + ln]) & 0xFFFFFFFF, ln,
+        crc = (
+            int(dev_crcs[i])
+            if dev_crcs is not None
+            else zlib.crc32(a[off_in : off_in + ln]) & 0xFFFFFFFF
         )
+        struct.pack_into("<II", buf, pos, crc, ln)
         pos += 8
         off_in += ln
     if append_terminator:
@@ -1524,14 +1636,18 @@ def deflate_blocks_device(
     block_payload: Optional[int] = None,
     conf=None,
     use_lanes: Optional[bool] = None,
+    device_input=None,
 ) -> bytes:
     """Device-tier drop-in for :func:`native.deflate_blocks` (no
     terminator appended): the part-write surface of the lockstep-lane
-    encoder.  The caller gathers the sorted records; the LZ77 match-find
-    and Huffman emit run on chip; the host does only gzip framing +
-    CRC32.  Blocking is deterministic (payload cut every
-    ``block_payload`` bytes), so ``write_part_fast``'s analytic
-    splitting-bai voffset math holds with the same ``block_payload``."""
+    encoder.  With host ``payload`` the caller gathers the sorted records
+    and the LZ77 match-find + Huffman emit run on chip; with
+    ``device_input`` (the device-resident write path) the gathered stream
+    is already in HBM and the lanes encoder + CRC32 both read it there —
+    the host does framing over compressed rows and a 4-byte CRC column
+    only.  Blocking is deterministic (payload cut every ``block_payload``
+    bytes), so ``write_part_fast``'s analytic splitting-bai voffset math
+    holds with the same ``block_payload``."""
     return bgzf_compress_device(
         payload,
         block_payload=block_payload,
@@ -1539,6 +1655,7 @@ def deflate_blocks_device(
         level=level,
         conf=conf,
         use_lanes=use_lanes,
+        device_input=device_input,
     )
 
 
@@ -1654,6 +1771,9 @@ def bgzf_decompress_device(
             for k, i in enumerate(gi):
                 s = int(co[i]) + 12 + int(xlen[i])
                 comp[k, : gc[k]] = raw[s : s + gc[k]]
+            from ..utils.tracing import count_d2h, count_h2d
+
+            count_h2d(comp.nbytes, "inflate_comp")
             if kind == "fixed" and jax.devices()[0].platform == "tpu":
                 # Preferred tier on real chips: the lockstep-lane Pallas
                 # decoder for literal-only fixed members (everything the
@@ -1698,6 +1818,7 @@ def bgzf_decompress_device(
                 )
             out_d = np.asarray(out_d)
             ok = np.asarray(ok)
+            count_d2h(out_d.nbytes, "inflate_out")
             for k, i in enumerate(gi):
                 if outs[i] is not None:
                     # Already decoded by the lockstep Pallas tier in a

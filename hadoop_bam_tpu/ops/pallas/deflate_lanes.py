@@ -503,6 +503,60 @@ def _pow2_at_least(n: int, lo: int) -> int:
     return v
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _words_from_stream(
+    stream: jax.Array, offs: jax.Array, lens: jax.Array, W: int
+) -> jax.Array:
+    """Build one group's transposed word layout ([W, 128]: member j's
+    words down lane j) straight from an HBM-resident byte stream — the
+    device-input mirror of the host-side transpose in
+    :func:`deflate_lanes`, so the payload never visits the host."""
+    S = stream.shape[0]
+    i = jnp.arange(W * 4, dtype=jnp.int32)[:, None]
+    idx = jnp.clip(offs[None, :] + i, 0, S - 1)
+    b = jnp.where(i < lens[None, :], stream[idx], 0).astype(jnp.uint32)
+    shifts = (jnp.uint32(1) << (8 * jnp.arange(4, dtype=jnp.uint32)))
+    w = (b.reshape(W, 4, LANES) * shifts[None, :, None]).sum(
+        axis=1, dtype=jnp.uint32
+    )
+    return jax.lax.bitcast_convert_type(w, jnp.int32)
+
+
+def _encode_group(
+    words_dev, plens_np: np.ndarray, n: int, g: dict, out_bytes: int,
+    interpret: bool, emit_step: int,
+):
+    """Match-kernel launch + device token compaction + fixed-Huffman pack
+    for one ≤128-lane group whose words are already in the transposed
+    layout (host- or device-built).  Returns (comp [n, out_bytes] uint8,
+    clens int32 [n], ok bool [n]) as host arrays — only the compressed
+    rows come back d2h."""
+    from ...utils.tracing import count_d2h
+
+    plens = np.zeros((1, LANES), dtype=np.int32)
+    plens[0, :n] = plens_np
+    toks, cnts, ntok, okk = _launch(
+        words_dev, jnp.asarray(plens), g["w"], g["h"], g["n_chunks"],
+        g["tok_tile"], g["chunk"], g["t_step"], bool(interpret),
+    )
+    ntok_np = np.asarray(ntok)[0]
+    T = _pow2_at_least(int(ntok_np.max()) + 1, 256)
+    tok_bt = _compact_tokens(toks, cnts, g["tok_tile"], T)
+    ntok_vec = ntok[0]
+    comp = np.zeros((n, out_bytes), dtype=np.uint8)
+    clens = np.zeros(n, dtype=np.int32)
+    for r0 in range(0, n, emit_step):
+        r1 = min(n, r0 + emit_step)
+        c, cl = _emit_tokens_fixed(
+            tok_bt[r0:r1], ntok_vec[r0:r1], out_bytes
+        )
+        comp[r0:r1] = np.asarray(c)
+        clens[r0:r1] = np.asarray(cl)
+    count_d2h(comp.nbytes, "deflate_comp")
+    ok = np.asarray(okk)[0, :n].astype(bool)
+    return comp, clens, ok
+
+
 def deflate_lanes(
     payload: np.ndarray,
     lens: np.ndarray,
@@ -545,6 +599,8 @@ def deflate_lanes(
         interpret = jax.devices()[0].platform != "tpu"
     NB = out_bytes * 8
     emit_step = max(1, _MAX_LAUNCH_ELEMS // NB)
+    from ...utils.tracing import count_h2d
+
     for g0 in range(0, B, LANES):
         g1 = min(B, g0 + LANES)
         n = g1 - g0
@@ -557,27 +613,92 @@ def deflate_lanes(
                 None, :, None
             ]
         ).sum(axis=1).astype(np.uint32).view(np.int32)
-        plens = np.zeros((1, LANES), dtype=np.int32)
-        plens[0, :n] = lens[g0:g1]
-        toks, cnts, ntok, okk = _launch(
-            jnp.asarray(words), jnp.asarray(plens), g["w"], g["h"],
-            g["n_chunks"], g["tok_tile"], g["chunk"], g["t_step"],
-            bool(interpret),
+        count_h2d(words.nbytes, "deflate_payload")
+        c, cl, okg = _encode_group(
+            jnp.asarray(words), lens[g0:g1], n, g, out_bytes,
+            bool(interpret), emit_step,
         )
-        # Device-side ragged compaction + bit pack (only the small token
-        # counts round-trip to the host, for the static T bucket).
-        ntok_np = np.asarray(ntok)[0]
-        T = _pow2_at_least(int(ntok_np.max()) + 1, 256)
-        tok_bt = _compact_tokens(toks, cnts, g["tok_tile"], T)
-        ntok_vec = ntok[0]
-        for r0 in range(0, n, emit_step):
-            r1 = min(n, r0 + emit_step)
-            c, cl = _emit_tokens_fixed(
-                tok_bt[r0:r1], ntok_vec[r0:r1], out_bytes
-            )
-            comp[g0 + r0 : g0 + r1] = np.asarray(c)
-            clens[g0 + r0 : g0 + r1] = np.asarray(cl)
-        ok_all[g0:g1] = np.asarray(okk)[0, :n].astype(bool)
+        comp[g0:g1] = c
+        clens[g0:g1] = cl
+        ok_all[g0:g1] = okg
+    if max_clen is not None:
+        ok_all &= clens <= max_clen
+    return comp, clens, ok_all
+
+
+def deflate_lanes_stream(
+    stream,
+    lens: np.ndarray,
+    offs: Optional[np.ndarray] = None,
+    max_clen: Optional[int] = None,
+    chunk_bytes: int = _DEFAULT_CHUNK,
+    interpret=None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """:func:`deflate_lanes` fed from an HBM-resident byte stream.
+
+    ``stream``: device uint8 (e.g. the gathered part stream the write
+    path leaves in HBM); member i's payload is
+    ``stream[offs[i] : offs[i]+lens[i]]`` (``offs`` defaults to the
+    back-to-back cumsum — the part writer's deterministic blocking).  The
+    transposed per-group word layout is built device-side, so the only
+    h2d traffic is the small offset/length columns and the only d2h
+    traffic is the compressed rows — the whole point of the
+    device-resident write path.  Same return contract as
+    :func:`deflate_lanes`."""
+    from ..flate import _MAX_LAUNCH_ELEMS
+
+    lens = np.asarray(lens, dtype=np.int32)
+    B = len(lens)
+    if B == 0:
+        return (
+            np.zeros((0, 0), np.uint8),
+            np.zeros(0, np.int32),
+            np.zeros(0, bool),
+        )
+    if offs is None:
+        ends = np.cumsum(lens.astype(np.int64))
+        offs = ends - lens
+    offs = np.asarray(offs, dtype=np.int64)
+    if int(jnp.asarray(stream).shape[0]) == 0:
+        # Every member is empty; encode through the host-input path (a
+        # zero-length device gather is ill-formed) — same bits out.
+        return deflate_lanes(
+            np.zeros((B, 1), np.uint8), lens,
+            max_clen=max_clen, chunk_bytes=chunk_bytes,
+            interpret=interpret,
+        )
+    max_len = int(lens.max())
+    P = _round_up(max(max_len, 1), chunk_bytes)
+    out_bytes = _out_bytes(P)
+    comp = np.zeros((B, out_bytes), dtype=np.uint8)
+    clens = np.zeros(B, dtype=np.int32)
+    ok_all = np.zeros(B, dtype=bool)
+    if max_len > _MAX_MEMBER or _vmem_bytes(P, chunk_bytes) > _VMEM_BUDGET_BYTES:
+        return comp, clens, ok_all
+    if int((offs + lens).max()) >= 2**31:
+        return comp, clens, ok_all  # past the int32 gather domain
+    g = _geometry(P, chunk_bytes)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    NB = out_bytes * 8
+    emit_step = max(1, _MAX_LAUNCH_ELEMS // NB)
+    dev = jnp.asarray(stream)
+    for g0 in range(0, B, LANES):
+        g1 = min(B, g0 + LANES)
+        n = g1 - g0
+        offs_p = np.zeros(LANES, dtype=np.int32)
+        lens_p = np.zeros(LANES, dtype=np.int32)
+        offs_p[:n] = offs[g0:g1]
+        lens_p[:n] = lens[g0:g1]
+        words = _words_from_stream(
+            dev, jnp.asarray(offs_p), jnp.asarray(lens_p), g["w"]
+        )
+        c, cl, okg = _encode_group(
+            words, lens[g0:g1], n, g, out_bytes, bool(interpret), emit_step
+        )
+        comp[g0:g1] = c
+        clens[g0:g1] = cl
+        ok_all[g0:g1] = okg
     if max_clen is not None:
         ok_all &= clens <= max_clen
     return comp, clens, ok_all

@@ -191,7 +191,10 @@ def sort_bam(
         # split still overshoots).
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
         splits = fmt.get_splits(in_paths, split_size=split_size)
-        from .ops.flate import deflate_lanes_tier_enabled
+        from .ops.flate import (
+            deflate_lanes_tier_enabled,
+            device_write_enabled,
+        )
 
         return _sort_bam_external(
             fmt,
@@ -207,6 +210,7 @@ def sort_bam(
             write_workers=write_workers,
             device_deflate=deflate_lanes_tier_enabled(conf),
             mark_duplicates=mark_duplicates,
+            device_write=device_write_enabled(conf),
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -229,6 +233,16 @@ def sort_bam(
             else _default_device_parse()
         )
     )
+    # Device-resident part writes: the sorted gather + flag patch + CRC32
+    # feed the deflate lanes straight from the HBM-resident split
+    # payloads, so the write side d2h's only compressed bytes.  Resolved
+    # once per job (``hadoopbam.write.device`` / HBAM_DEVICE_WRITE / the
+    # local-latency auto rule) independently of the sort backend — it is
+    # a codec-tier concern like the deflate lanes; split residency is
+    # kept through the sort when on.
+    from .ops.flate import device_write_enabled
+
+    use_device_write = device_write_enabled(conf)
     batches: List[RecordBatch] = []
     parsed: List[Optional[tuple]] = []  # per batch: (hi, lo, unm, meta)
     dev_hi: List = []
@@ -243,10 +257,12 @@ def sort_bam(
         # with nothing.
         if pending:
             from .ops.keys import split_keys_np
+            from .utils.tracing import count_h2d
 
             hi_i, lo_i = split_keys_np(
                 pending[0] if len(pending) == 1 else np.concatenate(pending)
             )
+            count_h2d(hi_i.nbytes + lo_i.nbytes, "keys")
             dev_hi.append(jnp.asarray(hi_i))
             dev_lo.append(jnp.asarray(lo_i))
             pending.clear()
@@ -285,9 +301,10 @@ def sort_bam(
                 "rec_off": b.soa["rec_off"],
                 "rec_len": b.soa["rec_len"],
             }
-            if not use_device_parse:
-                # Only the device-parse path consumes the residency
-                # handoff; don't pin HBM with unused split windows.
+            if not use_device_parse and not use_device_write:
+                # Neither the device-parse path nor the device write
+                # consumes the residency handoff; don't pin HBM with
+                # unused split windows.
                 b.device_data = None
             batches.append(b)
             if use_device_parse:
@@ -311,8 +328,10 @@ def sort_bam(
                     parsed.append(False)
                 # The chain kernel has consumed (or declined) the
                 # device-resident window; drop the reference so HBM frees
-                # as the read proceeds instead of pinning every split.
-                b.device_data = None
+                # as the read proceeds instead of pinning every split —
+                # unless the device write path will gather parts from it.
+                if not use_device_write:
+                    b.device_data = None
             elif use_device:
                 pending.append(b.keys)
                 if (si + 1) % upload_every == 0:
@@ -414,7 +433,15 @@ def sort_bam(
     # ``hadoopbam.deflate.lanes`` conf key / ``HBAM_DEFLATE_LANES`` env /
     # the same local-latency auto rule as the inflate tier.
     use_device_deflate = deflate_lanes_tier_enabled(conf)
-    merged = ChunkedRecords.from_batches(batches, with_keys=False)
+    merged = ChunkedRecords.from_batches(
+        batches, with_keys=False, keep_device=use_device_write
+    )
+    if use_device_write:
+        # The flat device copy (if any) now owns the resident bytes; drop
+        # the per-split references so the originals free before the
+        # writes start instead of doubling HBM for the whole write phase.
+        for b in batches:
+            b.device_data = None
     with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
         if part_dir is not None:
             # Persistent part dir: the parts are crash-restart units — a
@@ -454,6 +481,7 @@ def sort_bam(
                         threads=deflate_threads,
                         device_deflate=use_device_deflate,
                         dup_mask=dup_mask,
+                        device_write=use_device_write,
                     )
             finally:
                 if sb_stream is not None:
@@ -464,7 +492,12 @@ def sort_bam(
                     os.path.join(td, f"part-r-{pi:05d}.splitting-bai"),
                 )
 
-        executor.run(list(range(n_parts)), write_one)
+        try:
+            executor.run(list(range(n_parts)), write_one)
+        finally:
+            # Residency lifetime: the resident payload is dead once the
+            # parts exist — free the HBM before the merge.
+            merged.release_device()
         merge_bam_parts(
             td, out_path, header, write_splitting_bai=write_splitting_bai
         )
@@ -572,6 +605,9 @@ def _device_parse_split(b: RecordBatch):
     else:
         padded = np.zeros(pad_len, dtype=np.uint8)
         padded[:n_bytes] = b.data[s0:s1]
+        from .utils.tracing import count_h2d
+
+        count_h2d(padded.nbytes, "parse_stream")
     hi, lo, unm, count, ok = keys_from_stream_device(padded, n_bytes)
     meta = jnp.stack(
         [
@@ -772,7 +808,10 @@ class _LazyPermFetch:
             if self._np[g] is None:
                 with self._lock:
                     if self._np[g] is None:
+                        from .utils.tracing import count_d2h
+
                         self._np[g] = np.asarray(self._parts[g])
+                        count_d2h(self._np[g].nbytes, "perm")
                         self._parts[g] = None  # free the device buffer
             out.append(self._np[g][max(lo - b0, 0) : hi - b0])
         if not out:
@@ -805,6 +844,7 @@ def _sort_bam_external(
     write_workers: Optional[int],
     device_deflate: bool = False,
     mark_duplicates: bool = False,
+    device_write: bool = False,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -890,6 +930,12 @@ def _sort_bam_external(
                     "rec_off": b.soa["rec_off"],
                     "rec_len": b.soa["rec_len"],
                 }
+                # Spill runs live on disk, not in HBM: the out-of-core
+                # path cannot consume the inflate tier's residency
+                # handoff, so drop the device window per split — before
+                # this fix the refs silently pinned every split's
+                # inflated bytes in HBM until its run flushed.
+                b.device_data = None
                 n += b.n_records
                 if acc and acc_bytes + len(b.data) > memory_budget:
                     flush()
@@ -992,6 +1038,11 @@ def _sort_bam_external(
                 if write_splitting_bai:
                     sb_stream = open(tmp + ".sb", "wb")
                 with open(tmp, "wb") as f:
+                    # device_write passes through even though range
+                    # batches are rebuilt from disk and never carry
+                    # residency: the per-part tier-down records its
+                    # ``no_residency`` reason instead of the path
+                    # silently taking the host gather.
                     write_part_fast(
                         f,
                         batch,
@@ -1001,6 +1052,7 @@ def _sort_bam_external(
                         threads=deflate_threads,
                         device_deflate=device_deflate,
                         dup_mask=dup_rows,
+                        device_write=device_write,
                     )
             finally:
                 if sb_stream is not None:

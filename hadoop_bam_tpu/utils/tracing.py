@@ -58,6 +58,40 @@ class MetricsRegistry:
 METRICS = MetricsRegistry()
 
 
+def count_h2d(nbytes: int, what: str = "") -> None:
+    """Transfer ledger, host→device direction: every deliberate upload on
+    the hot paths reports its bytes here (keys, device-parse streams,
+    compressed blocks, write-path offset columns…), so the round
+    artifacts show the PCIe traffic instead of inferring it.  ``what``
+    adds an itemized ``transfers.h2d.<what>`` counter next to the
+    ``transfers.h2d_bytes`` total."""
+    n = int(nbytes)
+    METRICS.count("transfers.h2d_bytes", n)
+    if what:
+        METRICS.count(f"transfers.h2d.{what}", n)
+
+
+def count_d2h(nbytes: int, what: str = "") -> None:
+    """Transfer ledger, device→host direction (permutation fetches,
+    inflated payloads, compressed part blobs, CRC columns…)."""
+    n = int(nbytes)
+    METRICS.count("transfers.d2h_bytes", n)
+    if what:
+        METRICS.count(f"transfers.d2h.{what}", n)
+
+
+def transfers_report(counters: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    """The ``transfers`` block of the CLI ``--metrics`` JSON: every
+    ledger counter with the ``transfers.`` prefix stripped."""
+    if counters is None:
+        counters = METRICS.report()["counters"]
+    return {
+        k[len("transfers."):]: v
+        for k, v in counters.items()
+        if k.startswith("transfers.")
+    }
+
+
 @contextlib.contextmanager
 def span(name: str, registry: Optional[MetricsRegistry] = None) -> Iterator[None]:
     """Timed scope, cumulative per name; also annotates the JAX profiler

@@ -48,6 +48,12 @@ def pytest_configure(config):
         "dedup: duplicate-marking subsystem (dedup/) tests; combined "
         "with `tpu` they need a real accelerator and skip under a cpu pin",
     )
+    config.addinivalue_line(
+        "markers",
+        "device_write: device-resident part-write path at full-size "
+        "blocking; needs a real accelerator, skipped when JAX_PLATFORMS "
+        "pins cpu",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -70,6 +76,7 @@ def pytest_collection_modifyitems(config, items):
         if (
             "device_deflate" in item.keywords
             or "device_stream" in item.keywords
+            or "device_write" in item.keywords
             or ("dedup" in item.keywords and "tpu" in item.keywords)
         ):
             item.add_marker(skip)
