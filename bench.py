@@ -249,7 +249,72 @@ def _measure(platform: str) -> dict:
             out["write_h2d_bytes_per_read"] = _write_h2d_per_read(src, tmp)
         except Exception as e:
             out["write_h2d_error"] = str(e)[:120]
+    # Service-mode diagnostics (both platforms): warm ranged-view QPS
+    # through a live UDS daemon plus the cold→warm latency ratio — the
+    # resident-server thesis (warm kernel/index caches + HBM arena) as
+    # numbers per round.
+    try:
+        out.update(_serve_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["serve_bench_error"] = str(e)[:120]
     return out
+
+
+def _serve_bench(tmp: str) -> dict:
+    """Warm view QPS + cold-vs-warm first-request latency of the serve
+    daemon (hadoop_bam_tpu/serve/) on a small sorted indexed BAM.
+
+    Cold = the first request after startup (index/header loads + window
+    decode + any jit the warm-up missed); warm = the min over a ~1 s
+    request loop on the same region (arena + cache hits only).  The
+    ``serve_warm_vs_cold_latency`` ratio is cold/warm — the factor the
+    resident caches shave off a one-shot request."""
+    import threading
+
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.serve import BamDaemon, ServeClient
+    from hadoop_bam_tpu.spec import indices
+
+    n = int(os.environ.get("HBAM_BENCH_SERVE_RECORDS", "20000"))
+    src = os.path.join(tmp, "serve_src.bam")
+    synth_bam(src, n)
+    srt = os.path.join(tmp, "serve_sorted.bam")
+    sort_bam([src], srt, backend="host", level=1)
+    with open(srt + ".bai", "wb") as f:
+        indices.build_bai(srt).save(f)
+    sock = os.path.join(tmp, "serve.sock")
+    daemon = BamDaemon(socket_path=sock, warmup=True)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=daemon.serve_forever, args=(ready,), daemon=True
+    )
+    t.start()
+    if not ready.wait(120):
+        raise RuntimeError("serve daemon did not come up")
+    client = ServeClient(socket_path=sock)
+    region = "chr1:10000000-10100000"
+    try:
+        t0 = time.time()
+        client.view(srt, region, level=1)
+        cold_s = time.time() - t0
+        reqs = 0
+        warm_s = float("inf")
+        t0 = time.time()
+        while time.time() - t0 < 1.0:
+            t1 = time.time()
+            client.view(srt, region, level=1)
+            warm_s = min(warm_s, time.time() - t1)
+            reqs += 1
+        qps = reqs / (time.time() - t0)
+    finally:
+        client.shutdown()
+        t.join(timeout=30)
+    return {
+        "serve_view_qps": round(qps, 1),
+        "serve_view_cold_ms": round(cold_s * 1e3, 2),
+        "serve_view_warm_ms": round(warm_s * 1e3, 2),
+        "serve_warm_vs_cold_latency": round(cold_s / max(warm_s, 1e-9), 2),
+    }
 
 
 def _write_h2d_per_read(src: str, tmp: str) -> float:

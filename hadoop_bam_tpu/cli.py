@@ -225,6 +225,83 @@ def _cmd_markdup(args) -> int:
     return _cmd_sort(args, mark_duplicates=True)
 
 
+def _cmd_view(args) -> int:
+    """One-shot ranged view: the daemon's ``view`` endpoint without a
+    daemon — same code path (serve.endpoints.view_blob), so the output is
+    byte-identical to a served response for the same file and region."""
+    from .serve.endpoints import ServeContext, view_blob
+
+    ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        blob = view_blob(ctx, args.bam, args.region, level=args.level)
+    finally:
+        ctx.close()
+    if args.output == "-":
+        sys.stdout.buffer.write(blob)
+    else:
+        with open(args.output, "wb") as f:
+            f.write(blob)
+        print(f"{args.output}: {len(blob)} bytes")
+    return 0
+
+
+def _cmd_flagstat(args) -> int:
+    """One-shot flag census (the daemon's ``flagstat`` endpoint)."""
+    import json
+
+    from .serve.endpoints import ServeContext, flagstat
+
+    ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        counts = flagstat(ctx, args.bam)
+    finally:
+        ctx.close()
+    print(json.dumps(counts, indent=2, sort_keys=True))
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    """Run the resident daemon until a ``shutdown`` request (or SIGINT)."""
+    from .conf import (
+        Configuration,
+        SERVE_ARENA_BYTES,
+        SERVE_BATCH_WINDOW_MS,
+        SERVE_CACHE_BYTES,
+        SERVE_MAX_INFLIGHT,
+    )
+    from .serve.server import BamDaemon
+
+    conf = Configuration()
+    if args.cache_bytes is not None:
+        conf.set_int(SERVE_CACHE_BYTES, args.cache_bytes)
+    if args.arena_bytes is not None:
+        conf.set_int(SERVE_ARENA_BYTES, args.arena_bytes)
+    if args.batch_window_ms is not None:
+        conf.set_int(SERVE_BATCH_WINDOW_MS, args.batch_window_ms)
+    if args.max_inflight is not None:
+        conf.set_int(SERVE_MAX_INFLIGHT, args.max_inflight)
+    daemon = BamDaemon(
+        conf=conf,
+        socket_path=args.socket,
+        port=args.port,
+        warmup=not args.no_warmup,
+    )
+    daemon.start()
+    if daemon.warmup_report is not None:
+        w = daemon.warmup_report
+        print(
+            f"warm-up: {w['compiles']} compiles over "
+            f"{sum(w['warmed'].values())} geometries"
+            + (f", errors: {w['errors']}" if w["errors"] else "")
+        )
+    print(f"serving on {daemon.endpoint}")
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hadoop_bam_tpu",
@@ -334,6 +411,61 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_sort_args(s, markdup=True)
     s.set_defaults(func=_cmd_markdup)
+
+    s = sub.add_parser(
+        "view",
+        help="index-backed ranged read: records overlapping a region "
+             "as a small BAM (samtools-style region shorthand accepted; "
+             "same code path as the serve daemon's view endpoint)",
+    )
+    s.add_argument("bam")
+    s.add_argument("region", help="contig | contig:pos | contig:start-end")
+    s.add_argument("-o", "--output", default="-")
+    s.add_argument("--level", type=int, default=6)
+    s.set_defaults(func=_cmd_view)
+
+    s = sub.add_parser(
+        "flagstat",
+        help="whole-file flag census (samtools flagstat-class counters, "
+             "printed as JSON; same code path as the daemon endpoint)",
+    )
+    s.add_argument("bam")
+    s.set_defaults(func=_cmd_flagstat)
+
+    s = sub.add_parser(
+        "serve",
+        help="resident service mode: a long-lived daemon owning the TPU "
+             "(warm kernel/index caches, HBM arena, cross-request lane "
+             "batching) behind a localhost/UDS JSON socket",
+    )
+    s.add_argument(
+        "--socket", default=None,
+        help="UDS socket path (default: a per-user path under the temp "
+             "dir; hadoopbam.serve.socket)")
+    s.add_argument(
+        "--port", type=int, default=None,
+        help="serve on 127.0.0.1:PORT instead of a UDS socket "
+             "(hadoopbam.serve.port)")
+    s.add_argument(
+        "--cache-bytes", type=_parse_size, default=None, metavar="BYTES",
+        help="header/index cache budget (hadoopbam.serve.cache-bytes; "
+             "accepts k/m/g suffixes)")
+    s.add_argument(
+        "--arena-bytes", type=_parse_size, default=None, metavar="BYTES",
+        help="HBM residency arena budget (hadoopbam.serve.arena-bytes)")
+    s.add_argument(
+        "--batch-window-ms", type=int, default=None,
+        help="admission batch window for cross-request lane coalescing "
+             "(hadoopbam.serve.batch-window-ms; 0 disables)")
+    s.add_argument(
+        "--max-inflight", type=int, default=None,
+        help="max concurrently-running submitted jobs "
+             "(hadoopbam.serve.max-inflight)")
+    s.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the startup kernel-geometry pre-compilation "
+             "(hadoopbam.serve.warmup)")
+    s.set_defaults(func=_cmd_serve)
 
     return p
 

@@ -65,6 +65,32 @@ DEFLATE_LANES = "hadoopbam.deflate.lanes"
 # local-latency auto rule (ops.flate.device_write_enabled); parts whose
 # batch lacks residency tier down to the host gather per part.
 WRITE_DEVICE = "hadoopbam.write.device"
+# Resident service mode (serve/): a long-lived daemon owning the TPU,
+# reached over a localhost/UDS socket with length-prefixed JSON framing.
+# Either the UDS socket path or a 127.0.0.1 TCP port selects the
+# transport (socket wins when both are set; neither → a per-user default
+# socket under the temp dir).
+SERVE_SOCKET = "hadoopbam.serve.socket"
+SERVE_PORT = "hadoopbam.serve.port"
+# Byte budgets for the daemon's warm state: the header/index cache
+# (serve/cache.py LRU, keyed by (path, size, mtime) file identity) and
+# the HBM residency arena (serve/arena.py — decoded split windows, with
+# their device-resident payloads when the inflate tier left any, kept
+# across requests instead of freed per job).
+SERVE_CACHE_BYTES = "hadoopbam.serve.cache-bytes"
+SERVE_ARENA_BYTES = "hadoopbam.serve.arena-bytes"
+# Admission batch window (milliseconds): member-decompress work arriving
+# within the window coalesces into one shared ≤128-lane launch
+# (serve/batching.py); 0 disables coalescing (every request launches
+# alone).
+SERVE_BATCH_WINDOW_MS = "hadoopbam.serve.batch-window-ms"
+# Max concurrently-running submitted jobs (sort submissions run in a
+# bounded pool; view/flagstat answer inline per connection).
+SERVE_MAX_INFLIGHT = "hadoopbam.serve.max-inflight"
+# Pre-compile the pow2 geometry buckets of the device kernels at daemon
+# startup (serve/warmup.py) so first-request latency is warm; "false"
+# skips the warm-up (first requests then pay the compiles).
+SERVE_WARMUP = "hadoopbam.serve.warmup"
 
 _TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
 _FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))

@@ -453,6 +453,7 @@ class BamInputFormat:
         threads: Optional[int] = None,
         fields: Optional[Sequence[str]] = None,
         device_inflate: Optional[bool] = None,
+        inflate_fn=None,
     ) -> RecordBatch:
         """Inflate the split's blocks and decode all its records as one batch.
 
@@ -465,7 +466,11 @@ class BamInputFormat:
         ``device_inflate`` (default: the ``hadoopbam.inflate.lanes`` conf
         key / local-latency auto rule via ``ops.flate.lanes_tier_enabled``)
         ships the split's blocks to the accelerator compressed and inflates
-        them on the lockstep-lane tier instead of host zlib."""
+        them on the lockstep-lane tier instead of host zlib.
+
+        ``inflate_fn`` overrides the member inflate entirely (see
+        :func:`read_virtual_range`) — the serve daemon's cross-request
+        lane batcher plugs in here."""
         if device_inflate is None:
             device_inflate = self._device_inflate()
         if data is not None:
@@ -478,6 +483,7 @@ class BamInputFormat:
                 interval_chunks=split.interval_chunks,
                 fields=fields,
                 device_inflate=device_inflate,
+                inflate_fn=inflate_fn,
             )
         sfs = fs.get_fs(split.path)
         size = sfs.size(split.path)
@@ -507,6 +513,7 @@ class BamInputFormat:
                     interval_chunks=chunks,
                     fields=fields,
                     device_inflate=device_inflate,
+                    inflate_fn=inflate_fn,
                 )
             except (bam.BamError, bgzf.BgzfError):
                 if at_eof:
@@ -561,6 +568,7 @@ def read_virtual_range(
     interval_chunks: Optional[List[Tuple[int, int]]] = None,
     fields: Optional[Sequence[str]] = None,
     device_inflate: bool = False,
+    inflate_fn=None,
 ) -> RecordBatch:
     """Decode all records whose start voffset lies in ``[vstart, vend)``.
 
@@ -577,6 +585,13 @@ def read_virtual_range(
     split's blocks ship to the accelerator *compressed* (≈4x fewer h2d
     bytes than the inflated stream) and members the device tier rejects
     fall back to native zlib per member — output is identical either way.
+
+    ``inflate_fn(data, coffsets, csizes, usizes) -> (out, out_offsets)``,
+    when given, replaces the main-window member inflate entirely (both
+    the native and device tiers) — the serve daemon routes reads through
+    its cross-request lane batcher this way.  Spill blocks (a tail record
+    straddling the window) still inflate natively: they are per-request
+    by construction.
     """
     if fields is not None and with_keys:
         # Keys need refid/pos/flag + record extents even if the caller's
@@ -612,6 +627,13 @@ def read_virtual_range(
     dev_cell: List = [None]  # device-resident copy of the inflated window
 
     def inflate(co, cs, us):
+        if inflate_fn is not None:
+            return inflate_fn(
+                data,
+                np.asarray(co, dtype=np.int64),
+                np.asarray(cs, dtype=np.int32),
+                np.asarray(us, dtype=np.int32),
+            )
         if device_inflate:
             from ..ops import flate
 

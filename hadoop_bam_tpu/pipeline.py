@@ -98,6 +98,7 @@ def sort_bam(
     memory_budget: Optional[int] = None,
     device_parse: Optional[bool] = None,
     mark_duplicates: bool = False,
+    resource_cache=None,
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
 
@@ -156,7 +157,12 @@ def sort_bam(
     deflate.  Works on every sort path, including ``memory_budget`` —
     there the record *bytes* stay budget-bounded while the signature
     columns (~18 bytes/record, like samtools markdup's per-read state)
-    stay in memory."""
+    stay in memory.
+
+    ``resource_cache`` (a :class:`serve.cache.ResourceCache`) serves the
+    input header from the resident daemon's identity-keyed cache instead
+    of re-reading it per job — the serve subsystem passes its own; batch
+    invocations leave it None and read cold as before."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -171,7 +177,11 @@ def sort_bam(
         mark_duplicates = mark_duplicates or conf.get_boolean(
             BAM_MARK_DUPLICATES
         )
-    header = read_header(in_paths[0]).with_sort_order("coordinate")
+    if resource_cache is not None:
+        header = resource_cache.header(in_paths[0])[0]
+    else:
+        header = read_header(in_paths[0])
+    header = header.with_sort_order("coordinate")
     if memory_budget is not None:
         if mesh is not None or distributed is not None:
             raise ValueError(

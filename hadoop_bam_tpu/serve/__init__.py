@@ -1,0 +1,53 @@
+"""Resident service mode: the pipeline as a long-lived TPU daemon.
+
+Cold-start batch (``python -m hadoop_bam_tpu sort …``) re-imports JAX,
+re-compiles every kernel geometry and re-reads headers/indices per job;
+this package keeps all of that warm in one process that owns the TPU and
+serves a stream of requests over a localhost/UDS socket (ROADMAP open
+item 4 — the Sam2bam "keep the accelerator resident" stance, with
+admitted requests overlapping in-flight device work):
+
+- :mod:`~hadoop_bam_tpu.serve.server` — accept loop, request dispatch,
+  bounded job pool, graceful drain;
+- :mod:`~hadoop_bam_tpu.serve.client` — the thin stdlib client;
+- :mod:`~hadoop_bam_tpu.serve.warmup` — startup pre-compilation of the
+  pow2 kernel geometry buckets + the XLA compile counter;
+- :mod:`~hadoop_bam_tpu.serve.cache` — header/index LRU keyed by
+  ``(path, size, mtime)`` file identity;
+- :mod:`~hadoop_bam_tpu.serve.arena` — the warm HBM residency arena
+  (decoded split windows, device payloads included, reused across
+  requests);
+- :mod:`~hadoop_bam_tpu.serve.batching` — the admission queue packing
+  concurrent small requests' member inflates into shared 128-lane
+  launches;
+- :mod:`~hadoop_bam_tpu.serve.endpoints` — ``view`` / ``flagstat``
+  implementations shared byte-for-byte with the one-shot CLI
+  subcommands.
+"""
+
+from .arena import HbmArena
+from .batching import LaneBatcher
+from .cache import LruByteCache, ResourceCache, file_identity
+from .client import ServeClient, ServeError
+from .endpoints import ServeContext, flagstat, view_blob, view_records
+from .server import BamDaemon, default_socket_path
+from .warmup import compile_count, ensure_compile_watcher, warm_kernels
+
+__all__ = [
+    "BamDaemon",
+    "HbmArena",
+    "LaneBatcher",
+    "LruByteCache",
+    "ResourceCache",
+    "ServeClient",
+    "ServeContext",
+    "ServeError",
+    "compile_count",
+    "default_socket_path",
+    "ensure_compile_watcher",
+    "file_identity",
+    "flagstat",
+    "view_blob",
+    "view_records",
+    "warm_kernels",
+]

@@ -1,0 +1,102 @@
+"""Warm residency arena: decoded split windows kept across requests.
+
+The batch pipeline frees every split's payload when its job ends; a
+resident daemon answering high-QPS ranged ``view`` requests should not
+re-read, re-inflate and re-decode the same window for every hit on a hot
+region.  The arena holds decoded :class:`~hadoop_bam_tpu.io.bam.RecordBatch`
+windows — including their HBM-resident ``device_data`` when the
+lockstep-lane inflate tier left one — keyed by ``(file identity, voffset
+range, field set)``, LRU under a byte budget.  Dropping an entry releases
+both the host buffer and the device buffer (jax frees HBM when the last
+reference dies), so the budget bounds HBM residency too.
+
+This is deliberately *content* residency, not raw buffer pooling: reusing
+a decoded window skips the disk read, the inflate (host or device), the
+chain walk and the SoA decode in one stroke, and the device-resident copy
+rides along for the kernels that consume residency
+(``pipeline._device_parse_split``, the device write path).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+from ..utils.tracing import METRICS
+
+
+def _batch_nbytes(batch) -> int:
+    """Budget charge of a held batch: payload + SoA columns (the device
+    copy mirrors the payload bytes, so it is charged once — HBM and host
+    budgets are tracked by the same number)."""
+    n = len(batch.data)
+    for col in batch.soa.values():
+        n += getattr(col, "nbytes", 0)
+    keys = getattr(batch, "keys", None)
+    if keys is not None:
+        n += getattr(keys, "nbytes", 0)
+    return n
+
+
+class HbmArena:
+    """LRU residency arena under a byte budget (thread-safe)."""
+
+    def __init__(self, budget_bytes: int = 1 << 30, name: str = "serve.arena"):
+        if budget_bytes < 1:
+            raise ValueError("budget_bytes must be >= 1")
+        self.budget = budget_bytes
+        self.name = name
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
+        self.used_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: Hashable):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                METRICS.count(f"{self.name}.miss", 1)
+                return None
+            self._entries.move_to_end(key)
+            METRICS.count(f"{self.name}.hit", 1)
+            return e[1]
+
+    def hold(self, key: Hashable, batch, nbytes: Optional[int] = None) -> None:
+        """Adopt a decoded window into the arena (replacing any previous
+        entry under the key)."""
+        nb = int(nbytes if nbytes is not None else _batch_nbytes(batch))
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old[0]
+            self._entries[key] = (nb, batch)
+            self.used_bytes += nb
+            if getattr(batch, "device_data", None) is not None:
+                METRICS.count(f"{self.name}.device_resident", 1)
+            while self.used_bytes > self.budget and len(self._entries) > 1:
+                _, (nb_old, _) = self._entries.popitem(last=False)
+                self.used_bytes -= nb_old
+                METRICS.count(f"{self.name}.evict", 1)
+
+    def release_all(self) -> None:
+        """Drop everything (daemon drain: HBM frees with the references)."""
+        with self._lock:
+            self._entries.clear()
+            self.used_bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            device_resident = sum(
+                1
+                for _, b in self._entries.values()
+                if getattr(b, "device_data", None) is not None
+            )
+            return {
+                "entries": len(self._entries),
+                "used_bytes": self.used_bytes,
+                "budget_bytes": self.budget,
+                "device_resident": device_resident,
+            }

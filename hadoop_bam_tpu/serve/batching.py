@@ -1,0 +1,219 @@
+"""Cross-request lane batching: many small requests, one 128-lane launch.
+
+The lockstep-lane codec kernels decode up to 128 BGZF members per launch,
+but the batch pipeline only ever shows them one file's members at a time —
+a daemon answering many concurrent small ``view`` requests would otherwise
+pay one launch (and one h2d round trip) per request for a handful of
+members each.  :class:`LaneBatcher` is the admission queue that fixes the
+mismatch: requests submit their member-decompress work and block; a worker
+holds the first arrival for a short batch window, drains everything that
+accumulated (up to the 128-lane capacity), concatenates the members into
+one synthetic back-to-back stream — BGZF members are self-contained, so
+members from *different files* coexist in one launch — and runs a single
+decode, then scatters each request's slice back.
+
+The decode function is pluggable: the default resolves the same tier
+chain as the split readers (``ops.flate.inflate_blocks_device`` when the
+lanes tier is enabled, native zlib otherwise), so coalescing works — and
+is counted — identically on a host-only deployment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.tracing import METRICS
+
+#: Lane capacity of one lockstep codec launch (ops/pallas/inflate_lanes.py).
+MAX_LANES = 128
+
+
+def default_decode_fn(conf=None) -> Callable:
+    """The daemon's decode tier resolution, once per batcher: the device
+    lanes wrapper when the inflate-lanes gate fires (conf key / env /
+    local-latency auto rule), else the native host codec."""
+    from ..ops import flate
+
+    if flate.lanes_tier_enabled(conf):
+
+        def decode(raw, co, cs, us):
+            out, offs = flate.inflate_blocks_device(raw, co, cs, us)
+            return out, offs
+
+        return decode
+    from .. import native
+
+    def decode(raw, co, cs, us):
+        return native.inflate_blocks(raw, co, cs, us)
+
+    return decode
+
+
+class _Pending:
+    __slots__ = ("raw", "co", "cs", "us", "out", "offs", "err", "done")
+
+    def __init__(self, raw, co, cs, us):
+        self.raw = raw
+        self.co = co
+        self.cs = cs
+        self.us = us
+        self.out = None
+        self.offs = None
+        self.err: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    @property
+    def n_members(self) -> int:
+        return len(self.co)
+
+
+class LaneBatcher:
+    """Admission queue coalescing member inflates into shared launches.
+
+    ``window_s`` is the coalescing window: the first submission of a batch
+    waits at most this long for company before launching (0 → every
+    submission launches alone — correct, just uncoalesced).  Counters:
+    ``serve.batch.launches`` / ``.members`` / ``.requests`` /
+    ``.coalesced_requests`` (requests that shared their launch with at
+    least one other).
+    """
+
+    def __init__(
+        self,
+        window_s: float = 0.002,
+        decode_fn: Optional[Callable] = None,
+        max_lanes: int = MAX_LANES,
+        conf=None,
+    ):
+        self.window_s = max(0.0, float(window_s))
+        self.max_lanes = max(1, int(max_lanes))
+        self._decode = decode_fn or default_decode_fn(conf)
+        self._lock = threading.Lock()
+        self._queue: List[_Pending] = []
+        self._wake = threading.Event()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="hbam-lane-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- request side -------------------------------------------------------
+
+    def submit(
+        self,
+        raw,
+        coffsets: np.ndarray,
+        csizes: np.ndarray,
+        usizes: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blockingly decode one request's members; same contract as
+        ``native.inflate_blocks``: ``(out, out_offsets)`` with member i's
+        payload at ``out[out_offsets[i]:out_offsets[i+1]]``."""
+        if self._closed:
+            raise RuntimeError("LaneBatcher is closed")
+        raw_a = (
+            raw
+            if isinstance(raw, np.ndarray)
+            else np.frombuffer(raw, dtype=np.uint8)
+        )
+        p = _Pending(
+            raw_a,
+            np.asarray(coffsets, dtype=np.int64),
+            np.asarray(csizes, dtype=np.int32),
+            np.asarray(usizes, dtype=np.int32),
+        )
+        with self._lock:
+            self._queue.append(p)
+        self._wake.set()
+        p.done.wait()
+        if p.err is not None:
+            raise p.err
+        return p.out, p.offs
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self._worker.join(timeout=5.0)
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait()
+            if self._closed and not self._queue:
+                return
+            # Batch window: let concurrent requests pile onto the first
+            # arrival before launching.
+            if self.window_s:
+                time.sleep(self.window_s)
+            with self._lock:
+                if not self._queue:
+                    self._wake.clear()
+                    continue
+                batch: List[_Pending] = []
+                lanes = 0
+                while self._queue:
+                    nxt = self._queue[0]
+                    if batch and lanes + nxt.n_members > self.max_lanes:
+                        break  # next launch takes it (capacity packing)
+                    batch.append(self._queue.pop(0))
+                    lanes += nxt.n_members
+                if not self._queue:
+                    self._wake.clear()
+            self._launch(batch)
+
+    def _launch(self, batch: List[_Pending]) -> None:
+        try:
+            # One synthetic stream: each member's compressed bytes are
+            # self-contained, so back-to-back concatenation is a valid
+            # input for any of the decode tiers.
+            parts: List[np.ndarray] = []
+            co_l: List[int] = []
+            cs_l: List[int] = []
+            us_l: List[int] = []
+            pos = 0
+            for p in batch:
+                for k in range(p.n_members):
+                    c0 = int(p.co[k])
+                    cs = int(p.cs[k])
+                    parts.append(p.raw[c0 : c0 + cs])
+                    co_l.append(pos)
+                    cs_l.append(cs)
+                    us_l.append(int(p.us[k]))
+                    pos += cs
+            cat = (
+                np.concatenate(parts)
+                if parts
+                else np.empty(0, dtype=np.uint8)
+            )
+            out, offs = self._decode(
+                cat,
+                np.asarray(co_l, dtype=np.int64),
+                np.asarray(cs_l, dtype=np.int32),
+                np.asarray(us_l, dtype=np.int32),
+            )
+            METRICS.count("serve.batch.launches", 1)
+            METRICS.count("serve.batch.members", len(co_l))
+            METRICS.count("serve.batch.requests", len(batch))
+            if len(batch) > 1:
+                METRICS.count(
+                    "serve.batch.coalesced_requests", len(batch)
+                )
+            # Scatter each request's contiguous member run back out.
+            m0 = 0
+            for p in batch:
+                m1 = m0 + p.n_members
+                lo, hi = int(offs[m0]), int(offs[m1])
+                p.out = out[lo:hi]
+                p.offs = np.asarray(offs[m0 : m1 + 1], dtype=np.int64) - lo
+                m0 = m1
+        except BaseException as e:  # noqa: BLE001 - delivered to waiters
+            for p in batch:
+                p.err = e
+        finally:
+            for p in batch:
+                p.done.set()

@@ -1,0 +1,232 @@
+"""Header/index cache for the resident daemon, keyed by file identity.
+
+Cold-start batch re-reads the BAM header and any `.bai`/`.tbi`/
+`.splitting-bai` on every job; a long-lived server must not.  Entries are
+keyed by ``(path, size, mtime_ns)`` *file identity* — a rewritten or
+touched file is a different key, so staleness is detected at lookup time
+(the entry is dropped and reloaded) rather than by TTL guesswork.  The
+cache is LRU under a byte budget, and every lookup lands in METRICS
+(``serve.cache.{hit,miss,stale,evict}`` plus a per-kind itemization) so
+the daemon's ``stats`` endpoint and per-request deltas show real hit
+rates, not inferences.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+from ..utils.tracing import METRICS
+
+#: ``(path, size, mtime_ns)`` — the staleness key (the same identity rule
+#: the splitting-bai planner uses via its ``bam_size()`` terminator check,
+#: extended with mtime so an in-place rewrite of equal size still misses).
+FileIdentity = Tuple[str, int, int]
+
+
+def file_identity(path: str) -> FileIdentity:
+    st = os.stat(path)
+    return (path, st.st_size, st.st_mtime_ns)
+
+
+class LruByteCache:
+    """Thread-safe identity-validating LRU cache under a byte budget."""
+
+    def __init__(self, budget_bytes: int = 256 << 20, name: str = "serve.cache"):
+        if budget_bytes < 1:
+            raise ValueError("budget_bytes must be >= 1")
+        self.budget = budget_bytes
+        self.name = name
+        self._lock = threading.Lock()
+        # (kind, path) -> (identity, nbytes, value); insertion order = LRU.
+        self._entries: "OrderedDict[Tuple[str, str], Tuple[FileIdentity, int, Any]]" = (
+            OrderedDict()
+        )
+        self.used_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, kind: str, path: str, identity: Optional[FileIdentity] = None):
+        """The cached value, or None on miss.  A changed file identity
+        (size or mtime moved) invalidates the entry — counted ``stale``
+        on top of the miss, so silent-corruption risks are visible."""
+        if identity is None:
+            try:
+                identity = file_identity(path)
+            except OSError:
+                identity = None  # vanished file: any entry is stale
+        key = (kind, path)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and identity is not None and e[0] == identity:
+                self._entries.move_to_end(key)
+                METRICS.count(f"{self.name}.hit", 1)
+                METRICS.count(f"{self.name}.hit.{kind}", 1)
+                return e[2]
+            if e is not None:
+                # Present but wrong identity: drop it now (a later put
+                # would overwrite anyway, but eviction accounting should
+                # not carry dead bytes meanwhile).
+                self.used_bytes -= e[1]
+                del self._entries[key]
+                METRICS.count(f"{self.name}.stale", 1)
+        METRICS.count(f"{self.name}.miss", 1)
+        METRICS.count(f"{self.name}.miss.{kind}", 1)
+        return None
+
+    def put(
+        self,
+        kind: str,
+        path: str,
+        value: Any,
+        nbytes: int,
+        identity: Optional[FileIdentity] = None,
+    ) -> None:
+        if identity is None:
+            identity = file_identity(path)
+        key = (kind, path)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old[1]
+            self._entries[key] = (identity, int(nbytes), value)
+            self.used_bytes += int(nbytes)
+            # Evict LRU down to budget; the entry just inserted survives
+            # even when it alone exceeds the budget (callers cached it for
+            # a reason — it just pins the whole budget until displaced).
+            while self.used_bytes > self.budget and len(self._entries) > 1:
+                _, (_, nb, _) = self._entries.popitem(last=False)
+                self.used_bytes -= nb
+                METRICS.count(f"{self.name}.evict", 1)
+
+    def get_or_load(
+        self,
+        kind: str,
+        path: str,
+        loader: Callable[[str], Any],
+        sizer: Callable[[Any], int],
+    ):
+        """get() falling through to ``loader(path)`` + put().  The load
+        runs outside the cache lock (loads can be slow I/O); concurrent
+        misses may load twice and last-put wins — both copies are valid,
+        so this trades a rare duplicate load for zero lock-hold I/O."""
+        ident = file_identity(path)
+        v = self.get(kind, path, identity=ident)
+        if v is not None:
+            return v
+        v = loader(path)
+        self.put(kind, path, v, sizer(v), identity=ident)
+        return v
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "used_bytes": self.used_bytes,
+                "budget_bytes": self.budget,
+            }
+
+
+def _sizeof_saveable(obj) -> int:
+    """Exact serialized size of an index object exposing ``save(stream)``
+    — cheap at header/index scale and honest for the byte budget."""
+    buf = io.BytesIO()
+    obj.save(buf)
+    return buf.tell()
+
+
+class ResourceCache:
+    """The daemon's header + index cache: BAM headers, `.bai`, `.tbi`,
+    `.splitting-bai`, each validated by file identity on every lookup.
+
+    A warm ``view`` request must trigger zero header/index re-reads —
+    that claim is the ``serve.cache.miss`` delta being zero, asserted in
+    tests/test_serve.py rather than assumed.
+    """
+
+    def __init__(self, budget_bytes: int = 256 << 20):
+        self.lru = LruByteCache(budget_bytes, name="serve.cache")
+
+    def identity(self, path: str) -> FileIdentity:
+        return file_identity(path)
+
+    def header(self, path: str):
+        """(BamHeader, first-record virtual offset) for a BAM path."""
+        from ..io.bam import read_header_voffset
+
+        def load(p: str):
+            return read_header_voffset(p)
+
+        def size(v) -> int:
+            hdr = v[0]
+            return len(hdr.text) + sum(len(n) + 16 for n, _ in hdr.refs) + 64
+
+        return self.lru.get_or_load("header", path, load, size)
+
+    def bai(self, path: str):
+        """The `.bai` for a BAM path — the companion file when present
+        (htsjdk naming convention), else derived by walking the BAM.
+
+        The cache key follows the *source actually read*: a companion
+        `.bai` entry invalidates when the index file changes; a derived
+        entry invalidates when the BAM itself does.
+        """
+        from ..io.bam import _find_bai
+        from ..io import fs
+        from ..spec import indices
+
+        bai_path = _find_bai(path)
+        if bai_path is not None:
+            return self.lru.get_or_load(
+                "bai",
+                bai_path,
+                lambda p: indices.Bai.load(fs.get_fs(p).read_all(p)),
+                _sizeof_saveable,
+            )
+        return self.lru.get_or_load(
+            "bai-derived",
+            path,
+            lambda p: indices.build_bai(fs.get_fs(p).read_all(p)),
+            _sizeof_saveable,
+        )
+
+    def splitting_bai(self, path: str):
+        """The `.splitting-bai` companion, or None when absent."""
+        from ..io import fs
+        from ..spec import indices
+
+        idx_path = path + indices.SPLITTING_BAI_EXT
+        if not fs.get_fs(idx_path).exists(idx_path):
+            return None
+        return self.lru.get_or_load(
+            "splitting-bai",
+            idx_path,
+            lambda p: indices.SplittingBai.load(fs.get_fs(p).read_all(p)),
+            lambda v: 8 * v.size(),
+        )
+
+    def tabix(self, path: str):
+        """The `.tbi` companion of a tabix-indexed file, or None."""
+        from ..io import fs
+        from ..spec import indices
+
+        tbi_path = path + ".tbi"
+        if not fs.get_fs(tbi_path).exists(tbi_path):
+            return None
+        return self.lru.get_or_load(
+            "tbi",
+            tbi_path,
+            lambda p: indices.Tabix.load(fs.get_fs(p).read_all(p)),
+            lambda v: sum(
+                16 * sum(len(c) for c in r.bins.values()) + 8 * len(r.linear)
+                for r in v.refs
+            )
+            + 64,
+        )
+
+    def stats(self) -> dict:
+        return self.lru.stats()

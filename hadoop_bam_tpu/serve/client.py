@@ -1,0 +1,109 @@
+"""Thin stdlib client for the resident daemon (serve/server.py framing).
+
+One connection per request: the daemon's protocol is strictly
+request/reply, so a persistent connection would only add failure modes
+(half-closed sockets across daemon drains).  Every method raises
+:class:`ServeError` on an ``ok: false`` reply — callers never have to
+inspect protocol envelopes.
+"""
+
+from __future__ import annotations
+
+import base64
+import socket
+import time
+from typing import Optional
+
+from .server import recv_msg, send_msg
+
+
+class ServeError(RuntimeError):
+    """The daemon replied ok=false (the error string is the message)."""
+
+
+class ServeClient:
+    def __init__(
+        self,
+        socket_path: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        timeout: float = 300.0,
+    ):
+        if socket_path is None and port is None:
+            from .server import default_socket_path
+
+            socket_path = default_socket_path()
+        self.socket_path = socket_path
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _request(self, obj: dict) -> dict:
+        if self.socket_path is not None:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            addr = self.socket_path
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            addr = (self.host, self.port)
+        sock.settimeout(self.timeout)
+        try:
+            sock.connect(addr)
+            send_msg(sock, obj)
+            reply = recv_msg(sock)
+        finally:
+            sock.close()
+        if reply is None:
+            raise ServeError("daemon closed the connection without a reply")
+        if not reply.get("ok"):
+            raise ServeError(reply.get("error", "unknown daemon error"))
+        return reply
+
+    # -- ops ----------------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self._request({"op": "ping"})
+
+    def view(self, path: str, region: str, level: int = 6) -> bytes:
+        """The region's records as a complete small BAM (bytes)."""
+        r = self._request(
+            {"op": "view", "path": path, "region": region, "level": level}
+        )
+        return base64.b64decode(r["data_b64"])
+
+    def flagstat(self, path: str) -> dict:
+        return self._request({"op": "flagstat", "path": path})["counts"]
+
+    def sort(self, bam, output: str, **kwargs) -> str:
+        """Submit a sort; returns the job id (poll with :meth:`job` or
+        block with :meth:`wait`)."""
+        req = {"op": "sort", "bam": bam, "output": output}
+        req.update(kwargs)
+        return self._request(req)["job"]
+
+    def job(self, job_id: str) -> dict:
+        return self._request({"op": "job", "id": job_id})
+
+    def wait(
+        self, job_id: str, timeout: float = 600.0, poll_s: float = 0.05
+    ) -> dict:
+        """Poll a submitted job to completion; raises on job failure."""
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self.job(job_id)
+            if st["status"] == "done":
+                return st
+            if st["status"] == "failed":
+                raise ServeError(st.get("error", "job failed"))
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {st['status']} after {timeout}s"
+                )
+            time.sleep(poll_s)
+
+    def stats(self) -> dict:
+        return self._request({"op": "stats"})
+
+    def shutdown(self) -> dict:
+        """Graceful drain: the daemon finishes in-flight jobs, replies,
+        then exits its accept loop."""
+        return self._request({"op": "shutdown"})

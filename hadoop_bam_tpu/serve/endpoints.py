@@ -1,0 +1,304 @@
+"""Request endpoints: index-backed ranged ``view`` and flagstat scans.
+
+One implementation, two surfaces: the daemon (serve/server.py) and the
+one-shot CLI subcommands (``python -m hadoop_bam_tpu view|flagstat``) both
+call these functions, so daemon responses are byte-identical to the batch
+path by construction — the tests assert it anyway.
+
+``view ref:start-end`` is the reference's bounded-traversal path
+(BAMInputFormat.filterByInterval → chunk spans → OverlapDetector) turned
+into a request: interval shorthand via ``utils.intervals``, chunk spans
+from the cached ``.bai``, decoded windows from the residency arena (or
+read through the cross-request lane batcher on a miss), and the exact
+overlap cut on the ``ops/cigar.py`` ``overlap_mask`` kernel — padded to
+the pow2 row buckets the warm-up pre-compiled, with a NumPy fallback that
+is bit-identical when no device program is viable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..conf import (
+    Configuration,
+    SERVE_ARENA_BYTES,
+    SERVE_BATCH_WINDOW_MS,
+    SERVE_CACHE_BYTES,
+)
+from ..spec import bam, bgzf
+from ..utils.intervals import MAX_END, FormatError, parse_interval
+from ..utils.tracing import METRICS, span
+from .arena import HbmArena
+from .batching import LaneBatcher
+from .cache import ResourceCache
+
+#: SoA columns the view path needs: overlap inputs (refid/pos + cigar
+#: geometry for reference spans) and the record extents for the gather.
+VIEW_FIELDS = (
+    "refid", "pos", "flag", "rec_off", "rec_len", "l_read_name",
+    "n_cigar_op",
+)
+FLAGSTAT_FIELDS = ("flag", "rec_off", "rec_len")
+
+DEFAULT_CACHE_BYTES = 256 << 20
+DEFAULT_ARENA_BYTES = 1 << 30
+DEFAULT_BATCH_WINDOW_MS = 2.0
+
+
+@dataclass
+class ServeContext:
+    """The daemon's warm state, bundled: conf + cache + arena + batcher.
+
+    The one-shot CLI builds a throwaway instance per invocation (same code
+    path, cold state, no batcher thread unless asked); the daemon keeps
+    one for its lifetime.
+    """
+
+    conf: Configuration
+    cache: ResourceCache
+    arena: HbmArena
+    batcher: Optional[LaneBatcher] = None
+
+    @classmethod
+    def from_conf(
+        cls, conf: Optional[Configuration] = None, with_batcher: bool = True
+    ) -> "ServeContext":
+        conf = conf or Configuration()
+        cache_bytes = conf.get_int(SERVE_CACHE_BYTES, DEFAULT_CACHE_BYTES)
+        arena_bytes = conf.get_int(SERVE_ARENA_BYTES, DEFAULT_ARENA_BYTES)
+        window_ms = conf.get_int(
+            SERVE_BATCH_WINDOW_MS, int(DEFAULT_BATCH_WINDOW_MS)
+        )
+        batcher = (
+            LaneBatcher(window_s=window_ms / 1e3, conf=conf)
+            if with_batcher
+            else None
+        )
+        return cls(
+            conf=conf,
+            cache=ResourceCache(cache_bytes),
+            arena=HbmArena(arena_bytes),
+            batcher=batcher,
+        )
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
+            self.batcher = None
+        self.arena.release_all()
+
+    def _inflate_fn(self):
+        if self.batcher is None:
+            return None
+        b = self.batcher
+        return lambda raw, co, cs, us: b.submit(raw, co, cs, us)
+
+
+def _pow2_rows(n: int) -> int:
+    from .warmup import OVERLAP_PAD_MIN, pow2_at_least
+
+    return pow2_at_least(max(n, 1), OVERLAP_PAD_MIN)
+
+
+def _overlap_rows(batch, rid: int, beg0: int, end0: int) -> np.ndarray:
+    """Row indices of records overlapping [beg0, end0) on refid ``rid``.
+
+    Device path: the ``overlap_mask`` kernel over pow2-padded columns
+    (padding rows carry refid -1, which never matches), so repeated
+    requests reuse the warmed jit geometry.  Any device failure falls
+    back to the identical NumPy formula — counted, never fatal.
+    """
+    n = batch.n_records
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    from ..ops.cigar import reference_lengths_np
+
+    refid = np.asarray(batch.soa["refid"], dtype=np.int32)
+    pos = np.asarray(batch.soa["pos"], dtype=np.int32)
+    ref_len = reference_lengths_np(batch.data, batch.soa).astype(np.int32)
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.cigar import overlap_mask
+
+        n_pad = _pow2_rows(n)
+        refid_p = np.full(n_pad, -1, dtype=np.int32)
+        pos_p = np.zeros(n_pad, dtype=np.int32)
+        len_p = np.zeros(n_pad, dtype=np.int32)
+        refid_p[:n] = refid
+        pos_p[:n] = pos
+        len_p[:n] = ref_len
+        mask = np.asarray(
+            overlap_mask(
+                jnp.asarray(refid_p),
+                jnp.asarray(pos_p),
+                jnp.asarray(len_p),
+                jnp.asarray(np.asarray([rid], dtype=np.int32)),
+                jnp.asarray(np.asarray([beg0], dtype=np.int32)),
+                jnp.asarray(np.asarray([end0], dtype=np.int32)),
+            )
+        )[:n]
+        METRICS.count("serve.view.overlap_device", 1)
+    except Exception:
+        end = pos.astype(np.int64) + np.maximum(ref_len, 1)
+        mask = (
+            (refid == rid) & (pos >= 0) & (pos < end0) & (end > beg0)
+        )
+        METRICS.count("serve.view.overlap_host", 1)
+    return np.nonzero(mask)[0].astype(np.int64)
+
+
+def view_records(
+    ctx: ServeContext, path: str, region: str
+) -> Tuple[bam.BamHeader, List[Tuple[object, np.ndarray]]]:
+    """Resolve a ranged query to (header, [(decoded window, row indices)]).
+
+    Windows come from the residency arena when warm; a miss reads the
+    chunk span through the lane batcher (shared launches with concurrent
+    requests) and holds the decoded batch for the next hit.
+    """
+    iv = parse_interval(region)
+    hdr, _ = ctx.cache.header(path)
+    try:
+        rid = hdr.ref_index(iv.contig)
+    except KeyError:
+        raise FormatError(
+            f"unknown contig {iv.contig!r} in {path!r}"
+        ) from None
+    beg0 = iv.start - 1  # 1-based inclusive → 0-based half-open
+    end0 = min(iv.end, MAX_END)
+    bai = ctx.cache.bai(path)
+    chunks = bai.query(rid, beg0, end0)
+    ident = ctx.cache.identity(path)
+    picks: List[Tuple[object, np.ndarray]] = []
+    from ..io.bam import BamInputFormat
+    from ..io.splits import FileVirtualSplit
+
+    fmt = BamInputFormat(ctx.conf)
+    for c in chunks:
+        key = ("view", ident, c.beg, c.end)
+        batch = ctx.arena.get(key)
+        if batch is None:
+            with span("serve.view.read"):
+                batch = fmt.read_split(
+                    FileVirtualSplit(path, c.beg, c.end),
+                    with_keys=False,
+                    fields=VIEW_FIELDS,
+                    inflate_fn=ctx._inflate_fn(),
+                )
+            ctx.arena.hold(key, batch)
+        rows = _overlap_rows(batch, rid, beg0, end0)
+        if len(rows):
+            picks.append((batch, rows))
+    return hdr, picks
+
+
+def view_blob(
+    ctx: ServeContext, path: str, region: str, level: int = 6
+) -> bytes:
+    """A complete small BAM (header + overlapping records + terminator)
+    for the requested region — records in file order, like samtools view.
+    """
+    from .. import native
+    from ..io.bam import gather_record_array
+    from ..io.merger import prepare_bam_header_block
+
+    with span("serve.view"):
+        hdr, picks = view_records(ctx, path, region)
+        payloads = [
+            gather_record_array(batch, rows) for batch, rows in picks
+        ]
+        n_records = sum(len(rows) for _, rows in picks)
+        payload = (
+            np.concatenate(payloads)
+            if payloads
+            else np.empty(0, np.uint8)
+        )
+        body = (
+            native.deflate_blocks(payload, level=level)
+            if len(payload)
+            else b""
+        )
+        blob = (
+            prepare_bam_header_block(hdr, level=level)
+            + body
+            + bgzf.TERMINATOR
+        )
+    METRICS.count("serve.view.requests", 1)
+    METRICS.count("serve.view.records", n_records)
+    return blob
+
+
+#: samtools-flagstat-class counter names, in report order.
+FLAGSTAT_KEYS = (
+    "total", "secondary", "supplementary", "duplicates", "mapped",
+    "paired", "read1", "read2", "properly_paired",
+    "with_itself_and_mate_mapped", "singletons",
+)
+
+
+def flagstat(ctx: ServeContext, path: str) -> dict:
+    """Whole-file flag census (the flagstat-class scan endpoint).
+
+    Splits stream through the same read path as the sort (flag column
+    only), with each decoded split held in the arena so a warm re-scan is
+    read-free; the counts are pure NumPy popcounts over the flag column.
+    """
+    with span("serve.flagstat"):
+        hdr, _ = ctx.cache.header(path)
+        ident = ctx.cache.identity(path)
+        from ..io.bam import BamInputFormat
+
+        fmt = BamInputFormat(ctx.conf)
+        counts = {k: 0 for k in FLAGSTAT_KEYS}
+        for s in fmt.get_splits([path]):
+            key = ("flagstat", ident, s.vstart, s.vend)
+            batch = ctx.arena.get(key)
+            if batch is None:
+                batch = fmt.read_split(
+                    s,
+                    with_keys=False,
+                    fields=FLAGSTAT_FIELDS,
+                    inflate_fn=ctx._inflate_fn(),
+                )
+                ctx.arena.hold(key, batch)
+            flag = np.asarray(batch.soa["flag"], dtype=np.int64)
+            mapped = (flag & bam.FLAG_UNMAPPED) == 0
+            paired = (flag & bam.FLAG_PAIRED) != 0
+            mate_mapped = (flag & bam.FLAG_MATE_UNMAPPED) == 0
+            counts["total"] += len(flag)
+            counts["secondary"] += int(
+                ((flag & bam.FLAG_SECONDARY) != 0).sum()
+            )
+            counts["supplementary"] += int(
+                ((flag & bam.FLAG_SUPPLEMENTARY) != 0).sum()
+            )
+            counts["duplicates"] += int(
+                ((flag & bam.FLAG_DUPLICATE) != 0).sum()
+            )
+            counts["mapped"] += int(mapped.sum())
+            counts["paired"] += int(paired.sum())
+            counts["read1"] += int(
+                (paired & ((flag & bam.FLAG_FIRST_OF_PAIR) != 0)).sum()
+            )
+            counts["read2"] += int(
+                (paired & ((flag & bam.FLAG_SECOND_OF_PAIR) != 0)).sum()
+            )
+            counts["properly_paired"] += int(
+                (
+                    paired
+                    & mapped
+                    & ((flag & bam.FLAG_PROPER_PAIR) != 0)
+                ).sum()
+            )
+            counts["with_itself_and_mate_mapped"] += int(
+                (paired & mapped & mate_mapped).sum()
+            )
+            counts["singletons"] += int(
+                (paired & mapped & ~mate_mapped).sum()
+            )
+    METRICS.count("serve.flagstat.requests", 1)
+    return counts

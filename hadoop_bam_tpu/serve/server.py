@@ -1,0 +1,369 @@
+"""The resident daemon: a long-lived process that owns the TPU.
+
+Transport is deliberately stdlib-only: a UNIX-domain socket (or a
+127.0.0.1 TCP port) carrying length-prefixed JSON — 4 bytes big-endian
+length, then a UTF-8 JSON object — one request per connection.  Binary
+payloads (the ``view`` response BAM) ride base64 in the JSON; at the
+"tiny responses, high QPS" design point the 4/3 expansion is noise next
+to skipping a cold start.
+
+Request ops:
+
+- ``ping``                         → liveness + endpoint info
+- ``view``  {path, region, level}  → base64 BAM of overlapping records
+- ``flagstat`` {path}              → flag census counters
+- ``sort``  {bam, output, ...}     → submit; returns a job id (the job
+  runs through ``pipeline.sort_bam``, whose part writes already ride
+  ``parallel.executor.ElasticExecutor`` — retries + atomic restarts)
+- ``job``   {id}                   → job status/stats
+- ``stats``                        → METRICS snapshot + cache/arena/batch
+- ``shutdown``                     → graceful drain: stop admitting,
+  finish in-flight jobs, reply, exit the accept loop
+
+Warm state (kernel jit caches via serve/warmup.py, header/index cache,
+HBM residency arena, the cross-request lane batcher) lives in one
+:class:`~hadoop_bam_tpu.serve.endpoints.ServeContext` for the daemon's
+lifetime — the whole point of being resident.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import struct
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from ..conf import (
+    Configuration,
+    SERVE_MAX_INFLIGHT,
+    SERVE_PORT,
+    SERVE_SOCKET,
+    SERVE_WARMUP,
+)
+from ..utils.tracing import METRICS, snapshot, transfers_report
+from .endpoints import ServeContext, flagstat, view_blob
+
+_LEN = struct.Struct(">I")
+MAX_MESSAGE = 1 << 30
+DEFAULT_MAX_INFLIGHT = 2
+
+
+def default_socket_path() -> str:
+    """Per-user default UDS path (localhost TCP is the opt-in)."""
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"hbam-serve-{uid}.sock")
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """One length-prefixed JSON message, or None on clean EOF."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_MESSAGE:
+        raise ValueError(f"message of {n} bytes exceeds cap {MAX_MESSAGE}")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("truncated message")
+    return json.loads(body.decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None  # clean EOF between messages
+            raise ConnectionError("truncated message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class BamDaemon:
+    """Accept loop + request dispatch + bounded job pool + drain."""
+
+    def __init__(
+        self,
+        conf: Optional[Configuration] = None,
+        socket_path: Optional[str] = None,
+        port: Optional[int] = None,
+        host: str = "127.0.0.1",
+        max_inflight: Optional[int] = None,
+        warmup: Optional[bool] = None,
+        warmup_kwargs: Optional[dict] = None,
+    ):
+        self.conf = conf or Configuration()
+        self.socket_path = socket_path or self.conf.get(SERVE_SOCKET)
+        self.port = (
+            port
+            if port is not None
+            else (self.conf.get_int(SERVE_PORT, 0) or None)
+        )
+        self.host = host
+        if self.socket_path is None and self.port is None:
+            self.socket_path = default_socket_path()
+        self.max_inflight = max_inflight or self.conf.get_int(
+            SERVE_MAX_INFLIGHT, DEFAULT_MAX_INFLIGHT
+        )
+        self.warmup = (
+            warmup
+            if warmup is not None
+            else self.conf.get_boolean(SERVE_WARMUP, True)
+        )
+        self.warmup_kwargs = warmup_kwargs or {}
+        self.warmup_report: Optional[dict] = None
+        self.ctx = ServeContext.from_conf(self.conf)
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._handlers: List[threading.Thread] = []
+        self._jobs: Dict[str, dict] = {}
+        self._jobs_lock = threading.Lock()
+        self._job_seq = 0
+        self._job_pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight,
+            thread_name_prefix="hbam-serve-job",
+        )
+        self._started_snapshot = snapshot()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def endpoint(self) -> dict:
+        if self.socket_path is not None:
+            return {"socket": self.socket_path}
+        return {"host": self.host, "port": self.port}
+
+    def start(self) -> None:
+        """Bind the listener and run the startup warm-up (idempotent)."""
+        if self._listener is not None:
+            return
+        if self.warmup and self.warmup_report is None:
+            from .warmup import warm_kernels
+
+            self.warmup_report = warm_kernels(
+                self.conf, **self.warmup_kwargs
+            )
+        if self.socket_path is not None:
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+            lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            lst.bind(self.socket_path)
+        else:
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind((self.host, self.port or 0))
+            self.port = lst.getsockname()[1]
+        lst.listen(64)
+        lst.settimeout(0.1)
+        self._listener = lst
+        METRICS.count("serve.daemon_starts", 1)
+
+    def serve_forever(self, ready: Optional[threading.Event] = None) -> None:
+        """Blocking accept loop until a ``shutdown`` request (or
+        :meth:`stop`).  ``ready`` is set once requests can connect —
+        the hook tests and the CLI's readiness print use."""
+        self.start()
+        if ready is not None:
+            ready.set()
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                t = threading.Thread(
+                    target=self._handle, args=(conn,), daemon=True
+                )
+                t.start()
+                self._handlers.append(t)
+                self._handlers = [h for h in self._handlers if h.is_alive()]
+        finally:
+            self._shutdown_cleanup()
+
+    def stop(self) -> None:
+        """Out-of-band stop (signal handlers); requests should prefer the
+        ``shutdown`` op, which drains jobs before stopping."""
+        self._stop.set()
+
+    def _shutdown_cleanup(self) -> None:
+        for h in list(self._handlers):
+            h.join(timeout=5.0)
+        self._job_pool.shutdown(wait=True)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        if self.socket_path and os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        self.ctx.close()
+
+    # -- request handling ---------------------------------------------------
+
+    def _handle(self, conn: socket.socket) -> None:
+        stop_after = False
+        try:
+            with conn:
+                req = recv_msg(conn)
+                if req is None:
+                    return
+                try:
+                    reply, stop_after = self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 - reply, don't die
+                    METRICS.count("serve.request_errors", 1)
+                    reply = {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                send_msg(conn, reply)
+        except Exception:
+            METRICS.count("serve.connection_errors", 1)
+        finally:
+            if stop_after:
+                self._stop.set()
+
+    def _dispatch(self, req: dict) -> Tuple[dict, bool]:
+        op = req.get("op")
+        METRICS.count(f"serve.op.{op}", 1)
+        if op == "ping":
+            return (
+                {
+                    "ok": True,
+                    "pid": os.getpid(),
+                    "endpoint": self.endpoint,
+                    "draining": self._draining.is_set(),
+                },
+                False,
+            )
+        if op == "view":
+            blob = view_blob(
+                self.ctx,
+                req["path"],
+                req["region"],
+                level=int(req.get("level", 6)),
+            )
+            return (
+                {
+                    "ok": True,
+                    "data_b64": base64.b64encode(blob).decode("ascii"),
+                },
+                False,
+            )
+        if op == "flagstat":
+            return ({"ok": True, "counts": flagstat(self.ctx, req["path"])}, False)
+        if op == "sort":
+            if self._draining.is_set():
+                return ({"ok": False, "error": "daemon is draining"}, False)
+            return ({"ok": True, "job": self._submit_sort(req)}, False)
+        if op == "job":
+            with self._jobs_lock:
+                job = self._jobs.get(req.get("id"))
+            if job is None:
+                return ({"ok": False, "error": "unknown job id"}, False)
+            return ({"ok": True, **job}, False)
+        if op == "stats":
+            return ({"ok": True, **self._stats()}, False)
+        if op == "shutdown":
+            return (self._drain(), True)
+        return ({"ok": False, "error": f"unknown op {op!r}"}, False)
+
+    # -- sort jobs ----------------------------------------------------------
+
+    def _submit_sort(self, req: dict) -> str:
+        with self._jobs_lock:
+            self._job_seq += 1
+            jid = f"job-{self._job_seq:04d}"
+            self._jobs[jid] = {
+                "status": "queued",
+                "output": req.get("output"),
+            }
+        self._job_pool.submit(self._run_sort, jid, dict(req))
+        METRICS.count("serve.jobs_submitted", 1)
+        return jid
+
+    def _run_sort(self, jid: str, req: dict) -> None:
+        with self._jobs_lock:
+            self._jobs[jid]["status"] = "running"
+        try:
+            from ..pipeline import sort_bam
+
+            paths = req["bam"]
+            if isinstance(paths, str):
+                paths = [paths]
+            stats = sort_bam(
+                paths,
+                req["output"],
+                conf=self.conf,
+                level=int(req.get("level", 6)),
+                memory_budget=req.get("memory_budget"),
+                write_splitting_bai=bool(req.get("write_splitting_bai")),
+                mark_duplicates=bool(req.get("mark_duplicates")),
+                resource_cache=self.ctx.cache,
+            )
+            with self._jobs_lock:
+                self._jobs[jid].update(
+                    status="done",
+                    stats={
+                        "n_records": stats.n_records,
+                        "n_splits": stats.n_splits,
+                        "backend": stats.backend,
+                        "n_duplicates": stats.n_duplicates,
+                    },
+                )
+        except Exception as e:  # noqa: BLE001 - job status carries it
+            METRICS.count("serve.jobs_failed", 1)
+            with self._jobs_lock:
+                self._jobs[jid].update(
+                    status="failed", error=f"{type(e).__name__}: {e}"
+                )
+
+    # -- stats / drain ------------------------------------------------------
+
+    def _stats(self) -> dict:
+        report = snapshot()
+        report["transfers"] = transfers_report(report["counters"])
+        with self._jobs_lock:
+            jobs = {k: dict(v) for k, v in self._jobs.items()}
+        return {
+            "metrics": report,
+            "cache": self.ctx.cache.stats(),
+            "arena": self.ctx.arena.stats(),
+            "jobs": jobs,
+            "warmup": self.warmup_report,
+            "draining": self._draining.is_set(),
+        }
+
+    def _drain(self) -> dict:
+        """Graceful shutdown: refuse new jobs, finish the in-flight ones,
+        report what was drained.  The caller gets the reply before the
+        accept loop exits (the stop flag is set by the handler after the
+        reply is on the wire)."""
+        self._draining.set()
+        self._job_pool.shutdown(wait=True)
+        with self._jobs_lock:
+            statuses = [j["status"] for j in self._jobs.values()]
+        METRICS.count("serve.drains", 1)
+        return {
+            "ok": True,
+            "drained": True,
+            "jobs_total": len(statuses),
+            "jobs_done": sum(1 for s in statuses if s == "done"),
+            "jobs_failed": sum(1 for s in statuses if s == "failed"),
+        }

@@ -1,0 +1,203 @@
+"""Startup warm-up: pre-compile the pow2 kernel geometries, count compiles.
+
+Every jit entry point in the pipeline buckets its launch shapes to powers
+of two precisely so the set of distinct compiled geometries stays small —
+which makes them *enumerable*: a daemon can compile the whole working set
+once at startup and answer its first request warm.  ``warm_kernels``
+drives the real wrappers (``ops.flate`` codec tiers, the ``ops.cigar``
+overlap kernel, the sort keys program) over representative bucket sizes;
+whatever geometry a request would hit afterwards is already in the jit
+cache.
+
+The other half is *proving* warmth: :class:`CompileWatcher` hooks
+``jax.monitoring`` and counts every XLA backend compile into METRICS
+(``serve.jit_compiles``), so "a warm view request triggers zero kernel
+compiles" is an asserted counter delta, not a hope.  The listener is
+process-global and idempotent; when the monitoring API is unavailable the
+counter simply never moves (and tests that depend on it skip).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.tracing import METRICS, span
+
+_WATCHER = None
+_WATCHER_LOCK = threading.Lock()
+
+#: Warmable kernel families (the ``kinds`` vocabulary of warm_kernels).
+ALL_KINDS = ("overlap", "keys", "codec")
+
+#: Pow2 payload buckets for the codec warm-up when a real accelerator is
+#: present: small member, mid member, and the part writer's full-size
+#: blocking (DEV_LZ_PAYLOAD rides the last bucket's geometry).
+TPU_CODEC_BUCKETS = (4096, 16384, 57088)
+#: Interpret-mode (CPU) bucket: one tiny member — geometry coverage
+#: without minutes of interpret emulation (see the kernel-test budget
+#: note in tests/test_stream_codecs.py).
+CPU_CODEC_BUCKETS = (1024,)
+
+#: Row-count buckets for the overlap/keys programs: the serve endpoints
+#: pad record counts to pow2 ≥ OVERLAP_PAD_MIN, so these are exactly the
+#: shapes requests produce.
+OVERLAP_PAD_MIN = 64
+DEFAULT_ROW_BUCKETS = (64, 256, 1024, 4096)
+
+
+class CompileWatcher:
+    """Counts XLA backend compiles via the jax.monitoring event stream."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.available = False
+        try:
+            import jax.monitoring as monitoring
+
+            def _on_duration(key: str, *args, **kwargs) -> None:
+                if "backend_compile" in key:
+                    self.count += 1
+                    METRICS.count("serve.jit_compiles", 1)
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            self.available = True
+        except Exception:  # pragma: no cover - monitoring API moved away
+            pass
+
+
+def ensure_compile_watcher() -> CompileWatcher:
+    """The process-global watcher (registered once; jax.monitoring has no
+    unregister-by-handle, so a singleton avoids double counting)."""
+    global _WATCHER
+    with _WATCHER_LOCK:
+        if _WATCHER is None:
+            _WATCHER = CompileWatcher()
+        return _WATCHER
+
+
+def compile_count() -> int:
+    """Backend compiles observed so far (0 until the watcher exists)."""
+    w = _WATCHER
+    return w.count if w is not None else 0
+
+
+def pow2_at_least(n: int, lo: int = OVERLAP_PAD_MIN) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+def _warm_overlap(row_buckets: Sequence[int]) -> int:
+    """Compile the interval-overlap kernel at every request pad shape
+    (K=1 interval — the view endpoint queries one region at a time)."""
+    import jax.numpy as jnp
+
+    from ..ops.cigar import overlap_mask
+
+    done = 0
+    for n in row_buckets:
+        z = jnp.zeros(n, dtype=jnp.int32)
+        overlap_mask(
+            z - 1,  # refid -1: padding rows, never match
+            z,
+            z,
+            jnp.zeros(1, dtype=jnp.int32),
+            jnp.zeros(1, dtype=jnp.int32),
+            jnp.ones(1, dtype=jnp.int32),
+        ).block_until_ready()
+        done += 1
+    return done
+
+
+def _warm_keys(row_buckets: Sequence[int]) -> int:
+    """Compile the two-column key sort at the same pow2 row buckets."""
+    import jax.numpy as jnp
+
+    from ..ops.sort import sort_keys
+
+    done = 0
+    for n in row_buckets:
+        # Same dtypes as ops.keys.split_keys_np produces on the hot path.
+        hi = jnp.zeros(n, dtype=jnp.int32)
+        lo = jnp.zeros(n, dtype=jnp.uint32)
+        _, _, perm = sort_keys(hi, lo)
+        perm.block_until_ready()
+        done += 1
+    return done
+
+
+def _warm_codec(buckets: Sequence[int], conf) -> int:
+    """Round one synthetic payload per bucket through both device codec
+    wrappers, compiling whichever tiers the gates select (lanes kernels
+    when enabled, the XLA fixed/dynamic programs otherwise)."""
+    from ..ops import flate
+
+    rng = np.random.default_rng(0)
+    done = 0
+    for b in buckets:
+        # Compressible-but-nontrivial bytes: exercises real match/Huffman
+        # paths rather than the all-zero fast cases.
+        payload = rng.integers(0, 8, size=b, dtype=np.uint8)
+        blob = flate.bgzf_compress_device(
+            payload, level=1, conf=conf, block_payload=min(b, 57088)
+        )
+        flate.bgzf_decompress_device(blob, conf=conf)
+        done += 1
+    return done
+
+
+def warm_kernels(
+    conf=None,
+    kinds: Optional[Iterable[str]] = None,
+    codec_buckets: Optional[Sequence[int]] = None,
+    row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS,
+) -> Dict[str, object]:
+    """Pre-compile the daemon's kernel working set; returns a report.
+
+    ``kinds`` defaults to everything warmable, with the codec family
+    auto-sized to the backend: full-size pow2 buckets on a real
+    accelerator, one tiny interpret-mode bucket on CPU (compiling is the
+    point; emulating 64 KiB members is not).  Each family is independent
+    and failure-isolated — a broken tier records an error string instead
+    of killing startup (the request path has its own tier-downs).
+    """
+    ensure_compile_watcher()
+    kinds = tuple(kinds) if kinds is not None else ALL_KINDS
+    unknown = set(kinds) - set(ALL_KINDS)
+    if unknown:
+        raise ValueError(f"unknown warm-up kinds: {sorted(unknown)}")
+    if codec_buckets is None:
+        try:
+            import jax
+
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            on_tpu = False
+        codec_buckets = TPU_CODEC_BUCKETS if on_tpu else CPU_CODEC_BUCKETS
+    c0 = compile_count()
+    report: Dict[str, object] = {
+        "kinds": list(kinds),
+        "codec_buckets": list(codec_buckets),
+        "row_buckets": list(row_buckets),
+        "warmed": {},
+        "errors": {},
+    }
+    steps = {
+        "overlap": lambda: _warm_overlap(row_buckets),
+        "keys": lambda: _warm_keys(row_buckets),
+        "codec": lambda: _warm_codec(codec_buckets, conf),
+    }
+    with span("serve.warmup"):
+        for kind in kinds:
+            try:
+                report["warmed"][kind] = steps[kind]()
+            except Exception as e:  # noqa: BLE001 - startup must survive
+                report["errors"][kind] = f"{type(e).__name__}: {e}"
+                METRICS.count("serve.warmup_errors", 1)
+    report["compiles"] = compile_count() - c0
+    METRICS.count("serve.warmup_runs", 1)
+    return report

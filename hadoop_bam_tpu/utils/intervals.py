@@ -5,12 +5,25 @@ Reference semantics: util/IntervalUtil.java:27-53 — a comma-separated list of
 configuration property (e.g. ``hadoopbam.bam.intervals``,
 BAMInputFormat.java:89-111).  The last ``:`` splits contig from the range so
 contig names may themselves contain ``:``.
+
+On top of the reference grammar, :func:`parse_interval` accepts the two
+samtools-style shorthands the ``view`` endpoint needs: a bare ``contig``
+(no colon at all) means the whole contig (``1-MAX_END``), and
+``contig:pos`` (numeric, no dash) means the single position ``pos-pos``.
+A contig name that itself contains ``:`` still requires the explicit
+``contig:start-stop`` form — the shorthand never guesses where such a
+name ends (the same ambiguity samtools resolves with ``{...}`` quoting).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import List, Optional
+
+#: Largest representable 1-based position: the BAI binning scheme (SAM spec
+#: §5.3) addresses coordinates below 2^29, so a whole-contig shorthand ends
+#: here — callers with a header in hand may clamp tighter.
+MAX_END = (1 << 29) - 1
 
 
 class FormatError(ValueError):
@@ -32,12 +45,30 @@ class Interval:
 
 def parse_interval(text: str) -> Interval:
     colon = text.rfind(":")
-    if colon <= 0 or colon == len(text) - 1:
+    if colon < 0:
+        # Bare-contig shorthand: the whole contig.
+        if not text:
+            raise FormatError("empty interval")
+        return Interval(text, 1, MAX_END)
+    if colon == 0 or colon == len(text) - 1:
         raise FormatError(f"no contig:start-stop in interval '{text}'")
     contig = text[:colon]
     rng = text[colon + 1 :]
     dash = rng.find("-")
-    if dash <= 0 or dash == len(rng) - 1:
+    if dash < 0:
+        # Single-position shorthand: contig:pos.  Only a clean integer
+        # qualifies — anything else is malformed, not a contig name (a
+        # name containing ':' must use the explicit range form).
+        try:
+            pos = int(rng)
+        except ValueError as e:
+            raise FormatError(
+                f"non-integer position in interval '{text}'"
+            ) from e
+        if pos < 1:
+            raise FormatError(f"invalid position in interval '{text}'")
+        return Interval(contig, pos, pos)
+    if dash == 0 or dash == len(rng) - 1:
         raise FormatError(f"no start-stop in interval '{text}'")
     try:
         start = int(rng[:dash])

@@ -80,6 +80,44 @@ def count_d2h(nbytes: int, what: str = "") -> None:
         METRICS.count(f"transfers.d2h.{what}", n)
 
 
+def snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Dict[str, float]]:
+    """A point-in-time copy of the registry's counters + spans.
+
+    Pair with :func:`delta` for per-request accounting in long-lived
+    processes (the serve daemon): the process-global counters keep
+    accumulating — resetting them mid-flight would corrupt every other
+    in-flight request's numbers — and each request reports
+    ``delta(snapshot_at_admission)`` instead."""
+    return (registry or METRICS).report()
+
+
+def delta(
+    before: Dict[str, Dict[str, float]],
+    after: Optional[Dict[str, Dict[str, float]]] = None,
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Per-section difference of two :func:`snapshot` reports.
+
+    ``after`` defaults to a fresh snapshot.  Only keys whose value moved
+    are kept, so a request's report shows exactly the counters/spans it
+    touched.  Counters never decrease, but the diff is computed signed so
+    a misuse (swapped arguments) is visible rather than silently clamped.
+    """
+    if after is None:
+        after = snapshot(registry)
+    out: Dict[str, Dict[str, float]] = {}
+    for section in ("counters", "span_seconds", "span_counts"):
+        b = before.get(section, {})
+        a = after.get(section, {})
+        d = {}
+        for k in set(a) | set(b):
+            v = a.get(k, 0) - b.get(k, 0)
+            if v:
+                d[k] = v
+        out[section] = d
+    return out
+
+
 def transfers_report(counters: Optional[Dict[str, int]] = None) -> Dict[str, int]:
     """The ``transfers`` block of the CLI ``--metrics`` JSON: every
     ledger counter with the ``transfers.`` prefix stripped."""

@@ -54,6 +54,12 @@ def pytest_configure(config):
         "blocking; needs a real accelerator, skipped when JAX_PLATFORMS "
         "pins cpu",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: resident service mode (serve/) tests — daemon, cache, "
+        "arena, lane batching, warm-up (run everywhere; the kernel-side "
+        "pieces use interpret mode under a cpu pin)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

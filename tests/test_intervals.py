@@ -1,6 +1,7 @@
 import pytest
 
 from hadoop_bam_tpu.utils.intervals import (
+    MAX_END,
     FormatError,
     Interval,
     parse_interval,
@@ -11,6 +12,32 @@ from hadoop_bam_tpu.utils.intervals import (
 def test_parse_single():
     iv = parse_interval("chr1:100-200")
     assert iv == Interval("chr1", 100, 200)
+
+
+def test_bare_contig_shorthand():
+    # samtools-style: a bare contig means the whole contig.
+    assert parse_interval("chr1") == Interval("chr1", 1, MAX_END)
+    assert parse_interval("HLA-DRB1*15") == Interval(
+        "HLA-DRB1*15", 1, MAX_END
+    )
+
+
+def test_single_position_shorthand():
+    # samtools-style: contig:pos is the single position pos-pos.
+    assert parse_interval("chr1:5") == Interval("chr1", 5, 5)
+    # The last colon still splits, so colon-bearing contigs compose.
+    assert parse_interval("HLA-DRB1*15:01:7") == Interval(
+        "HLA-DRB1*15:01", 7, 7
+    )
+
+
+def test_shorthand_in_list_property():
+    ivs = parse_intervals("chr1,chr2:20-30,chr3:7")
+    assert ivs == [
+        Interval("chr1", 1, MAX_END),
+        Interval("chr2", 20, 30),
+        Interval("chr3", 7, 7),
+    ]
 
 
 def test_contig_with_colon():
@@ -29,7 +56,10 @@ def test_parse_list_property():
 
 @pytest.mark.parametrize(
     "bad",
-    ["chr1", "chr1:", "chr1:5", "chr1:5-", "chr1:-5", "chr1:a-b", "chr1:9-3", ":1-2"],
+    # "chr1" and "chr1:5" became the whole-contig / single-position
+    # shorthands; genuinely malformed input still raises.
+    ["", "chr1:", "chr1:5-", "chr1:-5", "chr1:a-b", "chr1:9-3", ":1-2",
+     "chr1:0", "chr1:x"],
 )
 def test_malformed(bad):
     with pytest.raises(FormatError):

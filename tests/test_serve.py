@@ -1,0 +1,486 @@
+"""Resident service mode (serve/): cache identity, lane coalescing,
+warm-vs-cold compile counting, UDS round trip, graceful drain.
+
+Everything runs under the CPU pin: the daemon and endpoints are
+host-orchestration code, and the one kernel-touching piece (the shared
+decompress launch) uses interpret mode over tiny members per the kernel
+test budget (≤3 KiB members always-on; full-size geometry rides the
+``slow``+``device_stream`` suites).  Warmth claims are asserted as
+counter deltas — ``serve.cache.miss``, ``serve.arena.hit``,
+``serve.jit_compiles`` — not inferred.
+"""
+
+import io
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import native
+from hadoop_bam_tpu.pipeline import sort_bam
+from hadoop_bam_tpu.serve import (
+    BamDaemon,
+    HbmArena,
+    LaneBatcher,
+    LruByteCache,
+    ResourceCache,
+    ServeClient,
+    ServeContext,
+    ServeError,
+    ensure_compile_watcher,
+    flagstat,
+    view_blob,
+    warm_kernels,
+)
+from hadoop_bam_tpu.spec import bam, bgzf, indices
+from hadoop_bam_tpu.utils.tracing import delta, snapshot
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# Fixtures: a tiny coordinate-sorted BAM with a .bai companion
+# ---------------------------------------------------------------------------
+
+
+def _write_unsorted_bam(path: str, n: int = 240, seed: int = 0) -> None:
+    refs = [("chr1", 1_000_000), ("chr2", 1_000_000)]
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n"
+        "@SQ\tSN:chr1\tLN:1000000\n@SQ\tSN:chr2\tLN:1000000",
+        refs,
+    )
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    w = bgzf.BgzfWriter(buf, level=1, append_terminator=True)
+    w.write(hdr.encode())
+    for i in range(n):
+        flag = bam.FLAG_PAIRED | (
+            bam.FLAG_FIRST_OF_PAIR if i % 2 == 0 else bam.FLAG_SECOND_OF_PAIR
+        )
+        refid = int(rng.integers(0, 2))
+        pos = int(rng.integers(0, 900_000))
+        cigar = [(50, "M")]
+        if i % 17 == 0:
+            # Unplaced-unmapped (refid -1): the pipeline hash-keys
+            # unmapped records to the tail, so a *placed* unmapped record
+            # would break the coordinate order the BAI linear index
+            # assumes — use the conventional unplaced form.
+            flag |= bam.FLAG_UNMAPPED
+            refid = pos = -1
+            cigar = []
+        rec = bam.build_record(
+            name=f"r{i:05d}",
+            refid=refid,
+            pos=pos,
+            mapq=60,
+            flag=flag,
+            cigar=cigar,
+            seq="A" * 50,
+            qual=bytes([30] * 50),
+        )
+        w.write(rec.encode())
+    w.close()
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+@pytest.fixture(scope="module")
+def sorted_bam(tmp_path_factory) -> str:
+    tmp = tmp_path_factory.mktemp("serve")
+    src = str(tmp / "unsorted.bam")
+    out = str(tmp / "sorted.bam")
+    _write_unsorted_bam(src)
+    sort_bam([src], out, backend="host")
+    with open(out + ".bai", "wb") as f:
+        indices.build_bai(out).save(f)
+    return out
+
+
+def _decode_blob_names(blob: bytes) -> list:
+    rdr = bgzf.BgzfReader(blob)
+    bam.read_header_stream(rdr)
+    names = []
+    while not rdr.at_eof:
+        sb = rdr.read(4)
+        if len(sb) < 4:
+            break
+        (bs,) = struct.unpack("<I", sb)
+        body = rdr.read_fully(bs)
+        rec, _ = bam.decode_record(sb + body, 0)
+        names.append(rec.read_name)
+    return names
+
+
+def _oracle_names(path: str, rid: int, beg0: int, end0: int) -> set:
+    from hadoop_bam_tpu.io.bam import BamInputFormat
+
+    fmt = BamInputFormat()
+    names = set()
+    for s in fmt.get_splits([path], split_size=1 << 20):
+        for r in fmt.read_split(s).records():
+            # Same formula as the endpoint's overlap cut: placed records
+            # (including placed-unmapped) overlapping [beg0, end0).
+            if (
+                r.refid == rid
+                and r.pos >= 0
+                and r.pos < end0
+                and r.pos + max(r.reference_length(), 1) > beg0
+            ):
+                names.add(r.read_name)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# Cache: identity keys, hit/miss/stale, LRU byte budget
+# ---------------------------------------------------------------------------
+
+
+def test_cache_identity_hit_miss_and_mtime_invalidation(sorted_bam):
+    cache = ResourceCache(budget_bytes=1 << 20)
+    s0 = snapshot()
+    h1 = cache.header(sorted_bam)
+    b1 = cache.bai(sorted_bam)
+    d = delta(s0)["counters"]
+    assert d.get("serve.cache.miss") == 2  # header + bai, both cold
+    assert "serve.cache.hit" not in d
+
+    s0 = snapshot()
+    assert cache.header(sorted_bam) is h1
+    assert cache.bai(sorted_bam) is b1
+    d = delta(s0)["counters"]
+    assert d.get("serve.cache.hit") == 2
+    assert "serve.cache.miss" not in d
+
+    # mtime bump = new file identity: the entry must invalidate (stale +
+    # miss + reload), never serve the old object.
+    st = os.stat(sorted_bam)
+    os.utime(sorted_bam, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    s0 = snapshot()
+    h2 = cache.header(sorted_bam)
+    d = delta(s0)["counters"]
+    assert d.get("serve.cache.stale") == 1
+    assert d.get("serve.cache.miss") == 1
+    assert h2 is not h1
+
+
+def test_cache_lru_byte_budget_eviction(tmp_path):
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"f{i}")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        paths.append(p)
+    cache = LruByteCache(budget_bytes=250, name="serve.cache")
+    s0 = snapshot()
+    for p in paths:
+        cache.put("blob", p, b"v", 100)
+    d = delta(s0)["counters"]
+    assert d.get("serve.cache.evict") == 1  # 3x100 > 250 → oldest out
+    assert cache.used_bytes <= 250
+    assert cache.get("blob", paths[0]) is None  # the evicted one
+    assert cache.get("blob", paths[2]) == b"v"
+
+
+# ---------------------------------------------------------------------------
+# Batching: concurrent requests share one decompress launch
+# ---------------------------------------------------------------------------
+
+
+def _members(payload: np.ndarray, block_payload: int = 512):
+    blob = native.deflate_blocks(payload, level=1, block_payload=block_payload)
+    co, cs, us = native.scan_blocks(blob)
+    return np.frombuffer(blob, np.uint8), co, cs, us
+
+
+def _submit_concurrently(batcher, works):
+    res = [None] * len(works)
+
+    def go(i):
+        res[i] = batcher.submit(*works[i])
+
+    ts = [
+        threading.Thread(target=go, args=(i,)) for i in range(len(works))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return res
+
+
+def test_batcher_coalesces_two_requests_into_one_launch():
+    p1 = np.frombuffer(bytes(range(256)) * 4, np.uint8)  # 1 KiB
+    p2 = np.frombuffer(b"ACGT" * 256, np.uint8)  # 1 KiB
+    works = [_members(p1), _members(p2)]
+    b = LaneBatcher(window_s=0.25)
+    s0 = snapshot()
+    try:
+        res = _submit_concurrently(b, works)
+    finally:
+        b.close()
+    d = delta(s0)["counters"]
+    assert res[0][0].tobytes() == p1.tobytes()
+    assert res[1][0].tobytes() == p2.tobytes()
+    # Per-request offsets are rebased to each request's own slice.
+    assert res[0][1][0] == 0 and res[0][1][-1] == len(p1)
+    assert d["serve.batch.launches"] == 1
+    assert d["serve.batch.requests"] == 2
+    assert d["serve.batch.coalesced_requests"] == 2
+    assert d["serve.batch.members"] == len(works[0][1]) + len(works[1][1])
+
+
+def test_batcher_shared_launch_on_device_tier(monkeypatch):
+    """The acceptance claim: two concurrent small requests' members ride
+    ONE 128-lane decompress launch — here with the lanes tier forced on
+    (interpret mode under the CPU pin; tiny members per the test
+    budget), so the coalesced call really is the device wrapper."""
+    from hadoop_bam_tpu.serve.batching import default_decode_fn
+
+    monkeypatch.setenv("HBAM_INFLATE_LANES", "1")
+    p1 = np.frombuffer(b"serve-lane-batch!" * 32, np.uint8)  # ~0.5 KiB
+    p2 = np.frombuffer(bytes(range(128)) * 4, np.uint8)  # 0.5 KiB
+    works = [_members(p1, 256), _members(p2, 256)]
+    b = LaneBatcher(window_s=0.5, decode_fn=default_decode_fn())
+    s0 = snapshot()
+    try:
+        res = _submit_concurrently(b, works)
+    finally:
+        b.close()
+    d = delta(s0)["counters"]
+    assert res[0][0].tobytes() == p1.tobytes()
+    assert res[1][0].tobytes() == p2.tobytes()
+    assert d["serve.batch.launches"] == 1
+    assert d["serve.batch.coalesced_requests"] == 2
+
+
+def test_batcher_error_propagates_to_all_waiters():
+    def boom(raw, co, cs, us):
+        raise RuntimeError("decode exploded")
+
+    b = LaneBatcher(window_s=0.1, decode_fn=boom)
+    p = np.zeros(64, np.uint8)
+    try:
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            b.submit(*_members(p))
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Warm-up + the view endpoint's warmth contract
+# ---------------------------------------------------------------------------
+
+
+def test_warm_kernels_idempotent_compile_count():
+    rep = warm_kernels(kinds=("overlap", "keys"), row_buckets=(64, 256))
+    assert rep["warmed"] == {"overlap": 2, "keys": 2}
+    assert not rep["errors"]
+    # Same buckets again: every geometry is in the jit cache already.
+    rep2 = warm_kernels(kinds=("overlap", "keys"), row_buckets=(64, 256))
+    assert rep2["compiles"] == 0
+
+
+def test_view_matches_oracle_and_shorthand(sorted_bam):
+    ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        blob = view_blob(ctx, sorted_bam, "chr1:100000-300000")
+        got = set(_decode_blob_names(blob))
+        exp = _oracle_names(sorted_bam, 0, 99_999, 300_000)
+        assert got == exp and got  # non-empty and exact
+        # Bare-contig and single-position shorthands resolve through the
+        # same path (whole contig == explicit max range; pos == pos-pos).
+        assert view_blob(ctx, sorted_bam, "chr2") == view_blob(
+            ctx, sorted_bam, f"chr2:1-{(1 << 29) - 1}"
+        )
+        names = _decode_blob_names(view_blob(ctx, sorted_bam, "chr2"))
+        assert set(names) == _oracle_names(
+            sorted_bam, 1, 0, (1 << 29) - 1
+        )
+    finally:
+        ctx.close()
+
+
+def test_warm_view_zero_compiles_zero_rereads(sorted_bam):
+    """The acceptance criterion: a warm ``view`` on a cached index does
+    zero kernel compiles and zero header/index re-reads — asserted via
+    the compile watcher and the cache/arena counters."""
+    watcher = ensure_compile_watcher()
+    if not watcher.available:
+        pytest.skip("jax.monitoring compile events unavailable")
+    warm_kernels(kinds=("overlap",), row_buckets=(64, 256, 1024))
+    ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        cold = view_blob(ctx, sorted_bam, "chr1:200000-400000")
+        s0 = snapshot()
+        warm = view_blob(ctx, sorted_bam, "chr1:200000-400000")
+        d = delta(s0)["counters"]
+    finally:
+        ctx.close()
+    assert warm == cold
+    assert "serve.jit_compiles" not in d, d  # zero kernel compiles
+    assert "serve.cache.miss" not in d, d  # zero header/index re-reads
+    assert "serve.arena.miss" not in d, d  # zero window re-decodes
+    assert d.get("serve.cache.hit", 0) >= 2  # header + bai served warm
+    assert d.get("serve.arena.hit", 0) >= 1
+
+
+def test_arena_lru_eviction_and_stats():
+    arena = HbmArena(budget_bytes=300)
+
+    class _B:
+        def __init__(self, n):
+            self.data = np.zeros(n, np.uint8)
+            self.soa = {}
+            self.keys = None
+            self.device_data = None
+
+    s0 = snapshot()
+    arena.hold("a", _B(120))
+    arena.hold("b", _B(120))
+    arena.hold("c", _B(120))  # evicts "a"
+    d = delta(s0)["counters"]
+    assert d.get("serve.arena.evict") == 1
+    assert arena.get("a") is None
+    assert arena.get("c") is not None
+    st = arena.stats()
+    assert st["entries"] == 2 and st["used_bytes"] <= 300
+
+
+# ---------------------------------------------------------------------------
+# Daemon: UDS round trip, byte identity, jobs, graceful drain
+# ---------------------------------------------------------------------------
+
+
+def _start_daemon(tmp_path, **kw) -> tuple:
+    sock = str(tmp_path / "serve.sock")
+    d = BamDaemon(socket_path=sock, warmup=False, **kw)
+    ready = threading.Event()
+    t = threading.Thread(target=d.serve_forever, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(20), "daemon did not come up"
+    return d, t, ServeClient(socket_path=sock)
+
+
+def test_daemon_uds_roundtrip_byte_identical(sorted_bam, tmp_path):
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        assert client.ping()["ok"]
+        served = client.view(sorted_bam, "chr1:100000-300000", level=6)
+        ctx = ServeContext.from_conf(with_batcher=False)
+        try:
+            oneshot = view_blob(ctx, sorted_bam, "chr1:100000-300000")
+            direct_fs = flagstat(ctx, sorted_bam)
+        finally:
+            ctx.close()
+        assert served == oneshot  # daemon == one-shot CLI path, exactly
+        assert client.flagstat(sorted_bam) == direct_fs
+        stats = client.stats()
+        assert stats["metrics"]["counters"]["serve.op.view"] >= 1
+        assert "cache" in stats and "arena" in stats
+        with pytest.raises(ServeError, match="unknown op"):
+            client._request({"op": "nonsense"})
+        with pytest.raises(ServeError, match="unknown contig"):
+            client.view(sorted_bam, "chrZZ:1-10")
+    finally:
+        client.shutdown()
+        t.join(timeout=20)
+    assert not t.is_alive()
+
+
+def test_daemon_concurrent_views_share_one_launch(sorted_bam, tmp_path):
+    """Two concurrent small ``view`` requests on a cold arena must share
+    a single decompress launch through the daemon's lane batcher."""
+    from hadoop_bam_tpu.conf import SERVE_BATCH_WINDOW_MS, Configuration
+
+    conf = Configuration({SERVE_BATCH_WINDOW_MS: "200"})  # generous window
+    d, t, _ = _start_daemon(tmp_path, conf=conf)
+    c1 = ServeClient(socket_path=d.socket_path)
+    c2 = ServeClient(socket_path=d.socket_path)
+    try:
+        s0 = snapshot()
+        res = [None, None]
+        t1 = threading.Thread(
+            target=lambda: res.__setitem__(
+                0, c1.view(sorted_bam, "chr1:100000-300000")
+            )
+        )
+        t2 = threading.Thread(
+            target=lambda: res.__setitem__(
+                1, c2.view(sorted_bam, "chr2:100000-300000")
+            )
+        )
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        dcnt = delta(s0)["counters"]
+        assert res[0] is not None and res[1] is not None
+        assert dcnt.get("serve.batch.requests", 0) >= 2
+        assert dcnt.get("serve.batch.coalesced_requests", 0) >= 2, dcnt
+        assert dcnt["serve.batch.launches"] < dcnt["serve.batch.requests"]
+    finally:
+        c1.shutdown()
+        t.join(timeout=20)
+
+
+def test_daemon_sort_job_and_graceful_drain(sorted_bam, tmp_path):
+    d, t, client = _start_daemon(tmp_path)
+    out = str(tmp_path / "resorted.bam")
+    jid = client.sort(sorted_bam, out, level=1)
+    # Drain immediately: the daemon must finish the in-flight job before
+    # replying, and the reply must account for it.
+    r = client.shutdown()
+    assert r["drained"] and r["jobs_total"] == 1
+    assert r["jobs_done"] == 1 and r["jobs_failed"] == 0
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # The drained job's output is a complete, readable BAM.
+    from hadoop_bam_tpu.io.bam import read_header
+
+    assert os.path.exists(out)
+    assert read_header(out).n_refs == 2
+    # The daemon refuses new connections after drain.
+    with pytest.raises(OSError):
+        client.ping()
+
+
+def test_daemon_rejects_sort_while_draining(sorted_bam, tmp_path):
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        d._draining.set()  # simulate a drain in progress
+        with pytest.raises(ServeError, match="draining"):
+            client.sort(sorted_bam, str(tmp_path / "x.bam"))
+    finally:
+        client.shutdown()
+        t.join(timeout=20)
+
+
+# ---------------------------------------------------------------------------
+# One-shot CLI parity
+# ---------------------------------------------------------------------------
+
+
+def test_cli_view_and_flagstat_one_shot(sorted_bam, tmp_path, capsys):
+    from hadoop_bam_tpu.cli import main
+
+    out = str(tmp_path / "view.bam")
+    assert main(["view", sorted_bam, "chr1:100000-300000", "-o", out]) == 0
+    ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        expect = view_blob(ctx, sorted_bam, "chr1:100000-300000")
+        expect_fs = flagstat(ctx, sorted_bam)
+    finally:
+        ctx.close()
+    with open(out, "rb") as f:
+        assert f.read() == expect
+
+    capsys.readouterr()
+    assert main(["flagstat", sorted_bam]) == 0
+    import json
+
+    printed = json.loads(capsys.readouterr().out)
+    assert printed == expect_fs
+    assert printed["total"] == 240
