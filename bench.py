@@ -257,6 +257,15 @@ def _measure(platform: str) -> dict:
         out.update(_serve_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["serve_bench_error"] = str(e)[:120]
+    # Robustness diagnostics (both platforms): the salvage policy layer's
+    # cost on a clean file (must be ≈0 — the disarmed seams and the
+    # strict-first fast path are the design) and whether a sort over a
+    # file with injected corrupt members completes under salvage — so
+    # robustness regressions show up in the round JSON like perf ones.
+    try:
+        out.update(_robustness_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["robustness_bench_error"] = str(e)[:120]
     return out
 
 
@@ -314,6 +323,72 @@ def _serve_bench(tmp: str) -> dict:
         "serve_view_cold_ms": round(cold_s * 1e3, 2),
         "serve_view_warm_ms": round(warm_s * 1e3, 2),
         "serve_warm_vs_cold_latency": round(cold_s / max(warm_s, 1e-9), 2),
+    }
+
+
+def _robustness_bench(tmp: str) -> dict:
+    """``salvage_overhead_pct``: salvage-mode sort vs strict on a CLEAN
+    file, host backend, min-of-2 interleaved (the policy layer is a
+    disarmed no-op plus a strict-first try frame, so this pins ≈0);
+    ``faults_survival``: a sort over the same file with corrupt members
+    injected mid-stream completes under ``errors='salvage'`` and
+    quarantines them (the injected-fault acceptance, run per round)."""
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.spec import bgzf
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    n = int(os.environ.get("HBAM_BENCH_ROBUST_RECORDS", "200000"))
+    src = os.path.join(tmp, "robust_src.bam")
+    synth_bam(src, n)
+
+    def one(errors: str, out_name: str) -> float:
+        t0 = time.time()
+        sort_bam(
+            [src], os.path.join(tmp, out_name), split_size=SPLIT_SIZE,
+            level=1, backend="host", errors=errors,
+        )
+        return time.time() - t0
+
+    one("strict", "robust_strict.bam")  # warm-up (native lib, caches)
+    t_s, t_v = [], []
+    for _ in range(2):
+        t_s.append(one("strict", "robust_strict.bam"))
+        t_v.append(one("salvage", "robust_salvage.bam"))
+    overhead = (min(t_v) / min(t_s) - 1.0) * 100.0
+
+    with open(src, "rb") as f:
+        data = bytearray(f.read())
+    blocks = bgzf.scan_blocks(bytes(data))
+    targets = [blocks[len(blocks) // 4], blocks[len(blocks) // 2],
+               blocks[3 * len(blocks) // 4]]
+    for b in targets:
+        data[b.coffset + 25] ^= 0x01  # payload flip: CRC-detected
+    bad = os.path.join(tmp, "robust_corrupt.bam")
+    with open(bad, "wb") as f:
+        f.write(bytes(data))
+    before = METRICS.report()["counters"].get(
+        "salvage.members_quarantined", 0
+    )
+    survived = True
+    quarantined = 0
+    try:
+        sort_bam(
+            [bad], os.path.join(tmp, "robust_salvaged.bam"),
+            split_size=SPLIT_SIZE, level=1, backend="host",
+            errors="salvage",
+        )
+        quarantined = (
+            METRICS.report()["counters"].get(
+                "salvage.members_quarantined", 0
+            )
+            - before
+        )
+    except Exception:
+        survived = False
+    return {
+        "salvage_overhead_pct": round(overhead, 2),
+        "faults_survival": survived,
+        "faults_quarantined_members": quarantined,
     }
 
 

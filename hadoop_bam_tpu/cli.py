@@ -137,6 +137,19 @@ def _parse_size(text: str) -> int:
         )
 
 
+def _apply_robustness_args(conf, args) -> None:
+    """Wire the shared ``--errors`` / ``--faults`` flags into the conf
+    (and arm the process-global fault plan for ``--faults``)."""
+    from . import faults
+    from .conf import ERRORS_MODE, FAULTS_PLAN
+
+    if getattr(args, "errors", None):
+        conf.set(ERRORS_MODE, args.errors)
+    if getattr(args, "faults", None):
+        conf.set(FAULTS_PLAN, args.faults)
+        faults.arm(args.faults)
+
+
 def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     from .conf import (
         BAM_MARK_DUPLICATES,
@@ -149,6 +162,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     from .pipeline import sort_bam
 
     conf = Configuration()
+    _apply_robustness_args(conf, args)
     if args.write_splitting_bai:
         conf.set_boolean(BAM_WRITE_SPLITTING_BAI, True)
     # Device codec toggles: unset leaves the conf key absent, deferring to
@@ -187,6 +201,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
             level=args.level,
             write_splitting_bai=args.write_splitting_bai,
             memory_budget=args.memory_budget,
+            part_dir=args.part_dir,
         )
     dup = (
         f", {stats.n_duplicates} duplicates flagged" if mark_duplicates
@@ -229,9 +244,12 @@ def _cmd_view(args) -> int:
     """One-shot ranged view: the daemon's ``view`` endpoint without a
     daemon — same code path (serve.endpoints.view_blob), so the output is
     byte-identical to a served response for the same file and region."""
+    from .conf import Configuration
     from .serve.endpoints import ServeContext, view_blob
 
-    ctx = ServeContext.from_conf(with_batcher=False)
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    ctx = ServeContext.from_conf(conf, with_batcher=False)
     try:
         blob = view_blob(ctx, args.bam, args.region, level=args.level)
     finally:
@@ -249,9 +267,12 @@ def _cmd_flagstat(args) -> int:
     """One-shot flag census (the daemon's ``flagstat`` endpoint)."""
     import json
 
+    from .conf import Configuration
     from .serve.endpoints import ServeContext, flagstat
 
-    ctx = ServeContext.from_conf(with_batcher=False)
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    ctx = ServeContext.from_conf(conf, with_batcher=False)
     try:
         counts = flagstat(ctx, args.bam)
     finally:
@@ -272,6 +293,7 @@ def _cmd_serve(args) -> int:
     from .serve.server import BamDaemon
 
     conf = Configuration()
+    _apply_robustness_args(conf, args)
     if args.cache_bytes is not None:
         conf.set_int(SERVE_CACHE_BYTES, args.cache_bytes)
     if args.arena_bytes is not None:
@@ -300,6 +322,24 @@ def _cmd_serve(args) -> int:
     except KeyboardInterrupt:
         daemon.stop()
     return 0
+
+
+def _add_robustness_args(s) -> None:
+    """The shared failure-policy flags (sort/markdup/view/flagstat/serve)."""
+    s.add_argument(
+        "--errors", choices=("strict", "salvage"), default=None,
+        help="corrupt-input policy (hadoopbam.errors): strict = abort on "
+             "the first bad BGZF member or torn record (default); salvage "
+             "= quarantine corrupt members/records, re-sync the record "
+             "chain via the guesser, finish the job (losses reported as "
+             "salvage.* counters in --metrics)")
+    s.add_argument(
+        "--faults", default=None, metavar="PLAN",
+        help="arm a deterministic fault-injection plan "
+             "(hadoopbam.faults.plan / HBAM_FAULTS; directive grammar in "
+             "hadoop_bam_tpu/faults/plan.py, e.g. "
+             "'seed=7;io.read.error:n=2;exec.crash:items=0,attempts=0') — "
+             "for robustness drills; disarmed runs pay nothing")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -374,6 +414,12 @@ def build_parser() -> argparse.ArgumentParser:
             help="bounded-memory out-of-core sort: cap materialized record "
                  "bytes (accepts k/m/g suffixes, e.g. 512m)")
         s.add_argument(
+            "--part-dir", default=None, metavar="DIR",
+            help="persistent part/spill directory: finished parts (and, "
+                 "with --memory-budget, the manifest-validated spill runs) "
+                 "become crash-restart checkpoints — rerun the same "
+                 "command after a kill and only missing work is redone")
+        s.add_argument(
             "--inflate-lanes", choices=("on", "off"), default=None,
             help="force the lockstep-lane device inflate tier "
                  "(hadoopbam.inflate.lanes; default: auto rule)")
@@ -399,6 +445,7 @@ def build_parser() -> argparse.ArgumentParser:
                             "the transfers block: h2d/d2h bytes by kind)")
         s.add_argument("--trace-dir", default=None,
                        help="capture a JAX profiler (XPlane) trace here")
+        _add_robustness_args(s)
 
     s = sub.add_parser("sort", help="coordinate-sort BAM file(s) end to end")
     add_sort_args(s, markdup=False)
@@ -422,6 +469,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("region", help="contig | contig:pos | contig:start-end")
     s.add_argument("-o", "--output", default="-")
     s.add_argument("--level", type=int, default=6)
+    _add_robustness_args(s)
     s.set_defaults(func=_cmd_view)
 
     s = sub.add_parser(
@@ -430,6 +478,7 @@ def build_parser() -> argparse.ArgumentParser:
              "printed as JSON; same code path as the daemon endpoint)",
     )
     s.add_argument("bam")
+    _add_robustness_args(s)
     s.set_defaults(func=_cmd_flagstat)
 
     s = sub.add_parser(
@@ -465,6 +514,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-warmup", action="store_true",
         help="skip the startup kernel-geometry pre-compilation "
              "(hadoopbam.serve.warmup)")
+    _add_robustness_args(s)
     s.set_defaults(func=_cmd_serve)
 
     return p

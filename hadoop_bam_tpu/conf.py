@@ -91,6 +91,25 @@ SERVE_MAX_INFLIGHT = "hadoopbam.serve.max-inflight"
 # startup (serve/warmup.py) so first-request latency is warm; "false"
 # skips the warm-up (first requests then pay the compiles).
 SERVE_WARMUP = "hadoopbam.serve.warmup"
+# Error-handling policy: "strict" (default — any corrupt BGZF member or
+# unparseable record aborts the job, the pre-PR-7 behavior) or "salvage"
+# (quarantine corrupt members/records, re-sync the record chain via the
+# guesser machinery, finish the job with salvage.* counters reporting
+# exactly what was lost).  Threaded spec/bgzf → io/bam → pipeline; the
+# CLI's --errors flag sets it.
+ERRORS_MODE = "hadoopbam.errors"
+# A fault-injection plan spec (see hadoop_bam_tpu/faults/plan.py for the
+# directive grammar).  Arms the process-global plan; the HBAM_FAULTS env
+# var takes precedence (it covers subprocess drills).  Unset = disarmed,
+# and the seams are zero-cost no-ops.
+FAULTS_PLAN = "hadoopbam.faults.plan"
+# ElasticExecutor hardening: wall-clock deadline per part-write attempt
+# (milliseconds; 0/unset = no deadline — an attempt that exceeds it is
+# counted failed and retried, Hadoop's task-timeout semantics) and the
+# base backoff between retry attempts (milliseconds, doubled per attempt
+# with deterministic jitter; default 50).
+EXECUTOR_ATTEMPT_TIMEOUT_MS = "hadoopbam.executor.attempt-timeout-ms"
+EXECUTOR_BACKOFF_MS = "hadoopbam.executor.backoff-ms"
 
 _TRUE_WORDS = frozenset(("yes", "true", "t", "y", "1", "on", "enabled"))
 _FALSE_WORDS = frozenset(("no", "false", "f", "n", "0", "off", "disabled"))

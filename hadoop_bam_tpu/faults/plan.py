@@ -1,0 +1,303 @@
+"""Deterministic fault-injection plans for the robustness seams.
+
+The reference repo has no fault injection at all (SURVEY.md §5 calls the
+gap out); its resilience story is whatever Hadoop's task retry happens to
+exercise.  This module is the adversary the TPU build's fallbacks never
+had: a seeded, declarative :class:`FaultPlan` that fires at the four seams
+where real failures enter the pipeline —
+
+- **byte I/O** (``io/fs.py`` reads): bit-flips, short reads, transient
+  ``IOError``;
+- **codec tiers** (``ops/flate.py`` wrappers + ``spec/bgzf.py`` host
+  inflate): forced per-member tier-downs, detected payload corruption;
+- **the part-write/executor boundary** (``parallel/executor.py``):
+  attempt crashes, torn tmp files, injected latency, hard process death
+  (the ``kill -9`` stand-in);
+- **the serve socket** (``serve/server.py``): dropped connections,
+  stalled replies.
+
+A plan is a ``;``-separated list of directives, each
+``site[:key=value[,key=value…]]``, e.g.::
+
+    HBAM_FAULTS="seed=7;io.read.error:n=2;exec.crash:items=1,attempts=0"
+
+Every directive carries ``n`` (how many times it fires; ``*`` =
+unlimited) and site-specific filters.  Firing order is deterministic:
+counters are consumed in call order and any randomness (bit positions)
+comes from the plan's seeded RNG, so a given plan against a given
+workload injects the same faults every run.  Offset-pinned bit-flips
+(``io.read.bitflip:offset=…``) are *persistent* by default — a corrupt
+disk byte is corrupt on every read, including margin-widened re-reads.
+
+Directive reference:
+
+===================  =====================================================
+``seed=<int>``       RNG seed for seeded choices (bit positions).
+``io.read.bitflip``  ``offset`` (absolute file offset; persistent unless
+                     ``n`` given), ``bit`` (0-7), ``path`` (substring
+                     filter), ``n``.
+``io.read.short``    ``drop`` (bytes removed from the tail; default half
+                     the read), ``path``, ``n``.
+``io.read.error``    transient ``IOError``; ``path``, ``n``.
+``flate.inflate.tierdown``  force members off the device inflate tiers;
+                     ``members`` (match set), ``n``.
+``flate.deflate.tierdown``  force members off the device deflate tiers;
+                     ``members``, ``n``.
+``flate.corrupt``    flip a byte of a host-inflated payload *before* the
+                     CRC gate (detected corruption); ``n``.
+``exec.crash``       raise inside an executor attempt; ``items``,
+                     ``attempts`` (match sets), ``n``.
+``exec.torn``        write a garbage tmp file, then raise (the torn-write
+                     adversary for the atomic-rename contract); ``items``,
+                     ``attempts``, ``n``.
+``exec.delay``       sleep ``ms`` inside an attempt; ``items``,
+                     ``attempts``, ``n``.
+``exec.die``         ``os._exit(137)`` — SIGKILL's exit, mid-attempt (the
+                     deterministic ``kill -9``); ``items``, ``attempts``,
+                     ``n``.
+``serve.drop``       close the connection without replying; ``op``
+                     (request-op filter), ``n``.
+``serve.stall``      sleep ``ms`` before replying; ``op``, ``n``.
+===================  =====================================================
+
+Match sets: ``*`` (any), ``3``, ``0-2``, ``1,4,7``.
+
+Zero cost when disarmed: the seams check one module global
+(``faults.ACTIVE is None``) and touch no tracing counter — a clean
+strict-mode run's metrics ledger is byte-identical with the subsystem
+present (tests/test_faults.py asserts this).  When a directive fires it
+counts ``faults.fired`` and ``faults.fired.<site>`` through METRICS so
+injected runs are auditable.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.tracing import METRICS
+
+_SITES = frozenset(
+    (
+        "io.read.bitflip",
+        "io.read.short",
+        "io.read.error",
+        "flate.inflate.tierdown",
+        "flate.deflate.tierdown",
+        "flate.corrupt",
+        "exec.crash",
+        "exec.torn",
+        "exec.delay",
+        "exec.die",
+        "serve.drop",
+        "serve.stall",
+    )
+)
+_UNLIMITED = -1
+
+
+def _match(spec: Optional[str], value) -> bool:
+    """Does ``value`` satisfy a match set (``*`` | n | a-b | a,b,c)?"""
+    if spec is None or spec == "*":
+        return True
+    if value is None:
+        return False
+    v = int(value)
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part[1:]:  # allow negative singletons like -1
+            lo, hi = part.split("-", 1) if not part.startswith("-") else (
+                part[: part.index("-", 1)], part[part.index("-", 1) + 1:]
+            )
+            if int(lo) <= v <= int(hi):
+                return True
+        elif v == int(part):
+            return True
+    return False
+
+
+class Directive:
+    """One armed fault: a site, its filters, and a firing budget."""
+
+    def __init__(self, site: str, params: Dict[str, str]):
+        if site not in _SITES:
+            raise ValueError(f"unknown fault site {site!r}")
+        self.site = site
+        self.params = params
+        n = params.get("n")
+        if n is None:
+            # Offset-pinned bit-flips model a bad disk byte: persistent.
+            persistent = site == "io.read.bitflip" and "offset" in params
+            self.remaining = _UNLIMITED if persistent else 1
+        else:
+            self.remaining = _UNLIMITED if n == "*" else int(n)
+
+    def int_param(self, key: str, default: int) -> int:
+        raw = self.params.get(key)
+        return default if raw is None else int(raw)
+
+    def __repr__(self) -> str:  # readable failure logs
+        return f"Directive({self.site}, {self.params}, n={self.remaining})"
+
+
+class FaultPlan:
+    """A seeded set of :class:`Directive`\\ s, consumed thread-safely."""
+
+    def __init__(
+        self, directives: List[Directive], seed: int = 0, spec: str = ""
+    ):
+        self.directives = directives
+        self.seed = seed
+        self.spec = spec
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.fired: Dict[str, int] = {}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        seed = 0
+        directives: List[Directive] = []
+        for raw in spec.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if raw.startswith("seed="):
+                seed = int(raw[5:])
+                continue
+            site, _, rest = raw.partition(":")
+            params: Dict[str, str] = {}
+            last_key: Optional[str] = None
+            for kv in rest.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                if "=" in kv:
+                    k, _, v = kv.partition("=")
+                    last_key = k.strip()
+                    params[last_key] = v.strip()
+                elif last_key is not None:
+                    # Continuation of a comma-holding match set, e.g.
+                    # ``items=1,3,7`` — bare tokens extend the last value.
+                    params[last_key] += "," + kv
+                else:
+                    raise ValueError(
+                        f"bad fault directive parameter {kv!r} in {raw!r}"
+                    )
+            directives.append(Directive(site.strip(), params))
+        return cls(directives, seed=seed, spec=spec)
+
+    # -- firing core --------------------------------------------------------
+
+    def _fire(self, site: str, **ctx) -> Optional[Directive]:
+        """The first matching directive with budget left, consumed; counts
+        ``faults.fired`` / ``faults.fired.<site>`` on a hit."""
+        with self._lock:
+            for d in self.directives:
+                if d.site != site or d.remaining == 0:
+                    continue
+                if not self._matches(d, ctx):
+                    continue
+                if d.remaining != _UNLIMITED:
+                    d.remaining -= 1
+                self.fired[site] = self.fired.get(site, 0) + 1
+                METRICS.count("faults.fired", 1)
+                METRICS.count(f"faults.fired.{site}", 1)
+                return d
+        return None
+
+    @staticmethod
+    def _matches(d: Directive, ctx: Dict) -> bool:
+        p = d.params
+        if "path" in p and p["path"] not in str(ctx.get("path", "")):
+            return False
+        if "op" in p and p["op"] != "*" and ctx.get("op") != p["op"]:
+            return False
+        for key in ("items", "attempts", "members"):
+            if key in p and not _match(p[key], ctx.get(key[:-1])):
+                return False
+        if "offset" in p:
+            off = int(p["offset"])
+            start = int(ctx.get("start", 0))
+            if not (start <= off < start + int(ctx.get("length", 0))):
+                return False
+        return True
+
+    # -- seam entry points --------------------------------------------------
+
+    def io_read(self, path: str, start: int, data: bytes) -> bytes:
+        """The byte-I/O seam: may raise a transient ``IOError`` or return
+        corrupted/truncated bytes."""
+        if self._fire("io.read.error", path=path, start=start,
+                      length=len(data)) is not None:
+            raise IOError(f"injected transient I/O error reading {path}")
+        d = self._fire("io.read.short", path=path, start=start,
+                       length=len(data))
+        if d is not None and len(data):
+            drop = min(d.int_param("drop", len(data) // 2), len(data))
+            data = data[: len(data) - drop]
+        d = self._fire("io.read.bitflip", path=path, start=start,
+                       length=len(data))
+        if d is not None and len(data):
+            if "offset" in d.params:
+                pos = int(d.params["offset"]) - start
+            else:
+                pos = self.rng.randrange(len(data))
+            if 0 <= pos < len(data):
+                bit = d.int_param("bit", 0) & 7
+                flipped = bytearray(data)
+                flipped[pos] ^= 1 << bit
+                data = bytes(flipped)
+        return data
+
+    def flate_tierdown(self, kind: str, member: int) -> bool:
+        """Force member ``member`` off the device ``kind`` ('inflate' /
+        'deflate') tier, down to host zlib."""
+        return self._fire(f"flate.{kind}.tierdown", member=member) is not None
+
+    def corrupt_payload(self, payload: bytes) -> bytes:
+        """Detected host-inflate corruption: flip one byte *before* the
+        CRC gate, so the framing check — not luck — catches it."""
+        if self._fire("flate.corrupt") is None or not payload:
+            return payload
+        pos = self.rng.randrange(len(payload))
+        out = bytearray(payload)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def exec_attempt(self, item: int, attempt: int, tmp_path: str) -> None:
+        """The executor seam: latency, torn tmp files, crashes, or hard
+        process death, per (item, attempt)."""
+        d = self._fire("exec.delay", item=item, attempt=attempt)
+        if d is not None:
+            time.sleep(d.int_param("ms", 100) / 1e3)
+        if self._fire("exec.die", item=item, attempt=attempt) is not None:
+            os._exit(137)  # SIGKILL's exit code: the kill -9 stand-in
+        d = self._fire("exec.torn", item=item, attempt=attempt)
+        if d is not None:
+            with open(tmp_path, "wb") as f:
+                f.write(b"\x00TORN\x00" * 64)
+            raise IOError(
+                f"injected torn write for item {item} attempt {attempt}"
+            )
+        if self._fire("exec.crash", item=item, attempt=attempt) is not None:
+            raise RuntimeError(
+                f"injected crash for item {item} attempt {attempt}"
+            )
+
+    def serve_action(self, op: Optional[str]) -> Optional[Dict]:
+        """The serve-socket seam: ``{"action": "drop"}`` (close without a
+        reply) or ``{"action": "stall", "ms": …}``, or None."""
+        d = self._fire("serve.drop", op=op)
+        if d is not None:
+            return {"action": "drop"}
+        d = self._fire("serve.stall", op=op)
+        if d is not None:
+            return {"action": "stall", "ms": d.int_param("ms", 200)}
+        return None

@@ -33,6 +33,7 @@ from ..conf import (
     BAM_INTERVALS,
     BAM_TRAVERSE_UNPLACED_UNMAPPED,
     BAM_WRITE_SPLITTING_BAI,
+    ERRORS_MODE,
     Configuration,
 )
 from ..spec import bam, bgzf, indices
@@ -201,6 +202,19 @@ class BamInputFormat:
     def __init__(self, conf: Optional[Configuration] = None):
         self.conf = conf or Configuration()
         self._device_inflate_cached: Optional[bool] = None
+        self._nrefs_cache: dict = {}
+
+    def errors_mode(self) -> str:
+        """The configured error policy: 'strict' (default) or 'salvage'
+        (the ``hadoopbam.errors`` conf key)."""
+        return self.conf.get(ERRORS_MODE, "strict") or "strict"
+
+    def _nrefs(self, path: str) -> int:
+        """Header reference count, cached per path — the salvage reader's
+        record-resync sanity rules need it."""
+        if path not in self._nrefs_cache:
+            self._nrefs_cache[path] = read_header(path).n_refs
+        return self._nrefs_cache[path]
 
     def _device_inflate(self) -> bool:
         """Route split inflate through the lockstep-lane device tier?
@@ -454,6 +468,7 @@ class BamInputFormat:
         fields: Optional[Sequence[str]] = None,
         device_inflate: Optional[bool] = None,
         inflate_fn=None,
+        errors: Optional[str] = None,
     ) -> RecordBatch:
         """Inflate the split's blocks and decode all its records as one batch.
 
@@ -470,9 +485,18 @@ class BamInputFormat:
 
         ``inflate_fn`` overrides the member inflate entirely (see
         :func:`read_virtual_range`) — the serve daemon's cross-request
-        lane batcher plugs in here."""
+        lane batcher plugs in here.
+
+        ``errors`` (default: the ``hadoopbam.errors`` conf key) selects
+        the policy on corrupt input: 'strict' raises (pre-PR-7 behavior),
+        'salvage' quarantines corrupt BGZF members and unparseable
+        records, re-syncs the record chain, and returns what survived
+        (``salvage.*`` counters account for the losses)."""
         if device_inflate is None:
             device_inflate = self._device_inflate()
+        if errors is None:
+            errors = self.errors_mode()
+        n_refs = self._nrefs(split.path) if errors == "salvage" else None
         if data is not None:
             return read_virtual_range(
                 data,
@@ -484,6 +508,8 @@ class BamInputFormat:
                 fields=fields,
                 device_inflate=device_inflate,
                 inflate_fn=inflate_fn,
+                errors=errors,
+                n_refs=n_refs,
             )
         sfs = fs.get_fs(split.path)
         size = sfs.size(split.path)
@@ -492,8 +518,8 @@ class BamInputFormat:
         margin = 4 << 20
         while True:
             end_byte = min(cend + margin, size)
-            window = sfs.read_range(
-                split.path, cstart, end_byte - cstart
+            window = fs.read_range_retry(
+                sfs, split.path, cstart, end_byte - cstart
             )
             at_eof = end_byte >= size
             shift = cstart << 16
@@ -514,6 +540,9 @@ class BamInputFormat:
                     fields=fields,
                     device_inflate=device_inflate,
                     inflate_fn=inflate_fn,
+                    errors=errors,
+                    n_refs=n_refs,
+                    window_at_eof=at_eof,
                 )
             except (bam.BamError, bgzf.BgzfError):
                 if at_eof:
@@ -569,6 +598,9 @@ def read_virtual_range(
     fields: Optional[Sequence[str]] = None,
     device_inflate: bool = False,
     inflate_fn=None,
+    errors: str = "strict",
+    n_refs: Optional[int] = None,
+    window_at_eof: bool = True,
 ) -> RecordBatch:
     """Decode all records whose start voffset lies in ``[vstart, vend)``.
 
@@ -592,12 +624,53 @@ def read_virtual_range(
     its cross-request lane batcher this way.  Spill blocks (a tail record
     straddling the window) still inflate natively: they are per-request
     by construction.
+
+    ``errors="salvage"`` (with ``n_refs`` from the header) switches to
+    the quarantining reader (:func:`_read_virtual_range_salvage`): corrupt
+    members are skipped with guesser re-sync instead of raising.  The
+    strict path below is byte-for-byte the pre-salvage hot path — the
+    policy costs one branch here.  ``window_at_eof=False`` tells the
+    salvage reader its buffer is a window that stops short of the file's
+    end, so trouble near the window edge raises (the caller widens)
+    instead of being mistaken for corruption.
     """
     if fields is not None and with_keys:
         # Keys need refid/pos/flag + record extents even if the caller's
         # subset omits them.
         fields = tuple(
             dict.fromkeys(tuple(fields) + SORT_FIELDS)
+        )
+    if errors == "salvage":
+        if n_refs is None:
+            raise ValueError("salvage mode needs n_refs from the header")
+        # Clean-input fast path: run the strict reader first and only
+        # drop into the quarantining reader when it actually raises —
+        # salvage mode on a clean file costs one try-frame (the bench's
+        # ``salvage_overhead_pct`` pins this at ≈0).  A corruption raise
+        # wastes the partial strict work; corruption is the rare case.
+        try:
+            return read_virtual_range(
+                data,
+                vstart,
+                vend,
+                with_keys=with_keys,
+                threads=threads,
+                interval_chunks=interval_chunks,
+                fields=fields,
+                device_inflate=device_inflate,
+                inflate_fn=inflate_fn,
+            )
+        except (bgzf.BgzfError, bam.BamError):
+            METRICS.count("salvage.strict_fallbacks", 1)
+        return _read_virtual_range_salvage(
+            data,
+            vstart,
+            vend,
+            n_refs=n_refs,
+            with_keys=with_keys,
+            interval_chunks=interval_chunks,
+            fields=fields,
+            window_at_eof=window_at_eof,
         )
     if vstart >= vend:
         # Degenerate split (e.g. header larger than the first byte split:
@@ -780,6 +853,276 @@ def read_virtual_range(
     return RecordBatch(
         soa=soa, data=arr, keys=keys, device_data=device_data
     )
+
+
+def _read_virtual_range_salvage(
+    data: bytes,
+    vstart: int,
+    vend: int,
+    n_refs: int,
+    with_keys: bool = True,
+    interval_chunks: Optional[List[Tuple[int, int]]] = None,
+    fields: Optional[Sequence[str]] = None,
+    window_at_eof: bool = True,
+) -> RecordBatch:
+    """The quarantining split reader: survive corrupt members and torn
+    record chains, return every record that is provably intact.
+
+    Reference stance: the library's whole point is making sense of BGZF
+    at arbitrary byte offsets (split guessers, per-record sanity rules),
+    yet the strict readers throw away that machinery the moment a byte is
+    wrong mid-job.  This reader turns it back on:
+
+    1. **Member scan with re-sync** — walk block headers from the split's
+       start; an unparseable header (bit-flipped magic, lying BSIZE)
+       quarantines bytes up to the next plausible header
+       (:func:`spec.bgzf.find_next_block`, the guesser's phase-1 scan).
+    2. **Per-member inflate** — each member decodes under the CRC32/ISIZE
+       gates; a failing member is quarantined (the strict batch inflate
+       would have aborted the job).
+    3. **Segmented chain walk** — file-contiguous runs of good members
+       form segments; the record chain cannot cross a quarantined gap, so
+       each segment after the first re-syncs its first record with the
+       guesser's record sanity rules + strict trial decode
+       (:func:`io.guesser.find_record_start_in_payload`).  Records
+       truncated by a gap (or failing mid-segment sanity) are dropped and
+       the walk re-syncs past them.
+    4. **Spill continuation** — a tail record straddling the split end
+       still completes through following members, as in strict mode.
+
+    Accounting (all under ``salvage.*`` in METRICS): quarantined members
+    and bytes (counted once per file region — events at/after this
+    split's end block are left to the next split), re-syncs and failures,
+    dropped records, and the surviving record count.  Device tiers and
+    the lane batcher are deliberately bypassed — salvage is the degraded
+    host-correctness path.
+    """
+    if vstart >= vend:
+        return RecordBatch(
+            soa=_empty_soa(fields), data=np.empty(0, np.uint8),
+            keys=np.empty(0, np.int64),
+        )
+    file_end = len(data)
+    cstart = vstart >> 16
+    cend = min(vend >> 16, file_end)
+    last_split = (vend >> 16) >= file_end
+
+    def _count_quarantine(co: int, nbytes: int) -> None:
+        # A member at/after the end block belongs to the next split's
+        # window — counting it here too would double-report.
+        if co < cend or last_split:
+            METRICS.count("salvage.members_quarantined", 1)
+            METRICS.count("salvage.bytes_quarantined", nbytes)
+
+    def _widen_guard(pos: int) -> None:
+        # Trouble within one max-block-size of a window edge that is NOT
+        # the file's end is indistinguishable from window truncation:
+        # raise so read_split widens the margin and retries.
+        if not window_at_eof and pos + bgzf.MAX_BLOCK_SIZE > file_end:
+            raise bgzf.BgzfError(
+                f"salvage: window too small to classify bytes at {pos}"
+            )
+
+    # ---- 1+2: member scan with re-sync, per-member inflate -------------
+    good_co: List[int] = []
+    good_cs: List[int] = []
+    good_us: List[int] = []
+    payloads: List[bytes] = []
+    pos = cstart
+    while pos < file_end and pos <= cend:
+        try:
+            csize, usize = bgzf.read_block_at(data, pos)
+        except bgzf.BgzfError:
+            _widen_guard(pos)
+            nxt = bgzf.find_next_block(data, pos + 1)
+            npos = nxt[0] if nxt is not None else file_end
+            if nxt is None:
+                _widen_guard(npos)
+            _count_quarantine(pos, npos - pos)
+            pos = npos
+            continue
+        try:
+            payload, _ = bgzf.inflate_block(data, pos)
+        except bgzf.BgzfError:
+            _count_quarantine(pos, csize)
+            pos += csize
+            continue
+        good_co.append(pos)
+        good_cs.append(csize)
+        good_us.append(len(payload))
+        payloads.append(payload)
+        pos += csize
+    spill_pos = pos
+
+    buf = bytearray()
+    uoffs: List[int] = []
+    for p_ in payloads:
+        uoffs.append(len(buf))
+        buf.extend(p_)
+
+    # ---- segment boundaries (contiguity breaks at every quarantine) ----
+    seg_starts: List[int] = []  # indices into the good-member tables
+    for k in range(len(good_co)):
+        if k == 0 or good_co[k] != good_co[k - 1] + good_cs[k - 1]:
+            seg_starts.append(k)
+    seg_bounds: List[Tuple[int, int]] = [
+        (s, seg_starts[i + 1] if i + 1 < len(seg_starts) else len(good_co))
+        for i, s in enumerate(seg_starts)
+    ]
+
+    # ---- vend cutoff over the good-member tables (monotone, as strict) -
+    vc = vend >> 16
+    if vc >= file_end or not good_co:
+        vend_off: Optional[int] = None
+    elif vc < good_co[0]:
+        vend_off = 0
+    else:
+        bi = max(0, int(np.searchsorted(good_co, vc, side="right")) - 1)
+        if good_co[bi] == vc:
+            vend_off = uoffs[bi] + min(vend & 0xFFFF, good_us[bi])
+        else:
+            vend_off = uoffs[bi] + good_us[bi]
+
+    from .guesser import find_record_start_in_payload
+
+    rec_parts: List[np.ndarray] = []
+    up0 = vstart & 0xFFFF
+    done = False
+
+    def spill_one() -> bool:
+        """Extend the frontier segment by one member (salvage rules: a
+        corrupt spill member just ends the chain — the dropped tail
+        record is counted by the caller, the member by the next split)."""
+        nonlocal spill_pos
+        if spill_pos >= file_end:
+            if not window_at_eof:
+                # The tail record continues past the window, not past the
+                # file: widen, don't drop.
+                raise bgzf.BgzfError(
+                    "salvage: window too small for spilled tail record"
+                )
+            return False
+        try:
+            csize, usize = bgzf.read_block_at(data, spill_pos)
+            payload, _ = bgzf.inflate_block(data, spill_pos)
+        except bgzf.BgzfError:
+            _widen_guard(spill_pos)
+            return False
+        good_co.append(spill_pos)
+        good_cs.append(csize)
+        good_us.append(len(payload))
+        uoffs.append(len(buf))
+        buf.extend(payload)
+        spill_pos += csize
+        return True
+
+    for si, (k0, k1) in enumerate(seg_bounds):
+        if done:
+            break
+        seg_u0 = uoffs[k0]
+        seg_u1 = uoffs[k1 - 1] + good_us[k1 - 1]
+        if vend_off is not None and seg_u0 >= vend_off:
+            break
+        # Frontier segment: the last one, ending exactly at the scan
+        # cursor — the only segment a spill block can legally extend.
+        at_frontier = (
+            si == len(seg_bounds) - 1
+            and good_co[k1 - 1] + good_cs[k1 - 1] == spill_pos
+        )
+        # Starting point: the split's own vstart is a planned record
+        # boundary IF its block survived; any other segment re-syncs.
+        if si == 0 and k0 == 0 and good_co[0] == cstart and up0 <= good_us[0]:
+            p = seg_u0 + up0
+        else:
+            METRICS.count("salvage.resyncs", 1)
+            r = find_record_start_in_payload(
+                np.frombuffer(bytes(buf[seg_u0:seg_u1]), np.uint8), n_refs
+            )
+            if r is None:
+                METRICS.count("salvage.resync_failed", 1)
+                continue
+            p = seg_u0 + r
+        guard = 0
+        while p < seg_u1 and guard < 1000:
+            guard += 1
+            # A mutable bytearray exposes a zero-copy uint8 view; the
+            # view is rebuilt per iteration because spill_one() may have
+            # grown (and reallocated) the buffer.
+            arr_now = np.frombuffer(
+                memoryview(buf), dtype=np.uint8, count=seg_u1
+            )
+            offs, resume = native.record_chain_partial(
+                arr_now, p, seg_u1
+            )
+            if vend_off is not None:
+                k = int(np.searchsorted(offs, vend_off, side="left"))
+            else:
+                k = len(offs)
+            rec_parts.append(np.asarray(offs[:k], dtype=np.int64))
+            if k < len(offs) or (
+                vend_off is not None and resume >= vend_off
+            ):
+                done = True
+                break
+            if resume + 4 > seg_u1 and not at_frontier:
+                break  # ≤3 trailing bytes at a gap: lenient, as strict EOF
+            if at_frontier:
+                if resume + 4 > seg_u1 and spill_pos >= file_end:
+                    break  # ≤3 trailing bytes at file EOF
+                if spill_one():
+                    seg_u1 = uoffs[-1] + good_us[-1]
+                    p = resume
+                    continue
+                if resume < seg_u1:
+                    # Torn tail record at the end of the salvageable data.
+                    METRICS.count("salvage.records_dropped", 1)
+                break
+            # A record truncated by the following gap, or an unparseable
+            # record mid-segment: drop it and re-sync past its start.
+            METRICS.count("salvage.records_dropped", 1)
+            METRICS.count("salvage.resyncs", 1)
+            r = find_record_start_in_payload(
+                np.frombuffer(bytes(buf[seg_u0:seg_u1]), np.uint8),
+                n_refs,
+                start=resume - seg_u0 + 1,
+            )
+            if r is None:
+                METRICS.count("salvage.resync_failed", 1)
+                break
+            p = seg_u0 + r
+
+    arr = np.frombuffer(bytes(buf), dtype=np.uint8)
+    offsets = (
+        np.concatenate(rec_parts)
+        if rec_parts
+        else np.empty(0, dtype=np.int64)
+    )
+    soa = (
+        bam.soa_decode(arr, offsets, fields=fields)
+        if len(offsets)
+        else _empty_soa(fields)
+    )
+    if interval_chunks is not None and len(offsets):
+        keep = _voffset_mask(
+            offsets,
+            np.asarray(uoffs, dtype=np.int64),
+            np.asarray(good_co, dtype=np.int64),
+            good_us,
+            interval_chunks,
+        )
+        soa = {k: v[keep] for k, v in soa.items()}
+    keys = (
+        bam.soa_keys(soa, arr)
+        if with_keys and len(soa["rec_off"])
+        else np.empty(0, dtype=np.int64)
+    )
+    METRICS.count("bam.blocks_inflated", len(good_co))
+    METRICS.count("bam.bytes_inflated", len(arr))
+    METRICS.count("bam.records_decoded", len(offsets))
+    METRICS.count("salvage.records_salvaged", len(offsets))
+    if interval_chunks is not None:
+        METRICS.count("bam.records_kept", len(soa["rec_off"]))
+    return RecordBatch(soa=soa, data=arr, keys=keys)
 
 
 def _voffset_mask(offsets, block_uoffs, block_voffs, us_l, chunks):

@@ -19,9 +19,46 @@ import io
 import os
 import re
 import threading
+import time
 from typing import BinaryIO, Dict, List, Optional
 
+from .. import faults
+from ..utils.tracing import METRICS
+
 _SCHEME_RE = re.compile(r"^([A-Za-z][A-Za-z0-9+.-]*)://")
+
+
+def _inject_read(path: str, start: int, data: bytes) -> bytes:
+    """The byte-I/O fault seam: every local read funnels its result
+    through the armed plan (bit-flips, short reads, transient IOError).
+    One ``is None`` check when disarmed — nothing else."""
+    if faults.ACTIVE is not None:
+        return faults.ACTIVE.io_read(path, start, data)
+    return data
+
+
+def read_range_retry(
+    filesystem: "Filesystem",
+    path: str,
+    start: int,
+    length: int,
+    retries: int = 2,
+    backoff_s: float = 0.01,
+) -> bytes:
+    """A ranged read with bounded retries on transient ``OSError`` — the
+    split readers' stance toward flaky devices (HttpFilesystem already
+    retries internally; this gives local/remote adapters the same grace).
+    Counts ``io.read_retries`` only when a retry actually happens, so a
+    clean run's ledger is untouched."""
+    for attempt in range(retries + 1):
+        try:
+            return filesystem.read_range(path, start, length)
+        except OSError:
+            if attempt == retries:
+                raise
+            METRICS.count("io.read_retries", 1)
+            time.sleep(backoff_s * (2 ** attempt))
+    raise AssertionError("unreachable")
 
 
 def path_scheme(path: str) -> str:
@@ -76,11 +113,11 @@ class LocalFilesystem(Filesystem):
     def read_range(self, path: str, start: int, length: int) -> bytes:
         with open(self._strip(path), "rb") as f:
             f.seek(start)
-            return f.read(length)
+            return _inject_read(path, start, f.read(length))
 
     def read_all(self, path: str) -> bytes:
         with open(self._strip(path), "rb") as f:
-            return f.read()
+            return _inject_read(path, 0, f.read())
 
     def open_read(self, path: str) -> BinaryIO:
         return open(self._strip(path), "rb")

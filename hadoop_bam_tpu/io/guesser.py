@@ -235,6 +235,54 @@ class BamSplitGuesser:
         return True
 
 
+def find_record_start_in_payload(
+    payload,
+    n_refs: int,
+    start: int = 0,
+    verify_records: int = 4,
+) -> Optional[int]:
+    """First verifiable BAM record start at/after ``start`` in an
+    *inflated* payload stream — the salvage-mode record-chain re-sync.
+
+    After a quarantined BGZF member breaks the record chain, the next
+    good segment begins at an unknown point inside a record.  This runs
+    the guesser's phase-2 sanity rules (vectorized) over the payload and
+    verifies each candidate by walking the chain with the strict phase-3
+    per-record validation for up to ``verify_records`` records (a record
+    truncated by the end of the payload is acceptable, like the
+    reference's buffered-window EOF rule).  Returns the payload offset of
+    the record's block_size word, or None.
+    """
+    arr = (
+        payload
+        if isinstance(payload, np.ndarray)
+        else np.frombuffer(payload, dtype=np.uint8)
+    )
+    if start:
+        arr = arr[start:]
+    if len(arr) < SHORTEST_POSSIBLE_BAM_RECORD:
+        return None
+    g = BamSplitGuesser(b"", n_refs)
+    data = arr.tobytes()
+    n = len(data)
+    for up in g._candidate_offsets(arr):
+        p = int(up) - 4
+        ok = True
+        decoded = 0
+        while decoded < verify_records and p + 4 <= n:
+            (bs,) = struct.unpack_from("<I", data, p)
+            if p + 4 + bs > n:
+                break  # truncated tail: fine iff something decoded
+            if not g._sane_record(data, p, bs):
+                ok = False
+                break
+            decoded += 1
+            p += 4 + bs
+        if ok and decoded:
+            return start + int(up) - 4
+    return None
+
+
 def guess_bgzf_block_start(data: bytes, beg: int, end: int) -> Optional[int]:
     """The plain-BGZF guesser (util/BGZFSplitGuesser.java:64-112): next
     verifiable block start in ``[beg, end)``, verified by actually inflating

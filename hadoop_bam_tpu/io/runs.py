@@ -25,9 +25,10 @@ which reproduces exactly the single-pass stable sort's output order.
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,6 +36,8 @@ RUN_DATA_EXT = ".run"
 RUN_KEYS_EXT = ".run.keys.npy"
 RUN_OFFS_EXT = ".run.offs.npy"
 RUN_IDX_EXT = ".run.idx.npy"
+MANIFEST_NAME = "manifest.json"
+_MANIFEST_VERSION = 1
 
 
 def run_paths(directory: str, idx: int) -> Tuple[str, str, str, str]:
@@ -142,6 +145,102 @@ class Run:
                 f"{got} of {size} bytes at {start}"
             )
         return out
+
+
+def input_identity(paths: Sequence[str]) -> List[Dict]:
+    """File-identity fingerprints of the job inputs — ``(path, size,
+    mtime_ns)``, the same identity key the serve cache uses.  A resumed
+    sort must refuse checkpoints written against different bytes."""
+    out: List[Dict] = []
+    for p in paths:
+        st = os.stat(p)
+        out.append(
+            {"path": p, "size": st.st_size, "mtime_ns": st.st_mtime_ns}
+        )
+    return out
+
+
+def write_manifest(
+    spill_dir: str,
+    inputs: List[Dict],
+    n_records: int,
+    run_count: int,
+    memory_budget: int,
+    mark_duplicates: bool,
+) -> None:
+    """Checkpoint the completed spill phase: inputs identity, job shape,
+    and the byte size of every run sideband.  Written atomically *after*
+    phase 1 finishes, so its existence certifies every run file it names
+    (a ``kill -9`` mid-spill leaves no manifest → the rerun redoes phase 1
+    from scratch; a kill mid-*merge* finds a valid manifest and reuses the
+    runs as checkpoints)."""
+    runs = []
+    for k in range(run_count):
+        data_p, keys_p, offs_p, idx_p = run_paths(spill_dir, k)
+        entry = {
+            "data": os.path.getsize(data_p),
+            "keys": os.path.getsize(keys_p),
+            "offs": os.path.getsize(offs_p),
+        }
+        if os.path.exists(idx_p):
+            entry["idx"] = os.path.getsize(idx_p)
+        runs.append(entry)
+    doc = {
+        "version": _MANIFEST_VERSION,
+        "inputs": inputs,
+        "n_records": n_records,
+        "run_count": run_count,
+        "memory_budget": memory_budget,
+        "mark_duplicates": mark_duplicates,
+        "runs": runs,
+    }
+    path = os.path.join(spill_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def load_manifest(
+    spill_dir: str,
+    inputs: List[Dict],
+    memory_budget: int,
+    mark_duplicates: bool,
+) -> Optional[Dict]:
+    """The validated checkpoint, or None (missing / stale / mismatched).
+
+    Validation is conservative: same format version, same input identity
+    (path+size+mtime_ns), same budget and markdup setting (both change
+    the spill plan), and every named run file present at its recorded
+    size.  Anything off → redo phase 1; a checkpoint is an optimization,
+    never a correctness dependency."""
+    path = os.path.join(spill_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (
+        doc.get("version") != _MANIFEST_VERSION
+        or doc.get("inputs") != inputs
+        or doc.get("memory_budget") != memory_budget
+        or bool(doc.get("mark_duplicates")) != bool(mark_duplicates)
+        or doc.get("run_count") != len(doc.get("runs", []))
+    ):
+        return None
+    for k, entry in enumerate(doc["runs"]):
+        data_p, keys_p, offs_p, idx_p = run_paths(spill_dir, k)
+        try:
+            if (
+                os.path.getsize(data_p) != entry["data"]
+                or os.path.getsize(keys_p) != entry["keys"]
+                or os.path.getsize(offs_p) != entry["offs"]
+                or ("idx" in entry and os.path.getsize(idx_p) != entry["idx"])
+            ):
+                return None
+        except OSError:
+            return None
+    return doc
 
 
 # Per-run (start, stop) record-index cuts defining one key range.

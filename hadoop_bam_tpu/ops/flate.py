@@ -119,6 +119,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults
 from ..spec import bgzf
 
 # --------------------------------------------------------------------------
@@ -1576,6 +1577,14 @@ def bgzf_compress_device(
             comp, cl = _deflate_fixed_rows(mat, lens)
             clens[:] = cl
             stats.xla += nblk
+    if faults.ACTIVE is not None and level != 0:
+        # Forced tier-down seam: selected members drop to host zlib no
+        # matter which device tier produced them — the cascade must stay
+        # bit-exact through the framing below (tests/test_faults.py).
+        for i in range(nblk):
+            if faults.ACTIVE.flate_tierdown("deflate", i):
+                overrides[i] = _host_raw_deflate(_member_payload(i), level)
+                clens[i] = len(overrides[i])
     stats.publish("flate.deflate")
 
     # ---- framing: one preallocated pass, CRC over the input itself -----
@@ -1723,6 +1732,24 @@ def bgzf_decompress_device(
             # every real-world BAM): the device decoder builds the
             # canonical tables per member/block on chip.
             groups["dyn"].append(i)
+    if faults.ACTIVE is not None:
+        # Forced tier-down seam: fired members skip every device tier and
+        # host-decode immediately (corrupt data still raises, exactly as
+        # a real per-member tier-down would surface it).
+        forced = [
+            i
+            for kind in groups
+            for i in groups[kind]
+            if faults.ACTIVE.flate_tierdown("inflate", i)
+        ]
+        for i in forced:
+            member = raw[int(co[i]) : int(co[i]) + int(cs[i])]
+            outs[i], _ = bgzf.inflate_block(member.tobytes(), 0, check_crc)
+            stats.host += 1
+        if forced:
+            fset = set(forced)
+            for kind in groups:
+                groups[kind] = [i for i in groups[kind] if i not in fset]
     # ---- Tier 1: the general lockstep-lane Pallas decoder --------------
     # One pass over every member regardless of block flavor (the lanes
     # kernel walks any stored/fixed/dynamic mix); members it rejects stay

@@ -12,23 +12,39 @@ host-side fan-out:
 
 - one *attempt* = run ``work_fn(item, tmp_path)``; the part materializes at
   its final name only via atomic rename, so readers never see torn output;
-- bounded retries per item with a per-item failure log;
+- bounded retries per item with a per-item failure log, exponential backoff
+  between attempts (``retry_backoff`` base, doubled per attempt with
+  deterministic per-item jitter) and an optional per-attempt wall-clock
+  deadline (``attempt_timeout`` — an attempt that exceeds it is *counted*
+  failed and retried, Hadoop's task-timeout stance; the stuck thread is
+  abandoned, never joined);
 - *resume*: an existing final part is trusted and skipped (a rerun after a
   crash redoes only missing parts — the part files double as checkpoints,
-  like the reference's reusable ``.splitting-bai`` artifacts);
+  like the reference's reusable ``.splitting-bai`` artifacts).  Trust is
+  qualified by ``validate_part``: a crashed ``os.replace`` race can leave a
+  zero-byte or half-written final name behind, and an unvalidated resume
+  would silently merge it — ``bgzf_part_valid`` (size > 0 + BGZF magic) is
+  what the BAM pipeline passes;
 - ``_SUCCESS`` written only when every item succeeded;
-- a ``fault_hook(item, attempt)`` seam for fault-injection tests (the
-  reference has none — SURVEY.md §5 calls this out as a gap).
+- *quarantine* (salvage mode): an item that exhausts its attempts is
+  recorded in ``ExecutionReport.quarantined`` (``salvage.parts_quarantined``
+  counter) instead of failing the job — degraded output beats a dead job,
+  and the merger's part glob simply skips the missing name;
+- two fault seams: the explicit ``fault_hook(item, attempt)`` callable and
+  the process-global armed :mod:`hadoop_bam_tpu.faults` plan (crashes, torn
+  tmp files, latency, hard process death), both no-ops when absent.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from .. import faults
 from ..utils import nio
 from ..utils.tracing import METRICS
 
@@ -44,6 +60,26 @@ class PartFailedError(RuntimeError):
         super().__init__(f"{len(failures)} part(s) failed permanently: {msgs}")
 
 
+class AttemptTimeout(RuntimeError):
+    """An attempt exceeded the executor's per-attempt deadline."""
+
+
+def bgzf_part_valid(path: str) -> bool:
+    """The BAM part validator: non-empty and starts with the BGZF magic.
+    (A part left by a crashed writer mid-``os.replace`` can be zero bytes
+    or garbage; a torn *BGZF chain* deeper in is caught by the readers'
+    CRC gates, so the cheap prefix check is the right resume gate.)"""
+    from ..spec import bgzf
+
+    try:
+        if os.path.getsize(path) == 0:
+            return False
+        with open(path, "rb") as f:
+            return f.read(4) == bgzf.MAGIC
+    except OSError:
+        return False
+
+
 @dataclass
 class ExecutionReport:
     parts: List[str]
@@ -51,6 +87,7 @@ class ExecutionReport:
     retried: int
     skipped_existing: int
     failure_log: Dict[int, List[str]] = field(default_factory=dict)
+    quarantined: List[int] = field(default_factory=list)
 
 
 class ElasticExecutor:
@@ -60,6 +97,10 @@ class ElasticExecutor:
         max_attempts: int = 3,
         max_workers: Optional[int] = None,
         fault_hook: Optional[Callable[[int, int], None]] = None,
+        attempt_timeout: Optional[float] = None,
+        retry_backoff: float = 0.0,
+        quarantine: bool = False,
+        validate_part: Optional[Callable[[str], bool]] = None,
     ) -> None:
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
@@ -69,6 +110,48 @@ class ElasticExecutor:
         # deflate threads) and holds a part's payload in memory.
         self.max_workers = max_workers or min(4, (os.cpu_count() or 4))
         self.fault_hook = fault_hook
+        self.attempt_timeout = attempt_timeout
+        self.retry_backoff = retry_backoff
+        self.quarantine = quarantine
+        self.validate_part = validate_part
+
+    def _backoff(self, item: int, attempt: int) -> None:
+        """Exponential backoff before retry ``attempt`` (≥1) of ``item``,
+        with deterministic jitter so concurrent retries de-synchronize
+        reproducibly (no RNG state shared with anything else)."""
+        if self.retry_backoff <= 0 or attempt == 0:
+            return
+        base = self.retry_backoff * (2 ** (attempt - 1))
+        jitter = 0.75 + ((item * 2654435761 + attempt * 40503) % 512) / 1024.0
+        time.sleep(base * jitter)
+
+    def _run_attempt(self, work_fn, item, tmp: str) -> None:
+        """One attempt, under the optional wall-clock deadline.  With a
+        deadline, the work runs in a watchdog thread: on expiry the
+        attempt is *recorded* failed and retried while the stuck thread is
+        abandoned (its tmp name is attempt-unique, so a zombie completing
+        late can never clobber a newer attempt's rename)."""
+        if self.attempt_timeout is None:
+            work_fn(item, tmp)
+            return
+        box: List = [None]
+
+        def target() -> None:
+            try:
+                work_fn(item, tmp)
+            except BaseException as e:  # noqa: BLE001 - relayed below
+                box[0] = e
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(self.attempt_timeout)
+        if t.is_alive():
+            METRICS.count("executor.attempt_timeouts", 1)
+            raise AttemptTimeout(
+                f"attempt exceeded deadline of {self.attempt_timeout}s"
+            )
+        if box[0] is not None:
+            raise box[0]
 
     def run(
         self,
@@ -79,7 +162,8 @@ class ElasticExecutor:
     ) -> ExecutionReport:
         """Run ``work_fn(item, tmp_path)`` per item; return final part paths
         in item order.  Raises PartFailedError if any item exhausts its
-        attempts (and does NOT write ``_SUCCESS``)."""
+        attempts (unless ``quarantine`` — then the item is skipped and
+        reported).  ``_SUCCESS`` is withheld only on a raise."""
         os.makedirs(self.out_dir, exist_ok=True)
         n = len(items)
         parts = [os.path.join(self.out_dir, part_name(i)) for i in range(n)]
@@ -93,9 +177,16 @@ class ElasticExecutor:
             nonlocal attempts, retried, skipped
             final = parts[i]
             if os.path.exists(final):
-                with lock:
-                    skipped += 1
-                return
+                if self.validate_part is None or self.validate_part(final):
+                    with lock:
+                        skipped += 1
+                    return
+                # A torn final name (crashed os.replace race): redo it.
+                METRICS.count("executor.invalid_part_redone", 1)
+                try:
+                    os.remove(final)
+                except OSError:
+                    pass
             errs: List[str] = []
             for attempt in range(self.max_attempts):
                 # Hadoop's _temporary convention: the leading underscore
@@ -110,9 +201,12 @@ class ElasticExecutor:
                         attempts += 1
                         if attempt > 0:
                             retried += 1
+                    self._backoff(i, attempt)
                     if self.fault_hook is not None:
                         self.fault_hook(i, attempt)
-                    work_fn(items[i], tmp)
+                    if faults.ACTIVE is not None:
+                        faults.ACTIVE.exec_attempt(i, attempt, tmp)
+                    self._run_attempt(work_fn, items[i], tmp)
                     os.replace(tmp, final)
                     return
                 except Exception as e:  # noqa: BLE001 - retry boundary
@@ -135,9 +229,15 @@ class ElasticExecutor:
         METRICS.count("executor.attempts", attempts)
         METRICS.count("executor.retried", retried)
         METRICS.count("executor.skipped_existing", skipped)
+        quarantined: List[int] = []
         if failures:
             METRICS.count("executor.failed_parts", len(failures))
-            raise PartFailedError(failures)
+            if not self.quarantine:
+                raise PartFailedError(failures)
+            # Salvage stance: degraded output beats a dead job.  The part
+            # name is simply absent, which the mergers' glob tolerates.
+            quarantined = sorted(failures)
+            METRICS.count("salvage.parts_quarantined", len(quarantined))
         if mark_success:
             nio.write_success(self.out_dir)
         return ExecutionReport(
@@ -145,4 +245,6 @@ class ElasticExecutor:
             attempts=attempts,
             retried=retried,
             skipped_existing=skipped,
+            failure_log=failures,
+            quarantined=quarantined,
         )

@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from .conf import (
     BAM_MARK_DUPLICATES,
     BAM_WRITE_SPLITTING_BAI,
+    ERRORS_MODE,
+    EXECUTOR_ATTEMPT_TIMEOUT_MS,
+    EXECUTOR_BACKOFF_MS,
     Configuration,
 )
 from .utils.tracing import METRICS, span
@@ -41,7 +44,7 @@ from .io.bam import (
 )
 from .io.merger import merge_bam_parts
 from .ops.sort import sort_keys
-from .parallel.executor import ElasticExecutor
+from .parallel.executor import ElasticExecutor, bgzf_part_valid
 from .parallel.mesh import make_mesh
 from .parallel.shuffle import DistributedSort
 from .spec import bam
@@ -99,6 +102,7 @@ def sort_bam(
     device_parse: Optional[bool] = None,
     mark_duplicates: bool = False,
     resource_cache=None,
+    errors: Optional[str] = None,
 ) -> SortStats:
     """Coordinate-sort BAM file(s) into one merged BAM.
 
@@ -162,7 +166,17 @@ def sort_bam(
     ``resource_cache`` (a :class:`serve.cache.ResourceCache`) serves the
     input header from the resident daemon's identity-keyed cache instead
     of re-reading it per job — the serve subsystem passes its own; batch
-    invocations leave it None and read cold as before."""
+    invocations leave it None and read cold as before.
+
+    ``errors`` (default: the ``hadoopbam.errors`` conf key, else
+    "strict") is the corrupt-input policy.  "strict" aborts on the first
+    bad BGZF member or torn record (pre-PR-7 behavior, and the hot path
+    is untouched).  "salvage" degrades instead of dying: corrupt members
+    and unparseable records are quarantined with guesser re-sync
+    (``salvage.*`` counters report exactly what was lost), a split whose
+    read fails outright contributes an empty batch, and a part that
+    exhausts its write attempts is quarantined rather than failing the
+    job.  Clean input produces byte-identical output in both modes."""
     if backend not in ("device", "host"):
         raise ValueError(
             f"backend must be 'device' or 'host', got {backend!r}"
@@ -177,6 +191,19 @@ def sort_bam(
         mark_duplicates = mark_duplicates or conf.get_boolean(
             BAM_MARK_DUPLICATES
         )
+    if errors is None:
+        errors = (
+            conf.get(ERRORS_MODE, "strict") if conf is not None else "strict"
+        ) or "strict"
+    if errors not in ("strict", "salvage"):
+        raise ValueError(f"errors must be strict|salvage, got {errors!r}")
+    # Executor hardening knobs (attempt deadline + retry backoff), shared
+    # by every write phase below.
+    timeout_ms = conf.get_int(EXECUTOR_ATTEMPT_TIMEOUT_MS, 0) if conf else 0
+    exec_timeout = timeout_ms / 1e3 if timeout_ms > 0 else None
+    exec_backoff = (
+        conf.get_int(EXECUTOR_BACKOFF_MS, 50) if conf else 50
+    ) / 1e3
     if resource_cache is not None:
         header = resource_cache.header(in_paths[0])[0]
     else:
@@ -221,6 +248,9 @@ def sort_bam(
             device_deflate=deflate_lanes_tier_enabled(conf),
             mark_duplicates=mark_duplicates,
             device_write=device_write_enabled(conf),
+            errors=errors,
+            attempt_timeout=exec_timeout,
+            retry_backoff=exec_backoff,
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -300,6 +330,7 @@ def sort_bam(
                 splits,
                 fields=read_fields,
                 with_keys=not use_device_parse,
+                errors=errors,
             )
         ):
             if mark_duplicates:
@@ -466,7 +497,13 @@ def sort_bam(
                 )
             )
         executor = ElasticExecutor(
-            td, max_attempts=max_attempts, max_workers=write_workers
+            td,
+            max_attempts=max_attempts,
+            max_workers=write_workers,
+            validate_part=bgzf_part_valid,
+            quarantine=errors == "salvage",
+            attempt_timeout=exec_timeout,
+            retry_backoff=exec_backoff,
         )
         # Split the native deflate thread budget across concurrent writers.
         deflate_threads = max(
@@ -715,13 +752,37 @@ def _read_splits_pipelined(
     fields=None,
     depth: Optional[int] = None,
     with_keys: bool = True,
+    errors: Optional[str] = None,
 ):
     """Yield decoded split batches in order, reading ahead in a small
     thread pool — split N+1's file read + native inflate (both release the
     GIL) overlap split N's downstream processing.  Round-1 weak #6: the
     serial read loop left the host idle during every disk wait.  Depth 2
     everywhere: measured neutral-to-positive even on the 1-core bench
-    host (BENCH_NOTES.md), a clear win with more cores."""
+    host (BENCH_NOTES.md), a clear win with more cores.
+
+    Under ``errors="salvage"`` a split whose read fails outright (even
+    the quarantining reader gave up — e.g. its header window is
+    destroyed) degrades to an *empty batch* with a
+    ``salvage.splits_failed`` counter instead of killing the job."""
+
+    def read_one(s):
+        try:
+            return fmt.read_split(
+                s, fields=fields, with_keys=with_keys, errors=errors
+            )
+        except Exception:
+            if errors != "salvage":
+                raise
+            METRICS.count("salvage.splits_failed", 1)
+            from .io.bam import _empty_soa
+
+            return RecordBatch(
+                soa=_empty_soa(fields),
+                data=np.empty(0, np.uint8),
+                keys=np.empty(0, np.int64),
+            )
+
     if depth is None:
         env = os.environ.get("HBAM_READ_DEPTH")
         if env:
@@ -738,15 +799,12 @@ def _read_splits_pipelined(
             depth = 2
     if depth <= 1 or len(splits) <= 1:
         for s in splits:
-            yield fmt.read_split(s, fields=fields, with_keys=with_keys)
+            yield read_one(s)
         return
     from concurrent.futures import ThreadPoolExecutor
 
     pool = ThreadPoolExecutor(max_workers=depth)
-    futs = [
-        pool.submit(fmt.read_split, s, fields=fields, with_keys=with_keys)
-        for s in splits[: depth + 1]
-    ]
+    futs = [pool.submit(read_one, s) for s in splits[: depth + 1]]
     nxt = depth + 1
     try:
         for i in range(len(splits)):
@@ -756,14 +814,7 @@ def _read_splits_pipelined(
             # counts on this generator being O(depth), not O(file).
             futs[i] = None
             if nxt < len(splits):
-                futs.append(
-                    pool.submit(
-                        fmt.read_split,
-                        splits[nxt],
-                        fields=fields,
-                        with_keys=with_keys,
-                    )
-                )
+                futs.append(pool.submit(read_one, splits[nxt]))
                 nxt += 1
             yield b
             del b
@@ -855,6 +906,9 @@ def _sort_bam_external(
     device_deflate: bool = False,
     mark_duplicates: bool = False,
     device_write: bool = False,
+    errors: str = "strict",
+    attempt_timeout: Optional[float] = None,
+    retry_backoff: float = 0.05,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -877,9 +931,29 @@ def _sort_bam_external(
     job-global duplicate mask; the decision itself is identical to the
     in-core path's (same columns, same device program), so the two paths
     produce byte-identical marked output.
+
+    **Crash-resume contract** (with a persistent ``part_dir``): a rerun
+    after any mid-job death — including ``kill -9`` — trusts exactly two
+    checkpoint classes.  Finished *final parts* (validated: non-empty +
+    BGZF magic, so a torn ``os.replace`` race never survives a resume)
+    are skipped by the executor as before.  Completed *spill phases* are
+    certified by a manifest (:func:`io.runs.write_manifest`) written
+    atomically only after every run is on disk: a valid manifest (input
+    file identity, budget, markdup flag, per-run sideband sizes all
+    matching) lets the rerun skip phase 1 entirely and re-derive the
+    ranges from the runs — both deterministic, so the resumed output is
+    byte-identical to an uninterrupted run.  Any mismatch silently redoes
+    phase 1; checkpoints are an optimization, never trusted blindly.
     """
     from .io.bam import write_part_fast
-    from .io.runs import Run, plan_ranges, write_run
+    from .io.runs import (
+        Run,
+        input_identity,
+        load_manifest,
+        plan_ranges,
+        write_manifest,
+        write_run,
+    )
 
     if mark_duplicates:
         from .dedup import DEDUP_EXTRA_FIELDS, signature_columns
@@ -902,74 +976,134 @@ def _sort_bam_external(
         spill_dir = os.path.join(td, "spill")
         os.makedirs(spill_dir, exist_ok=True)
 
-        # ---- Phase 1: stream splits → sorted runs ------------------------
-        n = 0
-        peak = 0
-        run_count = 0
-        acc: List[RecordBatch] = []
-        acc_bytes = 0
-        sig_cols: List[dict] = []
-        flushed_n = 0  # records already spilled (read-order index base)
-
-        def flush() -> None:
-            nonlocal run_count, acc, acc_bytes, peak, flushed_n
-            if not acc:
-                return
-            merged = ChunkedRecords.from_batches(acc)
-            peak = max(peak, acc_bytes)
-            perm = _sort_perm(merged.keys, backend)
-            orig = None
-            k = merged.n_records
-            if mark_duplicates:
-                # Global read-order index of each spilled record: runs are
-                # flushed in read order, so this chunk covers exactly
-                # [flushed_n, flushed_n + k).
-                orig = np.arange(flushed_n, flushed_n + k, dtype=np.int64)
-            write_run(spill_dir, run_count, merged, perm, orig_idx=orig)
-            flushed_n += k
-            run_count += 1
-            acc = []
-            acc_bytes = 0
-
-        with span("sort_bam.spill"):
-            for b in _read_splits_pipelined(fmt, splits, fields=read_fields):
-                if mark_duplicates:
-                    with span("sort_bam.markdup_signature"):
-                        sig_cols.append(signature_columns(b.data, b.soa))
-                b.soa = {
-                    "rec_off": b.soa["rec_off"],
-                    "rec_len": b.soa["rec_len"],
-                }
-                # Spill runs live on disk, not in HBM: the out-of-core
-                # path cannot consume the inflate tier's residency
-                # handoff, so drop the device window per split — before
-                # this fix the refs silently pinned every split's
-                # inflated bytes in HBM until its run flushed.
-                b.device_data = None
-                n += b.n_records
-                if acc and acc_bytes + len(b.data) > memory_budget:
-                    flush()
-                acc.append(b)
-                acc_bytes += len(b.data)
-                if acc_bytes >= memory_budget:
-                    flush()
-            flush()
-        METRICS.count("sort_bam.records", n)
-        METRICS.count("sort_bam.splits", len(splits))
-        METRICS.count("sort_bam.runs", run_count)
+        # ---- Phase 0: crash-resume check ---------------------------------
+        # With a persistent part_dir, a manifest left by a completed spill
+        # phase (plus the dup-mask sideband when marking duplicates) lets
+        # a rerun skip phase 1 and trust the runs as checkpoints.
+        identity = None
+        if part_dir is not None:
+            try:
+                identity = input_identity(
+                    list(dict.fromkeys(s.path for s in splits))
+                )
+            except OSError:
+                identity = None  # non-local inputs: no spill checkpointing
+        dupmask_path = os.path.join(spill_dir, "dupmask.npy")
+        manifest = (
+            load_manifest(
+                spill_dir, identity, memory_budget, mark_duplicates
+            )
+            if identity is not None
+            else None
+        )
+        if (
+            manifest is not None
+            and mark_duplicates
+            and not os.path.exists(dupmask_path)
+        ):
+            manifest = None
 
         dup_mask = None
         n_dup = 0
-        if mark_duplicates and n:
-            from .dedup import concat_columns, mark_duplicates_device
-
-            with span("sort_bam.markdup"):
-                dup_mask = mark_duplicates_device(
-                    concat_columns(sig_cols)
-                )
+        peak = 0
+        if manifest is not None:
+            n = int(manifest["n_records"])
+            run_count = int(manifest["run_count"])
+            METRICS.count("sort_bam.resume_spill_reused", 1)
+            if mark_duplicates:
+                dup_mask = np.load(dupmask_path)
                 n_dup = int(dup_mask.sum())
+        else:
+            # ---- Phase 1: stream splits → sorted runs --------------------
+            n = 0
+            run_count = 0
+            acc: List[RecordBatch] = []
+            acc_bytes = 0
+            sig_cols: List[dict] = []
+            flushed_n = 0  # records already spilled (read-order index base)
+
+            def flush() -> None:
+                nonlocal run_count, acc, acc_bytes, peak, flushed_n
+                if not acc:
+                    return
+                merged = ChunkedRecords.from_batches(acc)
+                peak = max(peak, acc_bytes)
+                perm = _sort_perm(merged.keys, backend)
+                orig = None
+                k = merged.n_records
+                if mark_duplicates:
+                    # Global read-order index of each spilled record: runs
+                    # are flushed in read order, so this chunk covers
+                    # exactly [flushed_n, flushed_n + k).
+                    orig = np.arange(
+                        flushed_n, flushed_n + k, dtype=np.int64
+                    )
+                write_run(spill_dir, run_count, merged, perm, orig_idx=orig)
+                flushed_n += k
+                run_count += 1
+                acc = []
+                acc_bytes = 0
+
+            with span("sort_bam.spill"):
+                for b in _read_splits_pipelined(
+                    fmt, splits, fields=read_fields, errors=errors
+                ):
+                    if mark_duplicates:
+                        with span("sort_bam.markdup_signature"):
+                            sig_cols.append(
+                                signature_columns(b.data, b.soa)
+                            )
+                    b.soa = {
+                        "rec_off": b.soa["rec_off"],
+                        "rec_len": b.soa["rec_len"],
+                    }
+                    # Spill runs live on disk, not in HBM: the out-of-core
+                    # path cannot consume the inflate tier's residency
+                    # handoff, so drop the device window per split — before
+                    # this fix the refs silently pinned every split's
+                    # inflated bytes in HBM until its run flushed.
+                    b.device_data = None
+                    n += b.n_records
+                    if acc and acc_bytes + len(b.data) > memory_budget:
+                        flush()
+                    acc.append(b)
+                    acc_bytes += len(b.data)
+                    if acc_bytes >= memory_budget:
+                        flush()
+                flush()
+
+            if mark_duplicates and n:
+                from .dedup import concat_columns, mark_duplicates_device
+
+                with span("sort_bam.markdup"):
+                    dup_mask = mark_duplicates_device(
+                        concat_columns(sig_cols)
+                    )
+                    n_dup = int(dup_mask.sum())
+                sig_cols = []
+
+            if identity is not None:
+                # Checkpoint the completed spill phase.  Sidebands first,
+                # manifest last (atomically): a manifest on disk certifies
+                # everything it names.
+                if dup_mask is not None:
+                    tmp_dm = dupmask_path + ".tmp"
+                    with open(tmp_dm, "wb") as f:
+                        np.save(f, dup_mask)
+                    os.replace(tmp_dm, dupmask_path)
+                write_manifest(
+                    spill_dir,
+                    identity,
+                    n_records=n,
+                    run_count=run_count,
+                    memory_budget=memory_budget,
+                    mark_duplicates=mark_duplicates,
+                )
+        METRICS.count("sort_bam.records", n)
+        METRICS.count("sort_bam.splits", len(splits))
+        METRICS.count("sort_bam.runs", run_count)
+        if n_dup:
             METRICS.count("sort_bam.duplicates", n_dup)
-            sig_cols = []
 
         # ---- Phase 2: exact key-range merge ------------------------------
         runs = [Run.open(spill_dir, k) for k in range(run_count)]
@@ -982,7 +1116,13 @@ def _sort_bam_external(
         # past the contract (write_workers is deliberately not honored
         # here; deflate threads provide the parallelism instead).
         executor = ElasticExecutor(
-            td, max_attempts=max_attempts, max_workers=1
+            td,
+            max_attempts=max_attempts,
+            max_workers=1,
+            validate_part=bgzf_part_valid,
+            quarantine=errors == "salvage",
+            attempt_timeout=attempt_timeout,
+            retry_backoff=retry_backoff,
         )
         deflate_threads = max(
             1, (os.cpu_count() or 4) // executor.max_workers

@@ -5,11 +5,22 @@ request/reply, so a persistent connection would only add failure modes
 (half-closed sockets across daemon drains).  Every method raises
 :class:`ServeError` on an ``ok: false`` reply — callers never have to
 inspect protocol envelopes.
+
+Failure policy (PR 7): transport-level failures — connection refused or
+reset, a dropped connection before the reply, a stalled read past the
+socket timeout — raise :class:`ServeConnectionError`, and *idempotent*
+requests (ping/view/flagstat/job/stats) retry them a bounded number of
+times with exponential backoff before giving up.  ``sort`` submissions
+are never auto-retried (a resubmit is a second job).  :meth:`wait` polls
+with jittered exponential backoff (0.05 s → ``poll_max``) instead of the
+old fixed 0.05 s spin, and rides out a bounded streak of retryable
+polling errors rather than dying on the first daemon hiccup.
 """
 
 from __future__ import annotations
 
 import base64
+import random
 import socket
 import time
 from typing import Optional
@@ -21,6 +32,19 @@ class ServeError(RuntimeError):
     """The daemon replied ok=false (the error string is the message)."""
 
 
+class ServeConnectionError(ServeError, ConnectionError):
+    """A transport-level failure (refused/reset/dropped/stalled) — the
+    retryable class; the daemon may be fine and merely mid-drain.  Also a
+    ``ConnectionError`` so pre-existing callers catching ``OSError`` for
+    connection trouble keep working."""
+
+
+#: Exceptions worth retrying at the transport layer.  ``socket.timeout``
+#: and the ``Connection*`` family are OSError subclasses, but transient
+#: non-OSError paths (json of a half frame) surface as ServeConnectionError.
+_RETRYABLE = (ServeConnectionError, socket.timeout, ConnectionError, OSError)
+
+
 class ServeClient:
     def __init__(
         self,
@@ -28,6 +52,8 @@ class ServeClient:
         host: str = "127.0.0.1",
         port: Optional[int] = None,
         timeout: float = 300.0,
+        retries: int = 2,
+        retry_backoff: float = 0.05,
     ):
         if socket_path is None and port is None:
             from .server import default_socket_path
@@ -37,8 +63,10 @@ class ServeClient:
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
 
-    def _request(self, obj: dict) -> dict:
+    def _request_once(self, obj: dict) -> dict:
         if self.socket_path is not None:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             addr = self.socket_path
@@ -53,55 +81,113 @@ class ServeClient:
         finally:
             sock.close()
         if reply is None:
-            raise ServeError("daemon closed the connection without a reply")
+            raise ServeConnectionError(
+                "daemon closed the connection without a reply"
+            )
         if not reply.get("ok"):
             raise ServeError(reply.get("error", "unknown daemon error"))
         return reply
 
+    def _request(self, obj: dict, idempotent: bool = False) -> dict:
+        """One request; idempotent ones retry transport failures with
+        exponential backoff (``retries`` attempts beyond the first)."""
+        attempts = (self.retries + 1) if idempotent else 1
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                return self._request_once(obj)
+            except ServeError as e:
+                if not isinstance(e, ServeConnectionError):
+                    raise  # a real daemon reply: never retry
+                last = e
+            except _RETRYABLE as e:
+                last = e
+            if attempt + 1 < attempts:
+                time.sleep(self.retry_backoff * (2 ** attempt))
+        assert last is not None
+        raise (
+            last
+            if isinstance(last, ServeError)
+            else ServeConnectionError(f"{type(last).__name__}: {last}")
+        )
+
     # -- ops ----------------------------------------------------------------
 
     def ping(self) -> dict:
-        return self._request({"op": "ping"})
+        return self._request({"op": "ping"}, idempotent=True)
 
     def view(self, path: str, region: str, level: int = 6) -> bytes:
         """The region's records as a complete small BAM (bytes)."""
         r = self._request(
-            {"op": "view", "path": path, "region": region, "level": level}
+            {"op": "view", "path": path, "region": region, "level": level},
+            idempotent=True,
         )
         return base64.b64decode(r["data_b64"])
 
     def flagstat(self, path: str) -> dict:
-        return self._request({"op": "flagstat", "path": path})["counts"]
+        return self._request(
+            {"op": "flagstat", "path": path}, idempotent=True
+        )["counts"]
 
     def sort(self, bam, output: str, **kwargs) -> str:
         """Submit a sort; returns the job id (poll with :meth:`job` or
-        block with :meth:`wait`)."""
+        block with :meth:`wait`).  Deliberately not auto-retried — a
+        resubmitted request is a *second* job."""
         req = {"op": "sort", "bam": bam, "output": output}
         req.update(kwargs)
         return self._request(req)["job"]
 
     def job(self, job_id: str) -> dict:
-        return self._request({"op": "job", "id": job_id})
+        return self._request({"op": "job", "id": job_id}, idempotent=True)
 
     def wait(
-        self, job_id: str, timeout: float = 600.0, poll_s: float = 0.05
+        self,
+        job_id: str,
+        timeout: float = 600.0,
+        poll_s: float = 0.05,
+        poll_max: float = 1.0,
+        max_poll_errors: int = 5,
     ) -> dict:
-        """Poll a submitted job to completion; raises on job failure."""
+        """Poll a submitted job to completion; raises on job failure.
+
+        Polling backs off exponentially from ``poll_s`` to ``poll_max``
+        with ±20% jitter (a fleet of waiters must not stampede the
+        daemon in lockstep), and a streak of up to ``max_poll_errors``
+        retryable transport errors — reset connections, stalled reads —
+        is ridden out with the same backoff instead of aborting a job
+        that is still running server-side."""
         deadline = time.monotonic() + timeout
+        delay = poll_s
+        errors_in_a_row = 0
         while True:
-            st = self.job(job_id)
-            if st["status"] == "done":
-                return st
-            if st["status"] == "failed":
-                raise ServeError(st.get("error", "job failed"))
+            try:
+                st = self.job(job_id)
+                errors_in_a_row = 0
+            except _RETRYABLE as e:
+                errors_in_a_row += 1
+                if errors_in_a_row > max_poll_errors:
+                    raise ServeConnectionError(
+                        f"job {job_id}: {errors_in_a_row} consecutive "
+                        f"polling failures (last: {type(e).__name__}: {e})"
+                    ) from e
+                st = None
+            if st is not None:
+                if st["status"] == "done":
+                    return st
+                if st["status"] == "failed":
+                    raise ServeError(st.get("error", "job failed"))
             if time.monotonic() >= deadline:
                 raise TimeoutError(
-                    f"job {job_id} still {st['status']} after {timeout}s"
+                    f"job {job_id} not done after {timeout}s"
                 )
-            time.sleep(poll_s)
+            time.sleep(
+                min(delay, max(deadline - time.monotonic(), 0.0))
+                * random.uniform(0.8, 1.2)
+            )
+            delay = min(delay * 1.6, poll_max)
 
     def stats(self) -> dict:
-        return self._request({"op": "stats"})
+        return self._request({"op": "stats"}, idempotent=True)
 
     def shutdown(self) -> dict:
         """Graceful drain: the daemon finishes in-flight jobs, replies,

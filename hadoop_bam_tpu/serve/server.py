@@ -38,6 +38,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from .. import faults
 from ..conf import (
     Configuration,
     SERVE_MAX_INFLIGHT,
@@ -104,6 +105,7 @@ class BamDaemon:
         warmup_kwargs: Optional[dict] = None,
     ):
         self.conf = conf or Configuration()
+        faults.arm_from_conf(self.conf)  # drills via hadoopbam.faults.plan
         self.socket_path = socket_path or self.conf.get(SERVE_SOCKET)
         self.port = (
             port
@@ -232,6 +234,19 @@ class BamDaemon:
                         "ok": False,
                         "error": f"{type(e).__name__}: {e}",
                     }
+                if faults.ACTIVE is not None:
+                    # The serve-socket fault seam: dropped connections and
+                    # stalled replies, injected between dispatch and send
+                    # so the client's retry/backoff path is what's proven
+                    # (the request itself already executed — exactly the
+                    # ambiguity a real connection drop leaves behind).
+                    act = faults.ACTIVE.serve_action(req.get("op"))
+                    if act is not None and act["action"] == "drop":
+                        return  # close without replying
+                    if act is not None and act["action"] == "stall":
+                        import time as _time
+
+                        _time.sleep(act["ms"] / 1e3)
                 send_msg(conn, reply)
         except Exception:
             METRICS.count("serve.connection_errors", 1)

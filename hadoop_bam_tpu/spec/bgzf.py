@@ -25,6 +25,9 @@ import zlib
 from dataclasses import dataclass
 from typing import BinaryIO, Iterator, List, Optional, Tuple, Union
 
+from .. import faults
+from ..utils.tracing import METRICS
+
 # Gzip member header with FEXTRA, as 4 leading magic bytes.
 MAGIC = b"\x1f\x8b\x08\x04"
 # The BC extra subfield: SI1='B', SI2='C', SLEN=2.
@@ -45,6 +48,16 @@ TERMINATOR = (
 
 class BgzfError(IOError):
     pass
+
+
+def has_eof_terminator(data: bytes) -> bool:
+    """Does the stream end with the canonical 28-byte BGZF EOF marker?
+
+    htsjdk's ``BlockCompressedInputStream.checkTermination`` equivalent:
+    a missing marker is the signature of a truncated file (a writer that
+    died before close), worth flagging *before* a job walks gigabytes to
+    the torn tail."""
+    return len(data) >= len(TERMINATOR) and data[-len(TERMINATOR):] == TERMINATOR
 
 
 @dataclass(frozen=True)
@@ -159,6 +172,10 @@ def inflate_block(buf: bytes, pos: int = 0, check_crc: bool = True) -> Tuple[byt
         payload = zlib.decompress(buf[cdata_off : cdata_off + cdata_len], wbits=-15)
     except zlib.error as e:
         raise BgzfError(f"corrupt deflate stream at offset {pos}: {e}") from e
+    if faults.ACTIVE is not None:
+        # Detected-corruption seam: the flip happens BEFORE the CRC gate,
+        # so the framing check — not luck — is what catches it.
+        payload = faults.ACTIVE.corrupt_payload(payload)
     crc, isize = struct.unpack_from("<II", buf, pos + bsize - 8)
     if len(payload) != isize:
         raise BgzfError(f"ISIZE mismatch at {pos}: {len(payload)} != {isize}")
@@ -234,16 +251,41 @@ class BgzfReader:
     The oracle equivalent of htsjdk's BlockCompressedInputStream as used by
     the record readers (e.g. BAMRecordReader.java:179-183 iterating
     ``[vStart, vEnd)``).  One-block cache; sequential reads walk the chain.
+
+    ``check_eof`` (default: on for whole-file ``str`` sources, off for
+    byte windows, which legitimately end mid-stream) probes for the
+    28-byte BGZF EOF terminator at open — htsjdk's truncated-file
+    warning — setting :attr:`truncated` and counting ``bgzf.missing_eof``
+    when it is absent.  ``errors="salvage"`` makes a torn tail (a final
+    member that fails to parse/inflate) a clean EOF at the last whole
+    member (``salvage.torn_tail`` counter) instead of the strict-mode
+    raise.
     """
 
-    def __init__(self, source: Union[str, bytes, BinaryIO]):
+    def __init__(
+        self,
+        source: Union[str, bytes, BinaryIO],
+        errors: str = "strict",
+        check_eof: Optional[bool] = None,
+    ):
         if isinstance(source, (str,)):
             with open(source, "rb") as f:
                 self._data = f.read()
+            if check_eof is None:
+                check_eof = True
         elif isinstance(source, bytes):
             self._data = source
         else:
             self._data = source.read()
+        if errors not in ("strict", "salvage"):
+            raise ValueError(f"errors must be strict|salvage, got {errors!r}")
+        self._errors = errors
+        #: None = not probed (windowed source); else the missing-EOF flag.
+        self.truncated: Optional[bool] = None
+        if check_eof:
+            self.truncated = not has_eof_terminator(self._data)
+            if self.truncated:
+                METRICS.count("bgzf.missing_eof", 1)
         self._coffset = 0
         self._uoffset = 0
         self._block: Optional[bytes] = None
@@ -254,7 +296,15 @@ class BgzfReader:
             return True
         if self._coffset >= len(self._data):
             return False
-        payload, csize = inflate_block(self._data, self._coffset)
+        try:
+            payload, csize = inflate_block(self._data, self._coffset)
+        except BgzfError:
+            if self._errors != "salvage":
+                raise
+            # Torn tail: stop cleanly at the last whole member.
+            METRICS.count("salvage.torn_tail", 1)
+            self._coffset = len(self._data)
+            return False
         self._block = payload
         self._block_csize = csize
         return True

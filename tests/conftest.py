@@ -60,6 +60,12 @@ def pytest_configure(config):
         "arena, lane batching, warm-up (run everywhere; the kernel-side "
         "pieces use interpret mode under a cpu pin)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection + salvage-mode robustness tests "
+        "(corrupt members, torn writes, kill -9 resume, socket drops; "
+        "run everywhere — no kernels involved)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
