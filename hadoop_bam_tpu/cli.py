@@ -150,6 +150,40 @@ def _apply_robustness_args(conf, args) -> None:
         faults.arm(args.faults)
 
 
+def _arm_trace(args, conf=None) -> bool:
+    """Arm the process-global timeline tracer for a ``--trace`` run.
+
+    The ring capacity comes from ``hadoopbam.trace.events`` when set
+    (oldest events drop on overflow; cumulative metrics are unaffected).
+    """
+    if not getattr(args, "trace", None):
+        return False
+    from .conf import TRACE_EVENTS
+    from .utils.tracing import DEFAULT_TRACE_EVENTS, TRACER
+
+    cap = (
+        conf.get_int(TRACE_EVENTS, DEFAULT_TRACE_EVENTS)
+        if conf is not None
+        else DEFAULT_TRACE_EVENTS
+    )
+    TRACER.start(capacity=cap)
+    return True
+
+
+def _export_trace(args) -> None:
+    """Write the Chrome trace-event JSON and disarm (stderr status line —
+    stdout may be carrying a BAM blob for ``view -o -``)."""
+    from .utils.tracing import TRACER
+
+    n = TRACER.export_chrome(args.trace)
+    dropped = TRACER.dropped_events
+    TRACER.stop()
+    msg = f"{args.trace}: {n} trace events"
+    if dropped:
+        msg += f" ({dropped} oldest dropped; raise hadoopbam.trace.events)"
+    print(msg, file=sys.stderr)
+
+
 def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     from .conf import (
         BAM_MARK_DUPLICATES,
@@ -185,12 +219,19 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
         mesh = make_mesh(args.devices)
     import contextlib
 
-    from .utils.tracing import METRICS, device_trace
+    from .utils.tracing import delta, device_trace, snapshot
 
     ctx = (
         device_trace(args.trace_dir) if args.trace_dir
         else contextlib.nullcontext()
     )
+    traced = _arm_trace(args, conf)
+    # Snapshot/delta, not reset(): the ``--metrics`` report covers exactly
+    # this run even when sort_bam is invoked from a process with prior
+    # registry traffic (a resident daemon, a test harness) — resetting the
+    # process-global registry here would corrupt any concurrent user's
+    # delta accounting (see MetricsRegistry.reset's hazard note).
+    before = snapshot() if args.metrics else None
     with ctx:
         stats = sort_bam(
             list(args.bam),
@@ -203,6 +244,8 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
             memory_budget=args.memory_budget,
             part_dir=args.part_dir,
         )
+    if traced:
+        _export_trace(args)
     dup = (
         f", {stats.n_duplicates} duplicates flagged" if mark_duplicates
         else ""
@@ -214,7 +257,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     if args.metrics:
         import json
 
-        report = METRICS.report()
+        report = delta(before)
         # Device codec tier accounting, explicit even when every counter
         # is zero (publish() skips zeros): members per tier plus the
         # size/vmem/ok0 tier-down taxonomy of the most recent call to
@@ -229,9 +272,18 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
         # Transfer ledger: the h2d/d2h byte totals (and per-kind splits)
         # the hot paths reported — the write-side "only compressed bytes
         # cross PCIe" claim is a number here, not an inference.
-        from .utils.tracing import transfers_report
+        from .utils.tracing import run_manifest, transfers_report
 
         report["transfers"] = transfers_report(report["counters"])
+        # Run provenance: backend actually used, every tier decision with
+        # its reason counters, fault/salvage mode, conf overrides — the
+        # block that keeps a silent fallback from masquerading as a
+        # device run (the bench rounds carry the same manifest).
+        report["run_manifest"] = run_manifest(
+            backend=stats.backend,
+            conf=conf,
+            counters=report["counters"],
+        ).as_dict()
         print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 
@@ -249,11 +301,14 @@ def _cmd_view(args) -> int:
 
     conf = Configuration()
     _apply_robustness_args(conf, args)
+    traced = _arm_trace(args, conf)
     ctx = ServeContext.from_conf(conf, with_batcher=False)
     try:
         blob = view_blob(ctx, args.bam, args.region, level=args.level)
     finally:
         ctx.close()
+        if traced:
+            _export_trace(args)
     if args.output == "-":
         sys.stdout.buffer.write(blob)
     else:
@@ -272,11 +327,14 @@ def _cmd_flagstat(args) -> int:
 
     conf = Configuration()
     _apply_robustness_args(conf, args)
+    traced = _arm_trace(args, conf)
     ctx = ServeContext.from_conf(conf, with_batcher=False)
     try:
         counts = flagstat(ctx, args.bam)
     finally:
         ctx.close()
+        if traced:
+            _export_trace(args)
     print(json.dumps(counts, indent=2, sort_keys=True))
     return 0
 
@@ -322,6 +380,17 @@ def _cmd_serve(args) -> int:
     except KeyboardInterrupt:
         daemon.stop()
     return 0
+
+
+def _add_trace_arg(s) -> None:
+    """The shared ``--trace`` flag (sort/markdup/view/flagstat)."""
+    s.add_argument(
+        "--trace", default=None, metavar="OUT.json",
+        help="record a per-event timeline (bounded ring buffer; "
+             "hadoopbam.trace.events caps it) and export Chrome "
+             "trace-event JSON here — load in Perfetto/chrome://tracing, "
+             "reduce with tools/trace_report.py for per-stage "
+             "busy/idle/overlap and the top stall")
 
 
 def _add_robustness_args(s) -> None:
@@ -444,7 +513,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "per tier and size/vmem/ok0 tier-downs, plus "
                             "the transfers block: h2d/d2h bytes by kind)")
         s.add_argument("--trace-dir", default=None,
-                       help="capture a JAX profiler (XPlane) trace here")
+                       help="capture a JAX profiler (XPlane) trace here "
+                            "(device timeline; composable with --trace's "
+                            "host timeline)")
+        _add_trace_arg(s)
         _add_robustness_args(s)
 
     s = sub.add_parser("sort", help="coordinate-sort BAM file(s) end to end")
@@ -469,6 +541,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("region", help="contig | contig:pos | contig:start-end")
     s.add_argument("-o", "--output", default="-")
     s.add_argument("--level", type=int, default=6)
+    _add_trace_arg(s)
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_view)
 
@@ -478,6 +551,7 @@ def build_parser() -> argparse.ArgumentParser:
              "printed as JSON; same code path as the daemon endpoint)",
     )
     s.add_argument("bam")
+    _add_trace_arg(s)
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_flagstat)
 

@@ -103,6 +103,11 @@ ERRORS_MODE = "hadoopbam.errors"
 # var takes precedence (it covers subprocess drills).  Unset = disarmed,
 # and the seams are zero-cost no-ops.
 FAULTS_PLAN = "hadoopbam.faults.plan"
+# Timeline tracer ring capacity (events) for ``--trace`` runs
+# (utils/tracing.Tracer): the per-event buffer is bounded — on overflow
+# the OLDEST events drop (counted in the export's ``dropped_events``)
+# while the cumulative METRICS spans stay intact.  Unset = 65536.
+TRACE_EVENTS = "hadoopbam.trace.events"
 # ElasticExecutor hardening: wall-clock deadline per part-write attempt
 # (milliseconds; 0/unset = no deadline — an attempt that exceeds it is
 # counted failed and retried, Hadoop's task-timeout semantics) and the

@@ -38,7 +38,7 @@ from ..conf import (
 )
 from ..spec import bam, bgzf, indices
 from ..utils.intervals import Interval, parse_intervals
-from ..utils.tracing import METRICS
+from ..utils.tracing import METRICS, span
 from .guesser import BamSplitGuesser
 from .splits import FileVirtualSplit
 
@@ -518,9 +518,10 @@ class BamInputFormat:
         margin = 4 << 20
         while True:
             end_byte = min(cend + margin, size)
-            window = fs.read_range_retry(
-                sfs, split.path, cstart, end_byte - cstart
-            )
+            with span("bam.stage.read", category="stage"):
+                window = fs.read_range_retry(
+                    sfs, split.path, cstart, end_byte - cstart
+                )
             at_eof = end_byte >= size
             shift = cstart << 16
             chunks = None
@@ -732,7 +733,8 @@ def read_virtual_range(
             threads=threads,
         )
 
-    out, offs = inflate(co_l, cs_l, us_l)
+    with span("bam.stage.inflate", category="stage"):
+        out, offs = inflate(co_l, cs_l, us_l)
     # ``buf[:plen]`` is the live payload.  The no-spill fast path keeps the
     # native output zero-copy; spills grow the buffer geometrically so a
     # tail record spanning K blocks costs O(window + spill) amortized, not
@@ -793,40 +795,41 @@ def read_virtual_range(
     # (spanning past the loaded window) pulls in spill blocks and resumes.
     rec_parts: List[np.ndarray] = []
     p = uoffs_l[0] + up0 if uoffs_l else 0
-    while True:
-        offs, resume = native.record_chain_partial(buf[:plen], p, plen)
-        if vend_off is not None:
-            k = int(np.searchsorted(offs, vend_off, side="left"))
-        else:
-            k = len(offs)
-        rec_parts.append(offs[:k])
-        if k < len(offs):
-            break  # saw a record at/after vend: done
-        if vend_off is not None and resume >= vend_off:
-            break
-        if resume + 4 <= plen:
-            # chain stopped on a truncated body inside the window
-            if not spill_one():
-                raise bam.BamError("truncated record at end of file")
-        elif spill_pos < file_end:
-            spill_one()
-        else:
-            # ≤3 trailing bytes at EOF: lenient, like the iterator stopping
-            # when no full size word remains.
-            break
-        p = resume
+    with span("bam.stage.parse", category="stage"):
+        while True:
+            offs, resume = native.record_chain_partial(buf[:plen], p, plen)
+            if vend_off is not None:
+                k = int(np.searchsorted(offs, vend_off, side="left"))
+            else:
+                k = len(offs)
+            rec_parts.append(offs[:k])
+            if k < len(offs):
+                break  # saw a record at/after vend: done
+            if vend_off is not None and resume >= vend_off:
+                break
+            if resume + 4 <= plen:
+                # chain stopped on a truncated body inside the window
+                if not spill_one():
+                    raise bam.BamError("truncated record at end of file")
+            elif spill_pos < file_end:
+                spill_one()
+            else:
+                # ≤3 trailing bytes at EOF: lenient, like the iterator
+                # stopping when no full size word remains.
+                break
+            p = resume
 
-    arr = buf[:plen]
-    offsets = (
-        np.concatenate(rec_parts)
-        if rec_parts
-        else np.empty(0, dtype=np.int64)
-    )
-    soa = (
-        bam.soa_decode(arr, offsets, fields=fields)
-        if len(offsets)
-        else _empty_soa(fields)
-    )
+        arr = buf[:plen]
+        offsets = (
+            np.concatenate(rec_parts)
+            if rec_parts
+            else np.empty(0, dtype=np.int64)
+        )
+        soa = (
+            bam.soa_decode(arr, offsets, fields=fields)
+            if len(offsets)
+            else _empty_soa(fields)
+        )
     if interval_chunks is not None and len(offsets):
         keep = _voffset_mask(
             offsets,
@@ -836,11 +839,12 @@ def read_virtual_range(
             interval_chunks,
         )
         soa = {k: v[keep] for k, v in soa.items()}
-    keys = (
-        bam.soa_keys(soa, arr)
-        if with_keys and len(soa["rec_off"])
-        else np.empty(0, dtype=np.int64)
-    )
+    with span("bam.stage.key", category="stage"):
+        keys = (
+            bam.soa_keys(soa, arr)
+            if with_keys and len(soa["rec_off"])
+            else np.empty(0, dtype=np.int64)
+        )
     METRICS.count("bam.blocks_inflated", len(voffs_l))
     METRICS.count("bam.bytes_inflated", plen)
     METRICS.count("bam.records_decoded", len(offsets))
@@ -1327,7 +1331,8 @@ def write_part_fast(
         if blob is not None:
             block_payload = _flate.DEV_LZ_PAYLOAD
     if blob is None:
-        payload = gather_record_array(batch, order)
+        with span("bam.stage.gather", category="stage"):
+            payload = gather_record_array(batch, order)
         if dup_mask is not None:
             dm = dup_mask[order] if order is not None else dup_mask
             if dm.any():
@@ -1361,11 +1366,13 @@ def write_part_fast(
                 blob = None
                 block_payload = bgzf.MAX_PAYLOAD
         if blob is None:
-            blob = native.deflate_blocks(
-                payload, level=level, threads=threads,
-                block_payload=block_payload,
-            )
-    stream.write(blob)
+            with span("bam.stage.deflate", category="stage"):
+                blob = native.deflate_blocks(
+                    payload, level=level, threads=threads,
+                    block_payload=block_payload,
+                )
+    with span("bam.stage.write", category="stage"):
+        stream.write(blob)
     if splitting_bai_stream is not None:
         ln = batch.soa["rec_len"].astype(np.int64) + 4
         if order is not None:

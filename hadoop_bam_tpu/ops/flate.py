@@ -121,6 +121,7 @@ import jax.numpy as jnp
 
 from .. import faults
 from ..spec import bgzf
+from ..utils.tracing import stage as _trace_stage
 
 # --------------------------------------------------------------------------
 # Fixed-Huffman tables (RFC 1951 §3.2.5-3.2.6), precomputed as numpy consts.
@@ -1260,6 +1261,7 @@ def _device_flatten(bytes2d, lane_of, start_of, local0, n_total: int):
     return bytes2d[lanes, p - starts]
 
 
+@_trace_stage("flate.stage.inflate_device")
 def inflate_blocks_device(
     data,
     coffsets: np.ndarray,
@@ -1639,6 +1641,7 @@ def bgzf_compress_device(
     return bytes(buf)
 
 
+@_trace_stage("flate.stage.deflate_device")
 def deflate_blocks_device(
     payload,
     level: int = 1,

@@ -33,7 +33,7 @@ from .conf import (
     EXECUTOR_BACKOFF_MS,
     Configuration,
 )
-from .utils.tracing import METRICS, span
+from .utils.tracing import METRICS, span, trace_ctx
 from .io.bam import (
     SORT_FIELDS,
     BamInputFormat,
@@ -361,7 +361,10 @@ def sort_bam(
                     parsed.append(False)
                     continue
                 try:
-                    parsed.append(_device_parse_split(b))
+                    with trace_ctx(split=si), span(
+                        "pipeline.stage.device_parse", category="stage"
+                    ):
+                        parsed.append(_device_parse_split(b))
                 except Exception:
                     # Device OOM / compile failure / tunnel error: record
                     # the failure and let the sort fall back to host keys.
@@ -398,7 +401,7 @@ def sort_bam(
             rows = -(-max(n, 1) // mesh.devices.size)
             ds = DistributedSort(mesh, rows_per_device=rows)
         backend = f"mesh[{ds.n_devices}]"
-        with span("sort_bam.shuffle_sort"):
+        with span("sort_bam.shuffle_sort", category="stage"):
             all_keys = _all_keys()
             try:
                 _, perm, _ = ds.sort_global(all_keys)
@@ -410,7 +413,7 @@ def sort_bam(
                 _, perm, _ = ds.sort_global(all_keys)
     elif use_device_parse and n:
         backend = "device-parse"
-        with span("sort_bam.device_parse_sort"):
+        with span("sort_bam.device_parse_sort", category="stage"):
             try:
                 perm = _finish_device_parse(batches, parsed, n)
             except Exception:
@@ -428,7 +431,7 @@ def sort_bam(
                 )
     elif use_device and n:
         backend = "single-device"
-        with span("sort_bam.device_sort"):
+        with span("sort_bam.device_sort", category="stage"):
             # Key columns were uploaded in batches during the read; the
             # permutation comes back in a few async group downloads that
             # are awaited lazily: group g's transfer rides under the
@@ -446,7 +449,7 @@ def sort_bam(
             perm = _LazyPermFetch(perm_dev, n)
     else:
         backend = "host"
-        with span("sort_bam.host_sort"):
+        with span("sort_bam.host_sort", category="stage"):
             perm = np.argsort(_all_keys(), kind="stable")
 
     # The dedup fusion stage: one device decision over the job-global
@@ -518,7 +521,9 @@ def sort_bam(
             try:
                 if write_splitting_bai:
                     sb_stream = open(tmp + ".sb", "wb")
-                with open(tmp, "wb") as f:
+                with trace_ctx(part=pi), span(
+                    "pipeline.stage.write_part", category="item"
+                ), open(tmp, "wb") as f:
                     write_part_fast(
                         f,
                         merged,
@@ -766,22 +771,28 @@ def _read_splits_pipelined(
     destroyed) degrades to an *empty batch* with a
     ``salvage.splits_failed`` counter instead of killing the job."""
 
-    def read_one(s):
-        try:
-            return fmt.read_split(
-                s, fields=fields, with_keys=with_keys, errors=errors
-            )
-        except Exception:
-            if errors != "salvage":
-                raise
-            METRICS.count("salvage.splits_failed", 1)
-            from .io.bam import _empty_soa
+    def read_one(si, s):
+        # trace_ctx tags every stage event this split's read/inflate/
+        # parse/key chain emits (in whichever pool thread it runs) with
+        # the split index — the stall reducer's per-item attribution.
+        with trace_ctx(split=si), span(
+            "pipeline.stage.read_split", category="item"
+        ):
+            try:
+                return fmt.read_split(
+                    s, fields=fields, with_keys=with_keys, errors=errors
+                )
+            except Exception:
+                if errors != "salvage":
+                    raise
+                METRICS.count("salvage.splits_failed", 1)
+                from .io.bam import _empty_soa
 
-            return RecordBatch(
-                soa=_empty_soa(fields),
-                data=np.empty(0, np.uint8),
-                keys=np.empty(0, np.int64),
-            )
+                return RecordBatch(
+                    soa=_empty_soa(fields),
+                    data=np.empty(0, np.uint8),
+                    keys=np.empty(0, np.int64),
+                )
 
     if depth is None:
         env = os.environ.get("HBAM_READ_DEPTH")
@@ -798,13 +809,16 @@ def _read_splits_pipelined(
             # second core.
             depth = 2
     if depth <= 1 or len(splits) <= 1:
-        for s in splits:
-            yield read_one(s)
+        for si, s in enumerate(splits):
+            yield read_one(si, s)
         return
     from concurrent.futures import ThreadPoolExecutor
 
     pool = ThreadPoolExecutor(max_workers=depth)
-    futs = [pool.submit(read_one, s) for s in splits[: depth + 1]]
+    futs = [
+        pool.submit(read_one, si, s)
+        for si, s in enumerate(splits[: depth + 1])
+    ]
     nxt = depth + 1
     try:
         for i in range(len(splits)):
@@ -814,7 +828,7 @@ def _read_splits_pipelined(
             # counts on this generator being O(depth), not O(file).
             futs[i] = None
             if nxt < len(splits):
-                futs.append(pool.submit(read_one, splits[nxt]))
+                futs.append(pool.submit(read_one, nxt, splits[nxt]))
                 nxt += 1
             yield b
             del b
@@ -1187,7 +1201,9 @@ def _sort_bam_external(
             try:
                 if write_splitting_bai:
                     sb_stream = open(tmp + ".sb", "wb")
-                with open(tmp, "wb") as f:
+                with trace_ctx(part=pi), span(
+                    "pipeline.stage.write_part", category="item"
+                ), open(tmp, "wb") as f:
                     # device_write passes through even though range
                     # batches are rebuilt from disk and never carry
                     # residency: the per-part tier-down records its

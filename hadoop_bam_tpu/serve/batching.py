@@ -134,6 +134,13 @@ class LaneBatcher:
             raise p.err
         return p.out, p.offs
 
+    def queue_depth(self) -> int:
+        """Requests currently waiting for a launch (the daemon's
+        ``serve.batch.queue_depth`` gauge — sustained nonzero means the
+        window/lane capacity is the bottleneck, not the kernels)."""
+        with self._lock:
+            return len(self._queue)
+
     def close(self) -> None:
         self._closed = True
         self._wake.set()

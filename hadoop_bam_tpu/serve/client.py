@@ -189,6 +189,12 @@ class ServeClient:
     def stats(self) -> dict:
         return self._request({"op": "stats"}, idempotent=True)
 
+    def metrics(self) -> str:
+        """The daemon's metrics in Prometheus text exposition format
+        (cumulative counters/histograms + live gauges) — what a scraping
+        sidecar would relay."""
+        return self._request({"op": "metrics"}, idempotent=True)["text"]
+
     def shutdown(self) -> dict:
         """Graceful drain: the daemon finishes in-flight jobs, replies,
         then exits its accept loop."""

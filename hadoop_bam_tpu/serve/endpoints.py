@@ -202,10 +202,13 @@ def view_blob(
     """A complete small BAM (header + overlapping records + terminator)
     for the requested region — records in file order, like samtools view.
     """
+    import time as _time
+
     from .. import native
     from ..io.bam import gather_record_array
     from ..io.merger import prepare_bam_header_block
 
+    t0 = _time.perf_counter()
     with span("serve.view"):
         hdr, picks = view_records(ctx, path, region)
         payloads = [
@@ -229,6 +232,10 @@ def view_blob(
         )
     METRICS.count("serve.view.requests", 1)
     METRICS.count("serve.view.records", n_records)
+    # Endpoint-level latency histogram: the daemon times whole requests
+    # around dispatch (``serve.op.view.ms``); this one covers the shared
+    # endpoint body, so the one-shot CLI surface gets p50/p95/p99 too.
+    METRICS.observe("serve.view.ms", (_time.perf_counter() - t0) * 1e3)
     return blob
 
 
@@ -247,6 +254,9 @@ def flagstat(ctx: ServeContext, path: str) -> dict:
     only), with each decoded split held in the arena so a warm re-scan is
     read-free; the counts are pure NumPy popcounts over the flag column.
     """
+    import time as _time
+
+    t0 = _time.perf_counter()
     with span("serve.flagstat"):
         hdr, _ = ctx.cache.header(path)
         ident = ctx.cache.identity(path)
@@ -301,4 +311,7 @@ def flagstat(ctx: ServeContext, path: str) -> dict:
                 (paired & mapped & ~mate_mapped).sum()
             )
     METRICS.count("serve.flagstat.requests", 1)
+    METRICS.observe(
+        "serve.flagstat.ms", (_time.perf_counter() - t0) * 1e3
+    )
     return counts

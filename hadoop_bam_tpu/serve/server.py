@@ -16,7 +16,10 @@ Request ops:
   runs through ``pipeline.sort_bam``, whose part writes already ride
   ``parallel.executor.ElasticExecutor`` — retries + atomic restarts)
 - ``job``   {id}                   → job status/stats
-- ``stats``                        → METRICS snapshot + cache/arena/batch
+- ``stats``                        → daemon-lifetime metrics delta +
+  per-op latency histograms (p50/p95/p99) + arena/cache/queue gauges
+- ``metrics``                      → Prometheus text exposition format
+  (counters/histograms + live gauges, ready for a scraper)
 - ``shutdown``                     → graceful drain: stop admitting,
   finish in-flight jobs, reply, exit the accept loop
 
@@ -46,7 +49,13 @@ from ..conf import (
     SERVE_SOCKET,
     SERVE_WARMUP,
 )
-from ..utils.tracing import METRICS, snapshot, transfers_report
+from ..utils.tracing import (
+    METRICS,
+    delta,
+    prometheus_text,
+    snapshot,
+    transfers_report,
+)
 from .endpoints import ServeContext, flagstat, view_blob
 
 _LEN = struct.Struct(">I")
@@ -220,12 +229,15 @@ class BamDaemon:
     # -- request handling ---------------------------------------------------
 
     def _handle(self, conn: socket.socket) -> None:
+        import time as _time
+
         stop_after = False
         try:
             with conn:
                 req = recv_msg(conn)
                 if req is None:
                     return
+                t0 = _time.perf_counter()
                 try:
                     reply, stop_after = self._dispatch(req)
                 except Exception as e:  # noqa: BLE001 - reply, don't die
@@ -234,6 +246,12 @@ class BamDaemon:
                         "ok": False,
                         "error": f"{type(e).__name__}: {e}",
                     }
+                # Per-op latency histogram (log2 ms buckets → p50/p95/p99
+                # in the stats/metrics ops without unbounded memory).
+                METRICS.observe(
+                    f"serve.op.{req.get('op')}.ms",
+                    (_time.perf_counter() - t0) * 1e3,
+                )
                 if faults.ACTIVE is not None:
                     # The serve-socket fault seam: dropped connections and
                     # stalled replies, injected between dispatch and send
@@ -295,6 +313,20 @@ class BamDaemon:
             return ({"ok": True, **job}, False)
         if op == "stats":
             return ({"ok": True, **self._stats()}, False)
+        if op == "metrics":
+            # Prometheus text exposition: cumulative process counters +
+            # full histogram buckets (Prometheus counters are cumulative
+            # by convention; scrapers rate() them) plus the live gauges.
+            return (
+                {
+                    "ok": True,
+                    "content_type": "text/plain; version=0.0.4",
+                    "text": prometheus_text(
+                        snapshot(), gauges=self._gauges()
+                    ),
+                },
+                False,
+            )
         if op == "shutdown":
             return (self._drain(), True)
         return ({"ok": False, "error": f"unknown op {op!r}"}, False)
@@ -351,13 +383,51 @@ class BamDaemon:
 
     # -- stats / drain ------------------------------------------------------
 
+    def _gauges(self) -> Dict[str, float]:
+        """Point-in-time gauges: arena/cache occupancy, batcher queue
+        depth, job-pool pressure — the daemon's live resource state next
+        to the cumulative counters."""
+        arena = self.ctx.arena.stats()
+        cache = self.ctx.cache.stats()
+        with self._jobs_lock:
+            statuses = [j["status"] for j in self._jobs.values()]
+        g = {
+            "serve.arena.used_bytes": arena["used_bytes"],
+            "serve.arena.budget_bytes": arena["budget_bytes"],
+            "serve.arena.entries": arena["entries"],
+            "serve.arena.device_resident": arena["device_resident"],
+            "serve.cache.used_bytes": cache["used_bytes"],
+            "serve.cache.budget_bytes": cache["budget_bytes"],
+            "serve.cache.entries": cache["entries"],
+            "serve.jobs.queued": sum(
+                1 for s in statuses if s == "queued"
+            ),
+            "serve.jobs.running": sum(
+                1 for s in statuses if s == "running"
+            ),
+            "serve.jobs.max_inflight": self.max_inflight,
+            "serve.draining": int(self._draining.is_set()),
+        }
+        if self.ctx.batcher is not None:
+            g["serve.batch.queue_depth"] = self.ctx.batcher.queue_depth()
+        return g
+
     def _stats(self) -> dict:
-        report = snapshot()
+        # Snapshot/delta exclusively — never reset(): the daemon-lifetime
+        # delta keeps the process-global registry untouched, so any
+        # concurrent request doing its own per-request delta accounting
+        # stays correct (MetricsRegistry.reset's documented hazard).
+        report = delta(self._started_snapshot)
+        # Histograms carry only count/sum through a delta; the percentile
+        # summaries are cumulative-distribution properties, so surface the
+        # live ones (per-op p50/p95/p99 latency, observed daemon-side).
+        report["histograms"] = snapshot()["histograms"]
         report["transfers"] = transfers_report(report["counters"])
         with self._jobs_lock:
             jobs = {k: dict(v) for k, v in self._jobs.items()}
         return {
             "metrics": report,
+            "gauges": self._gauges(),
             "cache": self.ctx.cache.stats(),
             "arena": self.ctx.arena.stats(),
             "jobs": jobs,

@@ -4,32 +4,133 @@ The reference's only instrumentation is a deprecated nanosecond ``Timer``
 (util/Timer.java:4-12) and a ``-`` progress tick every 500MB in its indexers
 (SplittingBAMIndexer.java:144,277-282); task progress is Hadoop's
 ``getProgress()`` contract.  Per SURVEY.md §5 the TPU build wires real
-tracing instead: wall-clock spans + named counters in a process-local
-registry, an optional 500MB-cadence progress printer, and hooks into the JAX
-profiler (XPlane) so device phases show up in TensorBoard traces.
+tracing instead, in three layers:
 
-Everything degrades to no-ops: spans/counters are cheap dict updates, and the
-profiler hooks import ``jax`` lazily so host-only tools never touch a device
-backend.
+1. **Cumulative metrics** (:class:`MetricsRegistry`): thread-safe named
+   counters, per-name span-time sums, and fixed-bucket log2
+   :class:`Histogram` distributions (p50/p95/p99 without unbounded
+   memory) — the ``--metrics`` / serve ``stats`` substrate.
+2. **Timeline tracer** (:class:`Tracer`): an opt-in bounded ring buffer
+   of per-event ``(name, t0, t1, thread, category, args)`` records fed by
+   the same :func:`span` call sites, exported as Chrome trace-event JSON
+   (loadable in Perfetto/chrome://tracing, reduced by
+   ``tools/trace_report.py``).  Disarmed, the ring buffer is never
+   allocated and :func:`span` pays one attribute check — the same
+   disarmed-contract stance as the fault seams.
+3. **Run provenance** (:class:`RunManifest`): what actually ran — the
+   backend, every device-tier decision with its reason counters, the
+   fault/salvage mode — attached to every ``--metrics`` JSON and bench
+   round so a silent CPU fallback can never masquerade as a device
+   number (the r4/r5 lesson, BENCH_NOTES.md).
+
+Everything degrades to no-ops: spans/counters are cheap dict updates, and
+the profiler hooks import ``jax`` lazily so host-only tools never touch a
+device backend.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import math
+import os
 import sys
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
+
+#: Every span/counter/histogram/gauge name must match: dotted lowercase,
+#: at least two components (``subsystem.metric``), so the metrics
+#: namespace stays greppable.  tests/test_tracing.py lints the source
+#: against this pattern.
+METRIC_NAME_PATTERN = r"^[a-z0-9_]+(\.[a-z0-9_]+)+$"
+
+
+class Histogram:
+    """Fixed log2-bucket value distribution: percentiles without unbounded
+    memory.
+
+    Bucket ``i`` counts observations ``v`` with ``2**(i-1) < v <= 2**i``
+    (bucket 0 takes ``v <= 1``; the last bucket takes everything larger),
+    so the footprint is :data:`N_BUCKETS` integers forever regardless of
+    observation count.  :meth:`percentile` returns the upper bound of the
+    bucket containing the requested rank — i.e. the smallest power of two
+    that is ≥ the true percentile, a ≤2x overestimate by construction —
+    which is the right fidelity for latency SLO gauges (the serve
+    daemon's per-op p50/p95/p99).
+    """
+
+    N_BUCKETS = 64
+
+    __slots__ = ("counts", "n", "total")
+
+    def __init__(self) -> None:
+        self.counts = [0] * self.N_BUCKETS
+        self.n = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if v <= 1.0:
+            i = 0
+        else:
+            # frexp: v = m * 2**e with 0.5 <= m < 1, so the smallest
+            # power of two >= v is 2**e (2**(e-1) for exact powers).
+            m, e = math.frexp(v)
+            i = min(self.N_BUCKETS - 1, e - 1 if m == 0.5 else e)
+        self.counts[i] += 1
+        self.n += 1
+        self.total += v
+
+    @staticmethod
+    def bucket_upper(i: int) -> float:
+        return float(2**i)
+
+    def percentile(self, q: float) -> float:
+        """Upper bound of the bucket holding the ``q``-quantile
+        observation (0 when empty)."""
+        if self.n == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.n))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return self.bucket_upper(i)
+        return self.bucket_upper(self.N_BUCKETS - 1)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "count": self.n,
+            "sum": self.total,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+            # Sparse: only occupied buckets, keyed by their upper bound.
+            "buckets": {
+                str(self.bucket_upper(i)): c
+                for i, c in enumerate(self.counts)
+                if c
+            },
+        }
+
+    def copy(self) -> "Histogram":
+        h = Histogram()
+        h.counts = list(self.counts)
+        h.n = self.n
+        h.total = self.total
+        return h
 
 
 class MetricsRegistry:
-    """Thread-safe named counters + cumulative span timings."""
+    """Thread-safe named counters + cumulative span timings + histograms."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._spans: Dict[str, float] = {}
         self._span_counts: Dict[str, int] = {}
+        self._hists: Dict[str, Histogram] = {}
 
     def count(self, name: str, delta: int = 1) -> None:
         with self._lock:
@@ -40,19 +141,51 @@ class MetricsRegistry:
             self._spans[name] = self._spans.get(name, 0.0) + seconds
             self._span_counts[name] = self._span_counts.get(name, 0) + 1
 
+    def observe(self, name: str, value: float) -> None:
+        """One observation into the named log2 :class:`Histogram`
+        (created on first use — e.g. per-op latency in milliseconds)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
     def report(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "span_seconds": dict(self._spans),
                 "span_counts": dict(self._span_counts),
+                "histograms": {
+                    k: h.as_dict() for k, h in self._hists.items()
+                },
             }
 
+    def histogram(self, name: str) -> Optional[Histogram]:
+        """A copy of the named histogram (None if never observed)."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.copy() if h is not None else None
+
     def reset(self) -> None:
+        """Zero every counter/span/histogram.
+
+        **Hazard (concurrent use):** in a long-lived process — the serve
+        daemon above all — any in-flight request doing
+        ``delta(snapshot_at_admission)`` accounting sees its *before*
+        snapshot become larger than the post-reset registry, corrupting
+        its reported deltas (negative values are the visible symptom).
+        Never call this while other threads may be mid-request: take a
+        :func:`snapshot` at the interesting epoch and report
+        :func:`delta` against it instead (the serve ``stats`` op and the
+        CLI ``--metrics`` report both do exactly this).  Tests that own
+        the whole process are the intended caller.
+        """
         with self._lock:
             self._counters.clear()
             self._spans.clear()
             self._span_counts.clear()
+            self._hists.clear()
 
 
 METRICS = MetricsRegistry()
@@ -64,11 +197,14 @@ def count_h2d(nbytes: int, what: str = "") -> None:
     compressed blocks, write-path offset columns…), so the round
     artifacts show the PCIe traffic instead of inferring it.  ``what``
     adds an itemized ``transfers.h2d.<what>`` counter next to the
-    ``transfers.h2d_bytes`` total."""
+    ``transfers.h2d_bytes`` total.  With the timeline tracer armed, each
+    crossing also lands as an instant event on the trace."""
     n = int(nbytes)
     METRICS.count("transfers.h2d_bytes", n)
     if what:
         METRICS.count(f"transfers.h2d.{what}", n)
+    if TRACER.armed:
+        TRACER.instant("transfers.h2d", "xfer", {"bytes": n, "what": what})
 
 
 def count_d2h(nbytes: int, what: str = "") -> None:
@@ -78,6 +214,8 @@ def count_d2h(nbytes: int, what: str = "") -> None:
     METRICS.count("transfers.d2h_bytes", n)
     if what:
         METRICS.count(f"transfers.d2h.{what}", n)
+    if TRACER.armed:
+        TRACER.instant("transfers.d2h", "xfer", {"bytes": n, "what": what})
 
 
 def snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Dict[str, float]]:
@@ -86,8 +224,8 @@ def snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, Dict[str, 
     Pair with :func:`delta` for per-request accounting in long-lived
     processes (the serve daemon): the process-global counters keep
     accumulating — resetting them mid-flight would corrupt every other
-    in-flight request's numbers — and each request reports
-    ``delta(snapshot_at_admission)`` instead."""
+    in-flight request's numbers (see :meth:`MetricsRegistry.reset`) —
+    and each request reports ``delta(snapshot_at_admission)`` instead."""
     return (registry or METRICS).report()
 
 
@@ -102,6 +240,10 @@ def delta(
     are kept, so a request's report shows exactly the counters/spans it
     touched.  Counters never decrease, but the diff is computed signed so
     a misuse (swapped arguments) is visible rather than silently clamped.
+    Histograms diff on their scalar ``count``/``sum`` only (bucket-level
+    diffs would re-create the unbounded-memory problem they solve);
+    percentiles remain a cumulative-distribution property and ride in the
+    full snapshot.
     """
     if after is None:
         after = snapshot(registry)
@@ -115,6 +257,17 @@ def delta(
             if v:
                 d[k] = v
         out[section] = d
+    hd: Dict[str, Dict[str, float]] = {}
+    bh = before.get("histograms", {})
+    for k, av in after.get("histograms", {}).items():
+        bv = bh.get(k, {})
+        dc = av.get("count", 0) - bv.get("count", 0)
+        if dc:
+            hd[k] = {
+                "count": dc,
+                "sum": av.get("sum", 0.0) - bv.get("sum", 0.0),
+            }
+    out["histograms"] = hd
     return out
 
 
@@ -130,10 +283,187 @@ def transfers_report(counters: Optional[Dict[str, int]] = None) -> Dict[str, int
     }
 
 
+# ---------------------------------------------------------------------------
+# Timeline tracer: per-event ring buffer → Chrome trace-event JSON.
+# ---------------------------------------------------------------------------
+
+DEFAULT_TRACE_EVENTS = 1 << 16  # ring capacity: ~64k events ≈ a few MB
+
+
+class Tracer:
+    """Opt-in bounded ring buffer of timeline events.
+
+    Disarmed (the default), no buffer exists and the :func:`span` hot
+    path pays exactly one attribute load (``TRACER.armed``) — the same
+    zero-cost-when-off contract as the fault seams, asserted by
+    tests/test_tracing.py's disarmed-contract test.  Armed
+    (:meth:`start`), every :func:`span` exit appends one event tuple
+    ``(name, category, t0, t1, tid, args)``; when the ring fills, the
+    OLDEST events are dropped (``dropped_events`` counts them — the
+    cumulative METRICS spans are unaffected, so totals stay honest even
+    on a truncated timeline).
+
+    Export (:meth:`export_chrome`) writes Chrome trace-event JSON —
+    ``{"traceEvents": [{"name", "cat", "ph": "X", "ts", "dur", "pid",
+    "tid", "args"}, …]}`` — loadable in Perfetto/chrome://tracing and
+    reducible by ``tools/trace_report.py``.  Timestamps are microseconds
+    from :meth:`start`.  This is host-side wall clock; the XPlane hook
+    (:func:`device_trace`) remains the device-timeline companion and the
+    two compose (span names annotate the XPlane timeline via
+    TraceAnnotation).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ring: Optional[List] = None  # allocated only when armed
+        self._cap = 0
+        self._head = 0  # next write slot
+        self._count = 0
+        self._epoch = 0.0
+        self.armed = False
+        self.dropped_events = 0
+
+    def start(self, capacity: int = DEFAULT_TRACE_EVENTS) -> None:
+        """Arm the tracer with a fresh ring of ``capacity`` event slots."""
+        with self._lock:
+            self._cap = max(16, int(capacity))
+            self._ring = [None] * self._cap
+            self._head = 0
+            self._count = 0
+            self.dropped_events = 0
+            self._epoch = time.perf_counter()
+            self.armed = True
+
+    def stop(self) -> None:
+        """Disarm and free the ring (events are gone — export first)."""
+        with self._lock:
+            self.armed = False
+            self._ring = None
+            self._cap = 0
+            self._head = 0
+            self._count = 0
+
+    def emit(
+        self,
+        name: str,
+        category: str,
+        t0: float,
+        t1: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Append one complete event (perf_counter endpoints).  Ambient
+        :func:`trace_ctx` key/values merge under explicit ``args``."""
+        ctx = getattr(_TLS, "ctx", None)
+        if ctx:
+            args = {**ctx, **args} if args else dict(ctx)
+        ev = (
+            name,
+            category,
+            t0 - self._epoch,
+            t1 - self._epoch,
+            threading.get_ident(),
+            args,
+        )
+        with self._lock:
+            if self._ring is None:
+                return  # disarmed between the caller's check and now
+            self._ring[self._head] = ev
+            self._head = (self._head + 1) % self._cap
+            if self._count < self._cap:
+                self._count += 1
+            else:
+                self.dropped_events += 1
+
+    def instant(
+        self, name: str, category: str, args: Optional[dict] = None
+    ) -> None:
+        """A zero-duration marker event (progress ticks, transfers)."""
+        t = time.perf_counter()
+        self.emit(name, category, t, t, args)
+
+    def events(self) -> List[tuple]:
+        """The live events, oldest first."""
+        with self._lock:
+            if self._ring is None or self._count == 0:
+                return []
+            if self._count < self._cap:
+                return list(self._ring[: self._count])
+            return (
+                self._ring[self._head :] + self._ring[: self._head]
+            )
+
+    def chrome_events(self) -> List[dict]:
+        """Events as Chrome trace-event dicts (``ph: "X"`` complete
+        events; instants are zero-duration)."""
+        pid = os.getpid()
+        out = []
+        for name, cat, t0, t1, tid, args in self.events():
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def export_chrome(self, path_or_stream) -> int:
+        """Write the Chrome trace-event JSON; returns the event count."""
+        evs = self.chrome_events()
+        doc = {
+            "traceEvents": evs,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped_events},
+        }
+        if hasattr(path_or_stream, "write"):
+            json.dump(doc, path_or_stream)
+        else:
+            with open(path_or_stream, "w") as f:
+                json.dump(doc, f)
+        return len(evs)
+
+
+#: The process-global timeline tracer (CLI ``--trace`` arms it).
+TRACER = Tracer()
+
+_TLS = threading.local()
+
+
 @contextlib.contextmanager
-def span(name: str, registry: Optional[MetricsRegistry] = None) -> Iterator[None]:
+def trace_ctx(**kw) -> Iterator[None]:
+    """Ambient event arguments for the current thread: every event
+    emitted inside the scope carries these key/values (``split=3``,
+    ``part=0`` — the stall reducer's per-item attribution).  Free when
+    the tracer is disarmed."""
+    if not TRACER.armed:
+        yield
+        return
+    old = getattr(_TLS, "ctx", None)
+    _TLS.ctx = {**old, **kw} if old else dict(kw)
+    try:
+        yield
+    finally:
+        _TLS.ctx = old
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    registry: Optional[MetricsRegistry] = None,
+    category: str = "span",
+    args: Optional[dict] = None,
+) -> Iterator[None]:
     """Timed scope, cumulative per name; also annotates the JAX profiler
-    timeline when a trace is active (TraceAnnotation is ~free otherwise)."""
+    timeline when a trace is active (TraceAnnotation is ~free otherwise)
+    and, with the timeline :data:`TRACER` armed, records a per-event
+    ``(name, t0, t1, thread, category, args)`` ring-buffer entry.
+    ``category="stage"`` marks pipeline-stage events — the unit
+    ``tools/trace_report.py`` attributes stalls to."""
     reg = registry or METRICS
     ann = _annotation(name)
     t0 = time.perf_counter()
@@ -144,7 +474,27 @@ def span(name: str, registry: Optional[MetricsRegistry] = None) -> Iterator[None
         else:
             yield
     finally:
-        reg.add_span(name, time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        reg.add_span(name, t1 - t0)
+        if TRACER.armed:
+            TRACER.emit(name, category, t0, t1, args)
+
+
+def stage(name: str):
+    """Decorator form of ``span(name, category="stage")`` — marks a whole
+    function as one pipeline stage (the codec wrappers use it; the stall
+    reducer groups events by these names)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with span(name, category="stage"):
+                return fn(*a, **k)
+
+        return wrapper
+
+    return deco
 
 
 def _annotation(name: str):
@@ -170,9 +520,231 @@ def device_trace(log_dir: str) -> Iterator[None]:
         yield
 
 
+# ---------------------------------------------------------------------------
+# Run provenance: what actually executed, attached to every artifact.
+# ---------------------------------------------------------------------------
+
+#: Counter prefixes that record a device-tier decision or fallback — the
+#: ``RunManifest`` collects every counter under these so "which tier ran,
+#: and why not the higher one" is a recorded fact, not an inference.
+TIER_DECISION_PREFIXES = (
+    "flate.inflate.",
+    "flate.deflate.",
+    "bam.device_write_tierdown.",
+    "bam.device_write_fallback",
+    "bam.device_write_parts",
+    "bam.device_inflate_fallback",
+    "bam.device_deflate_fallback",
+    "bam.write_residency_kept",
+    "sort_bam.device_parse_error",
+    "sort_bam.device_parse_fallback",
+    "sort_bam.device_parse_residency",
+    "flate.inflate_device_residency",
+)
+
+#: Counter prefixes that record a degraded/error mode the run survived.
+FAULT_MODE_PREFIXES = ("salvage.", "bgzf.missing_eof", "faults.")
+
+
+class RunManifest:
+    """Provenance of one run: backend actually used, per-tier decision
+    counters (with their reason taxonomy), fault/salvage/error mode, and
+    the explicit conf deltas — the block that makes a silent fallback
+    impossible to miss in a ``--metrics`` JSON or a bench round.
+
+    ``degraded`` is True when any *fallback-class* counter fired (a tier
+    that was supposed to run declined or errored) or when salvage-mode
+    losses were recorded; ``reasons`` names each trigger.  A run that
+    never attempted a device tier is not degraded — degradation means
+    "asked for X, got Y", which callers assert by also passing
+    ``requested``."""
+
+    def __init__(
+        self,
+        backend: Optional[str] = None,
+        platform: Optional[str] = None,
+        tier_decisions: Optional[Dict[str, int]] = None,
+        modes: Optional[Dict[str, object]] = None,
+        conf_deltas: Optional[Dict[str, str]] = None,
+        degraded: bool = False,
+        reasons: Optional[List[str]] = None,
+    ) -> None:
+        self.backend = backend
+        self.platform = platform
+        self.tier_decisions = tier_decisions or {}
+        self.modes = modes or {}
+        self.conf_deltas = conf_deltas or {}
+        self.degraded = degraded
+        self.reasons = reasons or []
+
+    def as_dict(self) -> dict:
+        return {
+            "backend": self.backend,
+            "platform": self.platform,
+            "tier_decisions": dict(self.tier_decisions),
+            "modes": dict(self.modes),
+            "conf_deltas": dict(self.conf_deltas),
+            "degraded": self.degraded,
+            "reasons": list(self.reasons),
+        }
+
+
+#: Fallback-class counters: their firing means a higher tier was
+#: attempted and lost — the manifest flags the run degraded and says why.
+_FALLBACK_REASONS = {
+    "bam.device_write_fallback": "device part write errored; host gather took the part",
+    "bam.device_inflate_fallback": "device inflate tier errored; native zlib took the window",
+    "bam.device_deflate_fallback": "device deflate tier errored; native zlib took the part",
+    "sort_bam.device_parse_error": "device parse errored on a split",
+    "sort_bam.device_parse_fallback": "device parse disagreed with the host walk; host keys used",
+}
+
+
+def run_manifest(
+    backend: Optional[str] = None,
+    conf=None,
+    counters: Optional[Dict[str, int]] = None,
+    requested: Optional[str] = None,
+) -> RunManifest:
+    """Assemble a :class:`RunManifest` from the live registry.
+
+    ``backend`` is the pipeline's actual sort backend string
+    (``SortStats.backend``); ``requested`` the one asked for — a mismatch
+    is itself a degradation reason.  ``conf`` contributes its explicit
+    key/values as ``conf_deltas`` (what the operator overrode);
+    ``counters`` defaults to the current METRICS counters."""
+    if counters is None:
+        counters = METRICS.report()["counters"]
+    tiers = {
+        k: v
+        for k, v in counters.items()
+        if any(k.startswith(p) for p in TIER_DECISION_PREFIXES)
+    }
+    modes: Dict[str, object] = {}
+    for k, v in counters.items():
+        if any(k.startswith(p) for p in FAULT_MODE_PREFIXES):
+            modes[k] = v
+    if conf is not None:
+        try:
+            from ..conf import ERRORS_MODE, FAULTS_PLAN
+
+            modes["errors"] = conf.get(ERRORS_MODE, "strict") or "strict"
+            if conf.get(FAULTS_PLAN):
+                modes["faults_plan"] = conf.get(FAULTS_PLAN)
+        except Exception:  # pragma: no cover - conf duck types in tests
+            pass
+    try:
+        from .. import faults
+
+        modes["faults_armed"] = faults.ACTIVE is not None
+    except Exception:  # pragma: no cover
+        pass
+    platform = None
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            platform = jax.default_backend()
+        except Exception:  # pragma: no cover - backend init failure
+            platform = None
+    reasons: List[str] = []
+    for k, why in _FALLBACK_REASONS.items():
+        if counters.get(k):
+            reasons.append(f"{why} ({k}={counters[k]})")
+    if counters.get("salvage.members_quarantined") or counters.get(
+        "salvage.records_dropped"
+    ):
+        reasons.append(
+            "salvage mode quarantined data "
+            f"(members={counters.get('salvage.members_quarantined', 0)}, "
+            f"records={counters.get('salvage.records_dropped', 0)})"
+        )
+    if requested is not None and backend is not None and requested != backend:
+        reasons.append(
+            f"requested backend {requested!r} but ran {backend!r}"
+        )
+    conf_deltas = {}
+    if conf is not None:
+        try:
+            conf_deltas = {k: conf.get(k) for k in conf}
+        except Exception:  # pragma: no cover
+            conf_deltas = {}
+    return RunManifest(
+        backend=backend,
+        platform=platform,
+        tier_decisions=tiers,
+        modes=modes,
+        conf_deltas=conf_deltas,
+        degraded=bool(reasons),
+        reasons=reasons,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (the serve daemon's ``metrics`` op).
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+def prometheus_text(
+    report: Optional[Dict[str, Dict[str, float]]] = None,
+    gauges: Optional[Dict[str, float]] = None,
+    prefix: str = "hbam",
+) -> str:
+    """Render a metrics report in Prometheus text exposition format.
+
+    Counters become ``<prefix>_<name>_total``, span sums
+    ``<prefix>_<name>_seconds_total`` (+ ``_count``), histograms the
+    standard cumulative ``_bucket{le="…"}`` / ``_sum`` / ``_count``
+    triplet, and ``gauges`` plain ``<prefix>_<name>`` samples.  Dots in
+    metric names map to underscores.
+    """
+    if report is None:
+        report = METRICS.report()
+    lines: List[str] = []
+    for k in sorted(report.get("counters", {})):
+        n = f"{prefix}_{_prom_name(k)}_total"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {report['counters'][k]}")
+    spans_s = report.get("span_seconds", {})
+    spans_n = report.get("span_counts", {})
+    for k in sorted(spans_s):
+        n = f"{prefix}_{_prom_name(k)}_seconds_total"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {spans_s[k]:.6f}")
+        n = f"{prefix}_{_prom_name(k)}_count"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {spans_n.get(k, 0)}")
+    for k in sorted(report.get("histograms", {})):
+        h = report["histograms"][k]
+        n = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for le, c in sorted(
+            h.get("buckets", {}).items(), key=lambda kv: float(kv[0])
+        ):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{float(le):g}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h.get("count", 0)}')
+        lines.append(f"{n}_sum {h.get('sum', 0.0):.6f}")
+        lines.append(f"{n}_count {h.get('count', 0)}")
+    for k in sorted(gauges or {}):
+        n = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {gauges[k]}")
+    return "\n".join(lines) + "\n"
+
+
 class Progress:
     """Byte-cadence progress ticks (SplittingBAMIndexer.java:277-282 prints
-    one ``-`` per 500MB; here: a callback or stderr tick, plus totals)."""
+    one ``-`` per 500MB; here: a callback or stderr tick, plus totals).
+
+    With the timeline :data:`TRACER` armed, the default sink routes ticks
+    onto the event stream as ``progress.tick`` instants instead of
+    writing bare ``-`` to stderr — a ``--trace``/``--metrics`` run keeps
+    machine-readable output clean while still recording cadence."""
 
     DEFAULT_CADENCE = 500 << 20
 
@@ -191,6 +763,13 @@ class Progress:
 
     @staticmethod
     def _default_sink(progress: "Progress") -> None:
+        if TRACER.armed:
+            TRACER.instant(
+                "progress.tick",
+                "progress",
+                {"done": progress.done, "total": progress.total},
+            )
+            return
         sys.stderr.write("-")
         sys.stderr.flush()
 

@@ -484,3 +484,50 @@ def test_cli_view_and_flagstat_one_shot(sorted_bam, tmp_path, capsys):
     printed = json.loads(capsys.readouterr().out)
     assert printed == expect_fs
     assert printed["total"] == 240
+
+
+def test_daemon_latency_histograms_gauges_and_prometheus(
+    sorted_bam, tmp_path
+):
+    """The observability surface of the daemon: per-op latency
+    histograms (p50/p95/p99) in ``stats``, live arena/cache/queue/job
+    gauges, and a ``metrics`` op emitting parseable Prometheus text."""
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        for _ in range(3):
+            client.view(sorted_bam, "chr1:100000-300000", level=1)
+        stats = client.stats()
+        # Per-op latency histogram: three view observations with sane
+        # percentile ordering out of the log2 buckets.
+        h = stats["metrics"]["histograms"]["serve.op.view.ms"]
+        assert h["count"] >= 3
+        assert 0 < h["p50"] <= h["p95"] <= h["p99"]
+        assert sum(h["buckets"].values()) == h["count"]
+        # Gauges: arena holds the decoded window, cache the header/index,
+        # the job pool and batcher queue are idle.
+        g = stats["gauges"]
+        assert g["serve.arena.entries"] >= 1
+        assert g["serve.arena.used_bytes"] > 0
+        assert g["serve.cache.entries"] >= 1
+        assert g["serve.jobs.running"] == 0
+        assert g["serve.batch.queue_depth"] == 0
+        assert g["serve.jobs.max_inflight"] == d.max_inflight
+        # The stats metrics block is a daemon-lifetime delta (snapshot/
+        # delta, never reset()): counters are this daemon's traffic.
+        assert stats["metrics"]["counters"]["serve.op.view"] >= 3
+        # Prometheus text exposition parses: counter lines, histogram
+        # bucket/sum/count triplet, gauges — every sample line is
+        # "name[{labels}] value".
+        text = client.metrics()
+        assert "hbam_serve_op_view_total" in text
+        assert 'hbam_serve_op_view_ms_bucket{le="+Inf"}' in text
+        assert "hbam_serve_op_view_ms_sum" in text
+        assert "hbam_serve_arena_used_bytes" in text
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name, value = line.rsplit(" ", 1)
+            assert name and float(value) >= 0
+    finally:
+        client.shutdown()
+        t.join(timeout=20)

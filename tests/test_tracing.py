@@ -1,18 +1,51 @@
-"""Observability tests: spans, counters, progress cadence, pipeline wiring
-(the replacement for the reference's deprecated util/Timer.java and the
-500MB progress ticks of SplittingBAMIndexer.java:277-282)."""
+"""Observability tests: spans, counters, histograms, the timeline tracer
+(ring buffer + Chrome trace export), stall attribution via
+tools/trace_report.py, run provenance, and the bench round's ``degraded``
+contract (the replacement for the reference's deprecated util/Timer.java
+and the 500MB progress ticks of SplittingBAMIndexer.java:277-282)."""
 
+import importlib.util
 import io
+import json
+import pathlib
+import re
+import subprocess
+import sys
 import threading
 
 import numpy as np
+import pytest
 
 from hadoop_bam_tpu.utils.tracing import (
+    METRIC_NAME_PATTERN,
     METRICS,
+    Histogram,
     MetricsRegistry,
     Progress,
+    TRACER,
+    Tracer,
+    prometheus_text,
+    run_manifest,
     span,
+    trace_ctx,
 )
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_module(path: pathlib.Path, name: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def trace_report_mod():
+    return _load_module(REPO / "tools" / "trace_report.py", "trace_report")
+
+
+def bench_mod():
+    return _load_module(REPO / "bench.py", "bench_under_test")
 
 
 def test_span_accumulates():
@@ -78,3 +111,383 @@ def test_pipeline_emits_metrics(tmp_path):
     for phase in ("sort_bam.plan", "sort_bam.read", "sort_bam.device_sort",
                   "sort_bam.write_merge"):
         assert rep["span_counts"][phase] == 1, phase
+
+
+# ---------------------------------------------------------------------------
+# Histograms: fixed log2 buckets → percentiles without unbounded memory.
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_placement():
+    h = Histogram()
+    for v in (0.5, 1.0, 3.0, 3.0, 3.0, 100.0):
+        h.observe(v)
+    d = h.as_dict()
+    # 0.5 and 1.0 land in bucket (…, 1]; the 3s in (2, 4]; 100 in (64, 128].
+    assert d["buckets"] == {"1.0": 2, "4.0": 3, "128.0": 1}
+    assert d["count"] == 6
+    assert d["sum"] == 110.5
+    # Exact powers of two belong to their own bucket's upper bound.
+    h2 = Histogram()
+    h2.observe(2.0)
+    h2.observe(2.1)
+    assert h2.as_dict()["buckets"] == {"2.0": 1, "4.0": 1}
+
+
+def test_histogram_percentiles():
+    h = Histogram()
+    for v in (0.5, 1.0, 3.0, 3.0, 3.0, 100.0):
+        h.observe(v)
+    # rank(p50, n=6) = 3 → the (2, 4] bucket; p95/p99 → rank 6 → (64, 128].
+    assert h.percentile(0.50) == 4.0
+    assert h.percentile(0.95) == 128.0
+    assert h.percentile(0.99) == 128.0
+    assert Histogram().percentile(0.99) == 0.0
+
+
+def test_registry_observe_and_delta():
+    from hadoop_bam_tpu.utils.tracing import delta, snapshot
+
+    reg = MetricsRegistry()
+    before = snapshot(reg)
+    reg.observe("op.latency_ms", 3.0)
+    reg.observe("op.latency_ms", 900.0)
+    rep = reg.report()
+    assert rep["histograms"]["op.latency_ms"]["count"] == 2
+    assert rep["histograms"]["op.latency_ms"]["p99"] == 1024.0
+    d = delta(before, snapshot(reg))
+    assert d["histograms"]["op.latency_ms"]["count"] == 2
+    assert d["histograms"]["op.latency_ms"]["sum"] == 903.0
+
+
+# ---------------------------------------------------------------------------
+# Timeline tracer: ring buffer, overflow, export schema, disarmed contract.
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_disarmed_contract():
+    """Tracing off ⇒ no ring-buffer allocation and span() still only does
+    its cumulative-registry work (the fault-seam stance: a disarmed
+    observability layer costs one attribute check)."""
+    t = Tracer()
+    assert not t.armed and t._ring is None
+    reg = MetricsRegistry()
+    assert not TRACER.armed, "global tracer must be disarmed between tests"
+    with span("contract.check", reg):
+        pass
+    assert TRACER._ring is None  # span() did not allocate anything
+    assert TRACER.events() == []
+    assert reg.report()["span_counts"]["contract.check"] == 1
+
+
+def test_ring_buffer_overflow_drops_oldest_counters_intact():
+    reg = MetricsRegistry()
+    TRACER.start(capacity=16)
+    try:
+        for i in range(40):
+            with span(f"ring.ev_{i:02d}", reg):
+                pass
+        evs = TRACER.events()
+        assert len(evs) == 16
+        assert TRACER.dropped_events == 24
+        # Oldest dropped: the survivors are exactly the last 16 emits.
+        names = [e[0] for e in evs]
+        assert names == [f"ring.ev_{i:02d}" for i in range(24, 40)]
+        # The cumulative registry never loses anything to ring overflow.
+        assert sum(reg.report()["span_counts"].values()) == 40
+    finally:
+        TRACER.stop()
+    assert TRACER._ring is None  # stop() frees the ring
+
+
+def test_trace_export_chrome_schema():
+    TRACER.start(capacity=64)
+    try:
+        with trace_ctx(split=3):
+            with span("schema.stage_a", category="stage"):
+                pass
+        TRACER.instant("schema.marker", "xfer", {"bytes": 10})
+        buf = io.StringIO()
+        n = TRACER.export_chrome(buf)
+    finally:
+        TRACER.stop()
+    doc = json.loads(buf.getvalue())
+    evs = doc["traceEvents"]
+    assert n == len(evs) == 2
+    for e in evs:
+        for k in ("ts", "dur", "ph", "name", "tid", "pid", "cat"):
+            assert k in e, f"event missing {k}: {e}"
+        assert e["ph"] == "X"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    stage = next(e for e in evs if e["cat"] == "stage")
+    assert stage["args"]["split"] == 3  # ambient trace_ctx rode along
+    assert doc["otherData"]["dropped_events"] == 0
+
+
+def test_progress_routes_through_tracer(capsys):
+    TRACER.start(capacity=32)
+    try:
+        p = Progress(total_bytes=100, cadence=10)  # default sink
+        p.advance(25)
+        ticks = [e for e in TRACER.events() if e[0] == "progress.tick"]
+        assert len(ticks) == 1
+        assert ticks[0][5]["done"] == 25
+    finally:
+        TRACER.stop()
+    assert capsys.readouterr().err == ""  # no bare '-' on stderr
+    # Disarmed: the default sink writes the reference's '-' tick again.
+    p = Progress(total_bytes=100, cadence=10)
+    p.advance(25)
+    assert capsys.readouterr().err == "-"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: sort --trace emits ordered per-split stage events.
+# ---------------------------------------------------------------------------
+
+
+def _mini_bam(tmp_path, n=800):
+    from hadoop_bam_tpu.spec import bam
+
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\n@SQ\tSN:c\tLN:100000", [("c", 100000)]
+    )
+    recs = [
+        bam.build_record(f"r{i}", 0, (97 * i) % 90000, 60, 0, [(10, "M")],
+                         "ACGTACGTAC", bytes([30] * 10))
+        for i in range(n)
+    ]
+    buf = io.BytesIO()
+    bam.write_bam(buf, hdr, iter(recs))
+    p = tmp_path / "m.bam"
+    p.write_bytes(buf.getvalue())
+    return str(p)
+
+
+def test_sort_trace_e2e_stage_events(tmp_path):
+    """A traced sort on a small fixture (tiny members, per the
+    interpret-mode test budget) produces valid Chrome JSON whose
+    per-split stage events appear in pipeline order, and the reducer
+    names a top stall."""
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    src = _mini_bam(tmp_path)
+    out = tmp_path / "sorted.bam"
+    trace = tmp_path / "t.json"
+    TRACER.start()
+    try:
+        sort_bam(src, str(out), split_size=8 << 10)
+        TRACER.export_chrome(str(trace))
+    finally:
+        TRACER.stop()
+    doc = json.loads(trace.read_text())
+    evs = doc["traceEvents"]
+    assert evs, "traced sort produced no events"
+    for e in evs:  # schema holds for every event
+        for k in ("ts", "dur", "ph", "name", "tid"):
+            assert k in e
+    stage_evs = [e for e in evs if e.get("cat") == "stage"]
+    splits = sorted(
+        {e["args"]["split"] for e in stage_evs
+         if "args" in e and "split" in e["args"]}
+    )
+    assert splits and splits[0] == 0
+    order = ["bam.stage.read", "bam.stage.inflate", "bam.stage.parse",
+             "bam.stage.key"]
+    for si in splits:
+        mine = {
+            e["name"]: e["ts"]
+            for e in stage_evs
+            if e.get("args", {}).get("split") == si
+            and e["name"] in order
+        }
+        assert set(mine) == set(order), f"split {si} missing stages"
+        ts = [mine[n] for n in order]
+        assert ts == sorted(ts), f"split {si} stages out of order: {mine}"
+    # Write-side stage events carry the part index.
+    assert any(
+        e.get("args", {}).get("part") == 0
+        for e in stage_evs
+        if e["name"].startswith("bam.stage.")
+    )
+    # The reducer closes the loop: busy/idle/overlap plus a named stall.
+    tr = trace_report_mod()
+    rep = tr.stage_report(tr.load_events(str(trace)))
+    assert rep is not None
+    assert rep["top_stall"]["stage"] in rep["stages"]
+    for s in rep["stages"].values():
+        assert 0.0 <= s["busy_frac"] <= 1.0 + 1e-9
+        assert 0.0 <= s["overlap_frac"] <= 1.0 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# tools/trace_report.py on the checked-in miniature fixture (tier-1 CI).
+# ---------------------------------------------------------------------------
+
+FIXTURE = REPO / "tests" / "data" / "mini_trace.json"
+
+
+def test_trace_report_fixture_reduction():
+    tr = trace_report_mod()
+    events = tr.load_events(str(FIXTURE))
+    rep = tr.stage_report(events)
+    assert rep["wall_ms"] == pytest.approx(12.0)
+    # Per-stage busy: the two inflate events union to 4 ms (they overlap
+    # the read-ahead window), deflate is a single 3.2 ms interval.
+    assert rep["stages"]["bam.stage.inflate"]["busy_ms"] == pytest.approx(4.0)
+    assert rep["stages"]["bam.stage.deflate"]["busy_ms"] == pytest.approx(3.2)
+    # 'item' wrappers and 'xfer' instants are excluded from attribution.
+    assert "pipeline.stage.read_split" not in rep["stages"]
+    assert "transfers.h2d" not in rep["stages"]
+    # The top stall is the deflate: largest exclusive (nothing-else-live)
+    # time, the same stage BENCH_NOTES ranks #1 on the 1-core host.
+    assert rep["top_stall"]["stage"] == "bam.stage.deflate"
+    assert rep["top_stall"]["exclusive_ms"] == pytest.approx(3.2)
+    # Overlap: inflate ran concurrently with read for 1 ms of its 4 ms.
+    assert rep["stages"]["bam.stage.inflate"]["overlap_frac"] == (
+        pytest.approx(0.25)
+    )
+    txt = tr.format_report(rep)
+    assert "top stall: bam.stage.deflate" in txt
+
+
+def test_trace_report_cli_runs():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(FIXTURE)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "top stall: bam.stage.deflate" in r.stdout
+    rj = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(FIXTURE), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert rj.returncode == 0
+    assert json.loads(rj.stdout)["top_stall"]["stage"] == "bam.stage.deflate"
+
+
+# ---------------------------------------------------------------------------
+# Run provenance: RunManifest + the bench round's degraded contract.
+# ---------------------------------------------------------------------------
+
+
+def test_run_manifest_collects_tiers_and_degradation():
+    counters = {
+        "flate.inflate.lanes": 10,
+        "flate.inflate.host": 2,
+        "bam.device_inflate_fallback": 1,
+        "salvage.members_quarantined": 3,
+        "unrelated.counter": 7,
+    }
+    m = run_manifest(backend="single-device", counters=counters)
+    d = m.as_dict()
+    assert d["backend"] == "single-device"
+    assert d["tier_decisions"]["flate.inflate.lanes"] == 10
+    assert "unrelated.counter" not in d["tier_decisions"]
+    assert d["degraded"] is True
+    joined = " ".join(d["reasons"])
+    assert "device inflate tier errored" in joined
+    assert "salvage mode quarantined" in joined
+    # A clean run is not degraded.
+    clean = run_manifest(backend="single-device", counters={})
+    assert clean.as_dict()["degraded"] is False
+    # Asked for device, ran host: degraded with the mismatch named.
+    mm = run_manifest(
+        backend="host", counters={}, requested="single-device"
+    )
+    assert mm.degraded and "requested backend" in mm.reasons[0]
+
+
+def test_bench_finalize_round_flags_cpu_fallback():
+    """The provenance acceptance: a faked CPU-fallback probe (the r4/r5
+    failure shape) must yield degraded: true with a readable reason in
+    the round JSON."""
+    bench = bench_mod()
+    base = {
+        "metric": "bam_sort_reads_per_sec", "value": 0,
+        "unit": "reads/s", "vs_baseline": 0.0, "platform": "cpu",
+    }
+    round_json = bench.finalize_round(
+        base, "auto", None,
+        "ambient backend probe failed twice (no diagnostics); "
+        "falling back to CPU",
+    )
+    assert round_json["degraded"] is True
+    assert "probe" in round_json["degraded_reason"]
+    assert round_json["probed_platform"] == "probe-failed"
+    assert round_json["error"].startswith("ambient backend probe")
+    # Probe saw a TPU but the measurement fell back to CPU.
+    r2 = bench.finalize_round(
+        dict(base), "auto", "tpu", "tpu run failed (rc=1); CPU fallback"
+    )
+    assert r2["degraded"] and "probe saw 'tpu'" in r2["degraded_reason"]
+    # A clean device round stays undegraded.
+    ok = bench.finalize_round(
+        {**base, "platform": "tpu", "value": 1000,
+         "run_manifest": {"degraded": False, "platform": "tpu"}},
+        "auto", "tpu", None,
+    )
+    assert ok["degraded"] is False and "degraded_reason" not in ok
+    # The round's own manifest knows the jax backend disagreed with the
+    # label: tier counters vs requested config.
+    lie = bench.finalize_round(
+        {**base, "platform": "tpu",
+         "run_manifest": {"degraded": False, "platform": "cpu"}},
+        "tpu", None, None,
+    )
+    assert lie["degraded"] and "initialized 'cpu'" in lie["degraded_reason"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + the metrics-namespace lint.
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.count("serve.op.view", 3)
+    reg.add_span("serve.view", 0.25)
+    reg.observe("serve.op.view.ms", 3.0)
+    reg.observe("serve.op.view.ms", 100.0)
+    txt = prometheus_text(reg.report(), gauges={"serve.arena.used_bytes": 42})
+    assert "hbam_serve_op_view_total 3" in txt
+    assert "hbam_serve_view_seconds_total 0.250000" in txt
+    assert 'hbam_serve_op_view_ms_bucket{le="4"} 1' in txt
+    assert 'hbam_serve_op_view_ms_bucket{le="128"} 2' in txt
+    assert 'hbam_serve_op_view_ms_bucket{le="+Inf"} 2' in txt
+    assert "hbam_serve_op_view_ms_count 2" in txt
+    assert "hbam_serve_arena_used_bytes 42" in txt
+    # Cumulative bucket counts parse monotonically.
+    les = [
+        float(m.group(1))
+        for m in re.finditer(r'_bucket\{le="([0-9.]+)"\}', txt)
+    ]
+    assert les == sorted(les)
+
+
+_NAME_CALL = re.compile(
+    r'(?:METRICS\.count|METRICS\.observe|[^.\w]span|_trace_stage'
+    r'|count_h2d|count_d2h)\(\s*\n?\s*(f?)"([^"]+)'
+)
+
+
+def test_metric_names_are_dotted_lowercase():
+    """Lint: every span()/counter/histogram name literal in the package
+    (and bench.py) matches ``METRIC_NAME_PATTERN`` — dotted lowercase,
+    ≥2 components — so the metrics namespace stays greppable.  F-string
+    placeholders are treated as a valid component."""
+    pat = re.compile(METRIC_NAME_PATTERN)
+    bad = []
+    files = sorted((REPO / "hadoop_bam_tpu").rglob("*.py"))
+    files.append(REPO / "bench.py")
+    for f in files:
+        src = f.read_text()
+        for m in _NAME_CALL.finditer(src):
+            is_f, name = m.group(1), m.group(2)
+            if is_f:
+                name = re.sub(r"\{[^}]*\}", "x0", name)
+            if not pat.match(name):
+                bad.append(f"{f.relative_to(REPO)}: {m.group(2)!r}")
+    assert not bad, "non-conforming metric names:\n" + "\n".join(bad)

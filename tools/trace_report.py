@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+"""Stall attribution over a Chrome trace-event JSON (``--trace`` output).
+
+The timeline tracer (hadoop_bam_tpu/utils/tracing.py) records every
+pipeline stage as a complete event (``ph: "X"``, ``cat: "stage"``) with
+per-item args (``split``/``part``).  This reducer turns that timeline
+into the numbers ROADMAP open item #1 needs as its before/after proof:
+
+- **busy**: per stage, the union length of its event intervals (a stage
+  running in two threads at once counts the wall once);
+- **idle**: the fraction of the trace wall that stage was NOT running;
+- **overlap**: the fraction of each stage's busy time during which at
+  least one OTHER stage was also running — a serialized pipeline scores
+  ~0, a well-double-buffered one approaches 1;
+- **top stall**: the stage with the largest *exclusive* busy time (busy
+  while nothing else ran) — the stage the pipeline is actually waiting
+  on, which is what double-buffering must hide next.
+
+Stdlib-only (no numpy/jax): runs anywhere a trace file exists, including
+tier-1 CI on the checked-in miniature fixture
+(tests/data/mini_trace.json).
+
+Usage:  python tools/trace_report.py TRACE.json [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+Interval = Tuple[float, float]
+
+
+def load_events(path_or_stream) -> List[dict]:
+    """Chrome trace-event JSON → the list of complete ('X') events.
+
+    Accepts both the object form (``{"traceEvents": [...]}``) the tracer
+    writes and the bare-array form some tools emit.
+    """
+    if hasattr(path_or_stream, "read"):
+        doc = json.load(path_or_stream)
+    else:
+        with open(path_or_stream) as f:
+            doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _merge(intervals: List[Interval]) -> List[Interval]:
+    """Sorted union of intervals (the busy set of one stage)."""
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [intervals[0]]
+    for lo, hi in intervals[1:]:
+        if lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _union_len(intervals: List[Interval]) -> float:
+    return sum(hi - lo for lo, hi in _merge(intervals))
+
+
+def _intersect_len(a: List[Interval], b: List[Interval]) -> float:
+    """Length of the intersection of two merged interval sets."""
+    a, b = _merge(a), _merge(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def stage_report(
+    events: List[dict], category: str = "stage"
+) -> Optional[dict]:
+    """Reduce stage events to per-stage busy/idle/overlap + the top stall.
+
+    Durations are in the trace's native microseconds; the report converts
+    to milliseconds.  Zero-duration events (transfer instants) contribute
+    counts but no busy time.  Returns None when the trace has no events
+    in ``category``.
+    """
+    by_stage: Dict[str, List[Interval]] = {}
+    n_events: Dict[str, int] = {}
+    items: Dict[str, set] = {}
+    t_min, t_max = float("inf"), float("-inf")
+    for e in events:
+        if e.get("cat") != category:
+            continue
+        name = e["name"]
+        t0 = float(e["ts"])
+        t1 = t0 + float(e.get("dur", 0.0))
+        by_stage.setdefault(name, []).append((t0, t1))
+        n_events[name] = n_events.get(name, 0) + 1
+        args = e.get("args") or {}
+        for k in ("split", "part"):
+            if k in args:
+                items.setdefault(name, set()).add((k, args[k]))
+        t_min = min(t_min, t0)
+        t_max = max(t_max, t1)
+    if not by_stage:
+        return None
+    wall = max(t_max - t_min, 1e-9)
+    merged = {k: _merge(v) for k, v in by_stage.items()}
+    any_other: Dict[str, List[Interval]] = {
+        k: _merge(
+            [iv for k2, ivs in merged.items() if k2 != k for iv in ivs]
+        )
+        for k in merged
+    }
+    stages = {}
+    for name, ivs in merged.items():
+        busy = _union_len(ivs)
+        ov = _intersect_len(ivs, any_other[name])
+        stages[name] = {
+            "events": n_events[name],
+            "items": len(items.get(name, ())),
+            "busy_ms": busy / 1e3,
+            "busy_frac": busy / wall,
+            "idle_frac": 1.0 - busy / wall,
+            "overlap_frac": (ov / busy) if busy > 0 else 0.0,
+            "exclusive_ms": (busy - ov) / 1e3,
+        }
+    # The top stall: the stage holding the wall hostage — largest busy
+    # time during which NO other stage ran.  That time is irreducible by
+    # overlap alone; it is what the next pipelining PR must attack.
+    top = max(stages.items(), key=lambda kv: kv[1]["exclusive_ms"])
+    # Pipeline-wide overlap: fraction of covered time with ≥2 stages live.
+    all_ivs = [iv for ivs in merged.values() for iv in ivs]
+    covered = _union_len(all_ivs)
+    pairwise = sum(
+        _intersect_len(merged[k], any_other[k]) for k in merged
+    )
+    # Each multi-stage moment is counted once per live stage; ≥2-live
+    # time is bounded by pairwise/2 — report the conservative bound.
+    multi = min(covered, pairwise / 2.0)
+    return {
+        "wall_ms": wall / 1e3,
+        "covered_ms": covered / 1e3,
+        "overlap_frac": (multi / covered) if covered > 0 else 0.0,
+        "stages": stages,
+        "top_stall": {
+            "stage": top[0],
+            "exclusive_ms": top[1]["exclusive_ms"],
+            "busy_frac": top[1]["busy_frac"],
+        },
+    }
+
+
+def format_report(rep: dict) -> str:
+    lines = [
+        f"trace wall: {rep['wall_ms']:.3f} ms  "
+        f"(stage-covered {rep['covered_ms']:.3f} ms, "
+        f"pipeline overlap {rep['overlap_frac']:.1%})",
+        "",
+        f"{'stage':<34} {'events':>6} {'items':>5} {'busy ms':>10} "
+        f"{'busy':>6} {'idle':>6} {'ovlp':>6} {'excl ms':>10}",
+    ]
+    for name in sorted(
+        rep["stages"], key=lambda k: -rep["stages"][k]["busy_ms"]
+    ):
+        s = rep["stages"][name]
+        lines.append(
+            f"{name:<34} {s['events']:>6} {s['items']:>5} "
+            f"{s['busy_ms']:>10.3f} {s['busy_frac']:>6.1%} "
+            f"{s['idle_frac']:>6.1%} {s['overlap_frac']:>6.1%} "
+            f"{s['exclusive_ms']:>10.3f}"
+        )
+    t = rep["top_stall"]
+    lines.append("")
+    lines.append(
+        f"top stall: {t['stage']} — {t['exclusive_ms']:.3f} ms exclusive "
+        f"({t['busy_frac']:.1%} of wall busy)"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage busy/idle/overlap + top stall from a "
+        "--trace Chrome trace-event JSON"
+    )
+    ap.add_argument("trace", help="trace file (sort --trace out.json)")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the reduced report as JSON instead of the table",
+    )
+    ap.add_argument(
+        "--category", default="stage",
+        help="event category to attribute (default: stage)",
+    )
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    rep = stage_report(events, category=args.category)
+    if rep is None:
+        print(
+            f"no {args.category!r} events in {args.trace} "
+            "(was the run traced with --trace?)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print(format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
