@@ -42,8 +42,13 @@ def _reg2bin_np(beg: np.ndarray, end: np.ndarray) -> np.ndarray:
     return out
 
 
-def synth_bam(path: str, n: int) -> None:
-    """Vectorized synthetic BAM: one template record patched per row."""
+def synth_bam(path: str, n: int, paired: bool = False) -> None:
+    """Vectorized synthetic BAM: one template record patched per row.
+
+    ``paired`` gives consecutive rows the same read name with
+    FIRST/SECOND-of-pair flags — the collation bench corpus (n//2
+    mates to pair); default rows carry unique names (every paired-flag
+    record an orphan), as the sort benches always had."""
     from hadoop_bam_tpu import native
     from hadoop_bam_tpu.spec import bam, bgzf
 
@@ -82,13 +87,23 @@ def synth_bam(path: str, n: int) -> None:
     bins = _reg2bin_np(pos.astype(np.int64), pos.astype(np.int64) + 100)
     stream[base + 4 + 10] = (bins & 0xFF).astype(np.uint8)
     stream[base + 4 + 11] = (bins >> 8).astype(np.uint8)
-    # Unique read names: 8 hex chars at offset 36+1 (vectorized hex).
+    # Read names: 8 hex chars at offset 36+1 (vectorized hex) — unique
+    # per row, or per pair of rows in ``paired`` mode.
     idx = np.arange(n, dtype=np.int64)
+    name_id = idx >> 1 if paired else idx
     for k in range(8):
-        d = (idx >> (4 * (7 - k))) & 0xF
+        d = (name_id >> (4 * (7 - k))) & 0xF
         stream[base + 4 + 33 + k] = np.where(d < 10, 48 + d, 87 + d).astype(
             np.uint8
         )
+    if paired:
+        flags = np.where(
+            idx % 2 == 0,
+            bam.FLAG_PAIRED | bam.FLAG_FIRST_OF_PAIR,
+            bam.FLAG_PAIRED | bam.FLAG_SECOND_OF_PAIR,
+        ).astype(np.int64)
+        stream[base + 4 + 14] = (flags & 0xFF).astype(np.uint8)
+        stream[base + 4 + 15] = (flags >> 8).astype(np.uint8)
     with open(path, "wb") as f:
         buf = io.BytesIO()
         w = bgzf.BgzfWriter(buf, level=1, append_terminator=False)
@@ -185,6 +200,30 @@ def _measure(platform: str) -> dict:
         out["markdup_marginal_cost"] = round(t_md / t_device, 3)
     except Exception as e:  # never fail the headline for a diagnostic
         out["markdup_error"] = str(e)[:120]
+    # Secondary diagnostic: the collation workloads (PR 9).  Fixmate on
+    # a same-sized *paired* corpus: ``collate_pairs_per_sec`` is mates
+    # paired per second of fixmate wall (the engine's throughput —
+    # device grouping + host verification + edit plan + stream rebuild),
+    # and ``fixmate_marginal_cost`` is fixmate wall over the plain
+    # device-sort wall on the same record count/geometry (how much a
+    # fixmate pass costs relative to the pipeline it rides beside).
+    try:
+        from hadoop_bam_tpu.pipeline import fixmate_bam
+
+        src_p = os.path.join(tmp, "bench_paired.bam")
+        synth_bam(src_p, N_RECORDS, paired=True)
+        out_fm = os.path.join(tmp, "fixmate.bam")
+        fixmate_bam([src_p], out_fm, split_size=SPLIT_SIZE, level=1)
+        t0 = time.time()
+        st_fm = fixmate_bam(
+            [src_p], out_fm, split_size=SPLIT_SIZE, level=1
+        )
+        t_fm = time.time() - t0
+        assert st_fm.n_pairs == N_RECORDS // 2, "collation incomplete"
+        out["collate_pairs_per_sec"] = round(st_fm.n_pairs / t_fm)
+        out["fixmate_marginal_cost"] = round(t_fm / t_device, 3)
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["fixmate_error"] = str(e)[:120]
     if platform == "tpu":
         # Secondary diagnostic: the device-resident parse mode, forced on
         # regardless of the topology auto rule (on a remote tunnel its

@@ -187,6 +187,7 @@ def _export_trace(args) -> None:
 def _cmd_sort(args, mark_duplicates: bool = False) -> int:
     from .conf import (
         BAM_MARK_DUPLICATES,
+        BAM_SORT_ORDER,
         BAM_WRITE_SPLITTING_BAI,
         DEFLATE_LANES,
         INFLATE_LANES,
@@ -197,6 +198,10 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
 
     conf = Configuration()
     _apply_robustness_args(conf, args)
+    sort_order = (
+        "queryname" if getattr(args, "queryname", False) else "coordinate"
+    )
+    conf.set(BAM_SORT_ORDER, sort_order)
     if args.write_splitting_bai:
         conf.set_boolean(BAM_WRITE_SPLITTING_BAI, True)
     # Device codec toggles: unset leaves the conf key absent, deferring to
@@ -243,6 +248,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
             write_splitting_bai=args.write_splitting_bai,
             memory_budget=args.memory_budget,
             part_dir=args.part_dir,
+            sort_order=sort_order,
         )
     if traced:
         _export_trace(args)
@@ -290,6 +296,50 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
 
 def _cmd_markdup(args) -> int:
     return _cmd_sort(args, mark_duplicates=True)
+
+
+def _cmd_fixmate(args) -> int:
+    """Fill mate coordinates/flags/TLEN/MC from collated pairs,
+    preserving record order (the samtools-fixmate role, on any input
+    order — the collation engine pairs mates by name)."""
+    from .conf import Configuration
+    from .pipeline import fixmate_bam
+
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    traced = _arm_trace(args, conf)
+    from .utils.tracing import delta, snapshot
+
+    before = snapshot() if args.metrics else None
+    stats = fixmate_bam(
+        list(args.bam),
+        args.output,
+        conf=conf,
+        split_size=args.split_size,
+        level=args.level,
+        memory_budget=args.memory_budget,
+        part_dir=args.part_dir,
+    )
+    if traced:
+        _export_trace(args)
+    print(
+        f"{args.output}: {stats.n_records} records from {stats.n_splits} "
+        f"splits via {stats.backend}: {stats.n_pairs} pairs fixed, "
+        f"{stats.n_singletons} singletons, {stats.n_orphans} orphans"
+    )
+    if args.metrics:
+        import json
+
+        from .utils.tracing import run_manifest
+
+        report = delta(before)
+        report["run_manifest"] = run_manifest(
+            backend=stats.backend,
+            conf=conf,
+            counters=report["counters"],
+        ).as_dict()
+        print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
 
 
 def _cmd_view(args) -> int:
@@ -503,6 +553,13 @@ def build_parser() -> argparse.ArgumentParser:
                  "from HBM; hadoopbam.write.device, default: auto rule)")
         if not markdup:
             s.add_argument(
+                "-n", "--queryname", action="store_true",
+                help="sort by read name (samtools natural order) instead "
+                     "of coordinates: the collation engine groups records "
+                     "by name hash on device and ranks the verified "
+                     "buckets with the exact strnum_cmp comparator; the "
+                     "output header says SO:queryname")
+            s.add_argument(
                 "--mark-duplicates", action="store_true",
                 help="fuse samtools-class duplicate marking into the sort "
                      "(OR 0x400 into duplicates' flags at write time)")
@@ -530,6 +587,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_sort_args(s, markdup=True)
     s.set_defaults(func=_cmd_markdup)
+
+    s = sub.add_parser(
+        "fixmate",
+        help="fill mate coordinates, mate flags, TLEN and MC tags from "
+             "collated pairs, preserving record order (samtools fixmate "
+             "semantics; any input order — mates pair by name collation)",
+    )
+    s.add_argument("bam", nargs="+")
+    s.add_argument("-o", "--output", required=True)
+    s.add_argument("--split-size", type=int, default=32 << 20)
+    s.add_argument("--level", type=int, default=6)
+    s.add_argument(
+        "--memory-budget", type=_parse_size, default=None, metavar="BYTES",
+        help="bounded-memory fixmate: pass B re-reads splits instead of "
+             "retaining them (accepts k/m/g suffixes)")
+    s.add_argument(
+        "--part-dir", default=None, metavar="DIR",
+        help="persistent part directory: finished parts are crash-restart "
+             "checkpoints, as for sort")
+    s.add_argument("--metrics", action="store_true",
+                   help="print the span/counter report after the run "
+                        "(collate.pairs/singletons/orphans, fixmate.* "
+                        "counters, run manifest)")
+    _add_trace_arg(s)
+    _add_robustness_args(s)
+    s.set_defaults(func=_cmd_fixmate)
 
     s = sub.add_parser(
         "view",

@@ -1,0 +1,84 @@
+"""The name-collation engine: one device primitive, a family of
+biobambam-class workloads (ROADMAP item 3).
+
+The dedup subsystem proved the shape — 64-bit murmur3 read-name hashes
+collated with one ``lax.sort`` pass, content tie-breaks making every
+decision input-order-free.  This package generalizes that pass into a
+standalone primitive (:mod:`.device`) and builds three workloads on it,
+all sharing the existing residency and part-write path:
+
+- **Queryname sort** — ``sort -n`` / ``pipeline.sort_bam(...,
+  sort_order="queryname")``: the chip groups records by name hash, the
+  host ranks the (verified-distinct) bucket representative names with
+  the exact samtools ``strnum_cmp`` natural comparator (:mod:`.host`),
+  and one ``lexsort`` with the flag → position → index tie-breaks
+  finishes.  ``SO:queryname`` is stamped in the output header.
+- **Fixmate** — ``pipeline.fixmate_bam`` / the ``fixmate`` subcommand:
+  mate coordinates, mate-unmapped/reverse flags, TLEN (the samtools
+  5′-to-5′ rule) and MC mate-CIGAR tags filled from collated pairs
+  (:mod:`.fixmate`), patched into a fresh gathered stream at write time
+  — source payloads never mutate, the markdup flag-patch stance.
+- **Markdup on unsorted input** — :mod:`dedup.device` pass 1 now *is*
+  this engine's core (``collate_core``), so duplicate marking accepts
+  queryname-grouped or shuffled input and elects identical winners;
+  :mod:`dedup.oracle` remains the record-identical verification.
+
+Collation is collision-safe, not collision-oblivious: hash buckets are
+verified against actual name bytes on the host before any decision
+trusts them (:func:`.host.verify_and_repair`), and the independent
+oracles (:mod:`.oracle`) group by real names only.
+"""
+
+from .device import Collation, collate_by_name, collate_core
+from .fixmate import (
+    FIXMATE_FIELDS,
+    FixmateEdits,
+    apply_fixmate,
+    compute_fixmate_edits,
+)
+from .host import (
+    QuerynameStats,
+    collation_counts,
+    natural_compare,
+    natural_sort_key,
+    queryname_perm,
+    verify_and_repair,
+)
+from .oracle import (
+    collate_oracle,
+    fixmate_oracle,
+    mc_tag_of,
+    queryname_sort_oracle,
+)
+from .signature import (
+    COLLATE_EXTRA_FIELDS,
+    QNAME_SEED2,
+    collation_columns,
+    concat_collation,
+    name_hash_pair,
+)
+
+__all__ = [
+    "COLLATE_EXTRA_FIELDS",
+    "Collation",
+    "FIXMATE_FIELDS",
+    "FixmateEdits",
+    "QNAME_SEED2",
+    "QuerynameStats",
+    "apply_fixmate",
+    "collate_by_name",
+    "collate_core",
+    "collate_oracle",
+    "collation_columns",
+    "collation_counts",
+    "compute_fixmate_edits",
+    "concat_collation",
+    "fixmate_oracle",
+    "mc_tag_of",
+    "name_hash_pair",
+    "natural_compare",
+    "natural_sort_key",
+    "queryname_perm",
+    "queryname_sort_oracle",
+    "verify_and_repair",
+]

@@ -1,0 +1,203 @@
+"""The on-device name-collation primitive.
+
+One ``lax.sort`` pass groups the whole record stream by its 64-bit
+read-name hash, with *content* tie-breaks (candidate-first, then flag →
+position → read index) so the collated order is a pure function of the
+record multiset — shuffling the input cannot change any decision built
+on top.  This is the generalization of the dedup subsystem's pass-1
+pair collation (ROADMAP item 3): :mod:`dedup.device` now builds on the
+same core, and queryname sort / fixmate / markdup-on-unsorted all share
+it.
+
+Everything is int32 (TPU-native lanes, no x64 dependence) and padded to
+the next power of two by the public wrapper so only O(log N) program
+shapes ever compile — the :mod:`dedup.device` stance, verbatim.
+
+The core's outputs live in *collated* (sorted) space: the permutation,
+segment ids over hash-equal runs of active rows, per-segment active and
+candidate counts, and — for segments holding exactly two candidates —
+the neighbor exchange index that makes the two mates see each other.
+Hash buckets are only probabilistically name groups; every consumer
+runs the host verification pass (:func:`collate.host.verify_buckets`)
+over the actual name bytes before trusting a bucket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_I32MAX = np.int32(2**31 - 1)
+
+
+def _prev(a: jax.Array) -> jax.Array:
+    """Row i-1's value at row i (row 0 sees itself; callers force the
+    first boundary explicitly)."""
+    return jnp.concatenate([a[:1], a[:-1]])
+
+
+def collate_core(
+    act: jax.Array,
+    qh1: jax.Array,
+    qh2: jax.Array,
+    cand: jax.Array,
+    tie1: jax.Array,
+    tie2: jax.Array,
+) -> Tuple[jax.Array, ...]:
+    """The shared collation sort (call under jit; all int32[N]).
+
+    Sort keys: ``(1-act, qh1, qh2, 1-cand, tie1, tie2, idx)`` — active
+    rows first, grouped by the 64-bit hash, candidates leading their
+    group, content tie-breaks, original index last for totality.
+
+    Returns collated-space arrays ``(order, seg, size, csize, mated,
+    nb)``: ``order`` (original index per collated row), ``seg``
+    (hash-run segment id; inactive rows are singleton segments),
+    ``size``/``csize`` (active / candidate rows in the row's segment),
+    ``mated`` (bool: this row is one of a segment's exactly-2
+    candidates), ``nb`` (the mate's collated-space row for mated rows;
+    clipped self-ish elsewhere — gate every use on ``mated``).
+    """
+    n = act.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    zeros = jnp.zeros(n, jnp.int32)
+    srt = lax.sort(
+        (1 - act, qh1, qh2, 1 - cand, tie1, tie2, idx), num_keys=7
+    )
+    order = srt[6]
+    acts = act[order]
+    cands = cand[order]
+    qh1s, qh2s = qh1[order], qh2[order]
+    same = (
+        (acts & _prev(acts)).astype(bool)
+        & (qh1s == _prev(qh1s))
+        & (qh2s == _prev(qh2s))
+    )
+    same = same.at[0].set(False)
+    seg = jnp.cumsum(jnp.where(same, 0, 1)) - 1
+    size = zeros.at[seg].add(acts)[seg]
+    csize = zeros.at[seg].add(cands)[seg]
+    # Candidates sort first within their segment, so the candidate rank
+    # is the offset from the segment start; a 2-candidate segment's
+    # mates sit at ranks 0 and 1 — adjacent rows.
+    start = jnp.full(n, _I32MAX, jnp.int32).at[seg].min(idx)[seg]
+    crank = idx - start
+    mated = (cands == 1) & (csize == 2)
+    nb = jnp.clip(jnp.where(crank == 0, idx + 1, idx - 1), 0, n - 1)
+    return order, seg, size, csize, mated, nb
+
+
+@jax.jit
+def _collate_padded(act, qh1, qh2, cand, tie1, tie2):
+    return collate_core(act, qh1, qh2, cand, tie1, tie2)
+
+
+@dataclass
+class Collation:
+    """The host-side view of one collation pass.
+
+    ``order``/``group`` cover the *active* rows only, in collated order:
+    ``order[j]`` is the original index of collated row j and
+    ``group[j]`` its dense hash-bucket id (buckets are contiguous runs).
+    ``mate`` is read-order over all N rows: the mate's original index
+    for rows collated into an exactly-two-candidate bucket, else -1.
+    """
+
+    order: np.ndarray  # int64[n_active]
+    group: np.ndarray  # int32[n_active], dense 0..n_groups-1
+    n_groups: int
+    mate: np.ndarray  # int32[N] read order, -1 = no mate
+    n_pairs: int
+
+    def bucket_bounds(self) -> np.ndarray:
+        """int64[n_groups+1] — collated-row bounds of each bucket."""
+        if len(self.group) == 0:
+            return np.zeros(1, dtype=np.int64)
+        starts = np.flatnonzero(
+            np.concatenate(([True], self.group[1:] != self.group[:-1]))
+        )
+        return np.concatenate((starts, [len(self.group)])).astype(np.int64)
+
+
+def collate_by_name(
+    cols: Dict[str, np.ndarray],
+    active: Optional[np.ndarray] = None,
+    candidates: Optional[np.ndarray] = None,
+) -> Collation:
+    """Run the device collation over read-order columns.
+
+    ``cols`` needs ``qh1``/``qh2``/``flag``/``pos``.  ``active`` selects
+    the rows to group (default: all); ``candidates`` the subset eligible
+    for mate pairing (default: ``cols['cand']`` if present, else
+    ``active``).  Rows are padded to the next power of two as inactive,
+    so only O(log N) program shapes compile.
+    """
+    n = len(cols["qh1"])
+    if n == 0:
+        return Collation(
+            order=np.empty(0, np.int64),
+            group=np.empty(0, np.int32),
+            n_groups=0,
+            mate=np.empty(0, np.int32),
+            n_pairs=0,
+        )
+    act = (
+        np.ones(n, np.int32)
+        if active is None
+        else np.asarray(active, np.int32)
+    )
+    if candidates is None:
+        cand = cols.get("cand")
+        cand = act.copy() if cand is None else np.asarray(cand, np.int32)
+    else:
+        cand = np.asarray(candidates, np.int32)
+    cand = cand & act  # a candidate outside the active set is meaningless
+    padded = 1 << max(3, int(np.ceil(np.log2(n))))
+
+    def pad(a, fill=0):
+        out = np.full(padded, fill, dtype=np.int32)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    order_d, seg_d, _, _, mated_d, nb_d = _collate_padded(
+        pad(act),
+        pad(cols["qh1"]),
+        pad(cols["qh2"]),
+        pad(cand),
+        pad(cols["flag"]),
+        pad(cols["pos"]),
+    )
+    order = np.asarray(order_d, dtype=np.int64)
+    seg = np.asarray(seg_d)
+    mated = np.asarray(mated_d)
+    nb = np.asarray(nb_d)
+
+    # Active rows form the collated prefix… of the *active-sorted* order;
+    # inactive real rows and padding interleave in the tail.  Mask by the
+    # original activity column.
+    act_rows = act[np.clip(order, 0, n - 1)].astype(bool) & (order < n)
+    order_a = order[act_rows]
+    seg_a = seg[act_rows]
+    group = (
+        np.cumsum(
+            np.concatenate(([0], (seg_a[1:] != seg_a[:-1]).astype(np.int32)))
+        )
+        if len(seg_a)
+        else np.empty(0, np.int64)
+    ).astype(np.int32)
+    mate = np.full(n, -1, dtype=np.int32)
+    m_rows = np.flatnonzero(mated)
+    if len(m_rows):
+        mate[order[m_rows]] = order[nb[m_rows]]
+    return Collation(
+        order=order_a,
+        group=group,
+        n_groups=int(group[-1]) + 1 if len(group) else 0,
+        mate=mate,
+        n_pairs=len(m_rows) // 2,
+    )

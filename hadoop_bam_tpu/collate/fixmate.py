@@ -1,0 +1,331 @@
+"""Fixmate: fill mate coordinates, mate flags, TLEN and MC tags from
+collated pairs.
+
+samtools-fixmate-class semantics (bam_mate.c), computed over the
+engine's collation instead of requiring name-grouped input:
+
+- **Pairing** — primary paired records (not secondary/supplementary;
+  unmapped included) collate by the 64-bit name hash; exactly two
+  candidates under one verified name are mates.  Orphans (no mate in
+  the input) and singletons pass through untouched.
+- **Mate fields** — each mate's ``next_refid``/``next_pos`` become the
+  other's (post-placement) ``refid``/``pos``; ``FLAG_MATE_UNMAPPED``
+  and ``FLAG_MATE_REVERSE`` are set *and cleared* from the mate's
+  actual flags.
+- **Placement** — an unmapped read with a mapped mate adopts the mate's
+  ``refid``/``pos`` (and a recomputed single-base ``bin``) so the pair
+  travels together, as samtools does before its mate sync.
+- **TLEN** — the samtools 5′-to-5′ rule: ``own5 = endpos if reverse
+  else pos`` (``endpos = pos + max(ref_span, 1)``); each mate gets
+  ``mate5 - own5`` when both are mapped to the same reference, else 0.
+- **MC** — the mate's CIGAR string as an ``MC:Z`` tag when the mate is
+  mapped with a non-empty CIGAR; an existing MC tag is spliced out
+  first, so re-running fixmate is byte-idempotent.
+
+The decision pass is vectorized over the job-global collation columns;
+records are rewritten only at write time, per part, into a fresh
+gathered stream (:func:`io.bam.rebuild_record_stream`) — source
+payloads never mutate, the markdup flag-patch stance.
+
+Deviations from samtools (documented in the README): proper-pair (0x2)
+recomputation and the ``-m`` mate-score (``ms``) tag are not
+implemented.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..spec.bam import (
+    CIGAR_OPS,
+    FLAG_MATE_REVERSE,
+    FLAG_MATE_UNMAPPED,
+    FLAG_REVERSE,
+    FLAG_UNMAPPED,
+)
+from ..utils.tracing import METRICS, span
+from .device import Collation
+from .host import collation_counts
+
+#: The SoA fields a fixmate read needs (pass A computes columns from
+#: them; pass B's tag splice recomputes the tag-region offset).
+FIXMATE_FIELDS = (
+    "refid", "pos", "flag", "rec_off", "rec_len",
+    "l_read_name", "n_cigar_op", "l_seq",
+)
+
+
+@dataclass
+class FixmateEdits:
+    """Read-order edit plan over the whole job (row == global record
+    index).  Field arrays are valid where ``mask``; ``place`` marks the
+    unmapped-placed subset whose ``refid``/``pos``/``bin`` also change.
+    ``mc_*`` address the packed MC-tag blob (len 0 = no tag)."""
+
+    mask: np.ndarray  # bool[N]
+    place: np.ndarray  # bool[N]
+    flag: np.ndarray  # int32[N]
+    refid: np.ndarray
+    pos: np.ndarray
+    bin: np.ndarray
+    next_refid: np.ndarray
+    next_pos: np.ndarray
+    tlen: np.ndarray
+    mc: np.ndarray  # uint8 blob
+    mc_off: np.ndarray  # int64[N]
+    mc_len: np.ndarray  # int32[N]
+    counts: Dict[str, int]
+
+    @property
+    def n(self) -> int:
+        return len(self.mask)
+
+
+def _cigar_string(cigs: np.ndarray, off: int, n_ops: int) -> str:
+    u32 = cigs[off : off + 4 * n_ops].view("<u4")
+    return "".join(
+        f"{int(c) >> 4}{CIGAR_OPS[int(c) & 0xF]}" for c in u32
+    )
+
+
+def compute_fixmate_edits(
+    cols: Dict[str, np.ndarray], col: Collation
+) -> FixmateEdits:
+    """The vectorized decision pass: one edit plan from the job-global
+    collation columns and the verified mate index."""
+    n = len(cols["flag"])
+    if n == 0:
+        z32 = np.empty(0, np.int32)
+        return FixmateEdits(
+            mask=np.empty(0, bool), place=np.empty(0, bool),
+            flag=z32, refid=z32, pos=z32, bin=z32, next_refid=z32,
+            next_pos=z32, tlen=z32, mc=np.empty(0, np.uint8),
+            mc_off=np.empty(0, np.int64), mc_len=z32,
+            counts={"pairs": 0, "singletons": 0, "orphans": 0},
+        )
+    flag = cols["flag"].astype(np.int32)
+    refid = cols["refid"].astype(np.int32)
+    pos = cols["pos"].astype(np.int32)
+    span_c = cols["span"].astype(np.int32)
+    m = col.mate
+    rows = np.flatnonzero(m >= 0)
+    mate = m[rows].astype(np.int64)
+    unmapped = (flag & FLAG_UNMAPPED) != 0
+
+    # Placement first (samtools order): an unmapped read with a mapped
+    # mate adopts the mate's coordinates, and the subsequent mate sync
+    # reads the *placed* values.
+    place_rows = rows[unmapped[rows] & ~unmapped[mate]]
+    p_refid = refid.copy()
+    p_pos = pos.copy()
+    p_refid[place_rows] = refid[m[place_rows]]
+    p_pos[place_rows] = pos[m[place_rows]]
+
+    new_flag = flag[rows] & ~(FLAG_MATE_UNMAPPED | FLAG_MATE_REVERSE)
+    new_flag |= np.where(unmapped[mate], FLAG_MATE_UNMAPPED, 0)
+    new_flag |= np.where(
+        (flag[mate] & FLAG_REVERSE) != 0, FLAG_MATE_REVERSE, 0
+    )
+
+    # TLEN, the samtools 5'-to-5' rule (bam_mate.c): own5 is the
+    # alignment end for reverse reads, the start otherwise.
+    endpos = pos.astype(np.int64) + np.maximum(span_c, 1)
+    own5 = np.where((flag & FLAG_REVERSE) != 0, endpos, pos.astype(np.int64))
+    both_mapped = (
+        ~unmapped[rows]
+        & ~unmapped[mate]
+        & (refid[rows] == refid[mate])
+        & (refid[rows] >= 0)
+    )
+    new_tlen = np.where(both_mapped, own5[mate] - own5[rows], 0)
+
+    mask = np.zeros(n, dtype=bool)
+    mask[rows] = True
+    place = np.zeros(n, dtype=bool)
+    place[place_rows] = True
+
+    out_flag = flag.copy()
+    out_flag[rows] = new_flag
+    out_nrefid = np.zeros(n, np.int32)
+    out_npos = np.zeros(n, np.int32)
+    out_nrefid[rows] = p_refid[mate]
+    out_npos[rows] = p_pos[mate]
+    out_tlen = np.zeros(n, np.int32)
+    out_tlen[rows] = new_tlen.astype(np.int32)
+    # reg2bin(pos, pos+1) closed form for the single-base placed span.
+    out_bin = np.where(
+        p_pos >= 0, 4681 + (p_pos >> 14), 4680
+    ).astype(np.int32)
+
+    # MC tags: the mate's CIGAR string, for rows whose mate is mapped
+    # with a non-empty CIGAR.  Ragged string formatting is the one
+    # per-record host loop here (tag text is irreducibly ragged); it
+    # runs over paired rows only.
+    mc_off = np.zeros(n, dtype=np.int64)
+    mc_len = np.zeros(n, dtype=np.int32)
+    blob = bytearray()
+    n_cig = cols["n_cig"].astype(np.int64)
+    cig_off = cols["cig_off"].astype(np.int64)
+    cigs = cols["cigs"]
+    mc_rows = rows[~unmapped[mate] & (n_cig[mate] > 0)]
+    for r, mt in zip(mc_rows, m[mc_rows]):
+        tag = (
+            b"MCZ"
+            + _cigar_string(
+                cigs, int(cig_off[mt]), int(n_cig[mt])
+            ).encode()
+            + b"\x00"
+        )
+        mc_off[r] = len(blob)
+        mc_len[r] = len(tag)
+        blob.extend(tag)
+
+    counts = collation_counts(cols, col)
+    METRICS.count("fixmate.records_updated", len(rows))
+    METRICS.count("fixmate.placed_unmapped", len(place_rows))
+    METRICS.count("fixmate.mc_tags", len(mc_rows))
+    return FixmateEdits(
+        mask=mask,
+        place=place,
+        flag=out_flag,
+        refid=p_refid,
+        pos=p_pos,
+        bin=out_bin,
+        next_refid=out_nrefid,
+        next_pos=out_npos,
+        tlen=out_tlen,
+        mc=np.frombuffer(bytes(blob), dtype=np.uint8),
+        mc_off=mc_off,
+        mc_len=mc_len,
+        counts=counts,
+    )
+
+
+_TAG_FIXED = {
+    0x41: 1,  # A
+    0x63: 1, 0x43: 1,  # c C
+    0x73: 2, 0x53: 2,  # s S
+    0x69: 4, 0x49: 4, 0x66: 4,  # i I f
+}
+_B_ELEM = {0x63: 1, 0x43: 1, 0x73: 2, 0x53: 2, 0x69: 4, 0x49: 4, 0x66: 4}
+
+
+def find_tag_span(
+    body: np.ndarray, tag_off: int, tag: bytes
+) -> Optional[Tuple[int, int]]:
+    """(offset, length) of a whole tag entry (tag+type+value) inside one
+    record body, or None.  A malformed tag block stops the walk (the
+    record keeps its bytes — fixmate never invents a splice)."""
+    p = tag_off
+    end = len(body)
+    while p + 3 <= end:
+        t0, t1, ty = int(body[p]), int(body[p + 1]), int(body[p + 2])
+        q = p + 3
+        if ty in _TAG_FIXED:
+            q += _TAG_FIXED[ty]
+        elif ty in (0x5A, 0x48):  # Z H: NUL-terminated
+            while q < end and body[q] != 0:
+                q += 1
+            q += 1
+        elif ty == 0x42:  # B: elem type + i32 count + payload
+            if q + 5 > end:
+                return None
+            elem = _B_ELEM.get(int(body[q]))
+            if elem is None:
+                return None
+            count = (
+                int(body[q + 1])
+                | (int(body[q + 2]) << 8)
+                | (int(body[q + 3]) << 16)
+                | (int(body[q + 4]) << 24)
+            )
+            q += 5 + elem * count
+        else:
+            return None
+        if q > end:
+            return None
+        if bytes((t0, t1)) == tag:
+            return p, q - p
+        p = q
+    return None
+
+
+def apply_fixmate(batch, edits: FixmateEdits, row0: int):
+    """Rewrite one split's records per the edit plan → a fresh
+    :class:`io.bam.RecordBatch` (source payload untouched).
+
+    MC splice offsets are found by a tag walk over the rows gaining an
+    MC tag; the stream rebuild and every fixed-field patch are
+    vectorized (:func:`io.bam.rebuild_record_stream`)."""
+    from ..io.bam import RecordBatch, rebuild_record_stream
+
+    k = batch.n_records
+    soa = batch.soa
+    rec_off = soa["rec_off"].astype(np.int64)
+    rec_len = soa["rec_len"].astype(np.int64)
+    sl = slice(row0, row0 + k)
+    mask = edits.mask[sl]
+    place = edits.place[sl]
+    mc_len = edits.mc_len[sl].astype(np.int64)
+    mc_off = edits.mc_off[sl]
+
+    # Default: no splice (cut at end, zero length), no append.
+    cut_off = rec_len.copy()
+    cut_len = np.zeros(k, dtype=np.int64)
+    tag_off = (
+        32
+        + soa["l_read_name"].astype(np.int64)
+        + 4 * soa["n_cigar_op"].astype(np.int64)
+        + (soa["l_seq"].astype(np.int64) + 1) // 2
+        + soa["l_seq"].astype(np.int64)
+    )
+    with span("fixmate.stage.tag_walk", category="stage"):
+        for i in np.flatnonzero(mc_len > 0):
+            body = batch.data[rec_off[i] : rec_off[i] + rec_len[i]]
+            hit = find_tag_span(body, int(tag_off[i]), b"MC")
+            if hit is not None:
+                cut_off[i], cut_len[i] = hit
+    with span("fixmate.stage.apply", category="stage"):
+        out, new_off, new_len = rebuild_record_stream(
+            batch.data,
+            rec_off,
+            rec_len,
+            cut_off,
+            cut_len,
+            edits.mc,
+            mc_off,
+            mc_len,
+        )
+        rows = np.flatnonzero(mask)
+        if len(rows):
+            body = new_off[rows]
+            _poke_i32(out, body + 20, edits.next_refid[sl][rows])
+            _poke_i32(out, body + 24, edits.next_pos[sl][rows])
+            _poke_i32(out, body + 28, edits.tlen[sl][rows])
+            _poke_u16(out, body + 14, edits.flag[sl][rows])
+        p_rows = np.flatnonzero(place)
+        if len(p_rows):
+            body = new_off[p_rows]
+            _poke_i32(out, body + 0, edits.refid[sl][p_rows])
+            _poke_i32(out, body + 4, edits.pos[sl][p_rows])
+            _poke_u16(out, body + 10, edits.bin[sl][p_rows])
+    return RecordBatch(
+        soa={"rec_off": new_off, "rec_len": new_len},
+        data=out,
+        keys=np.empty(0, np.int64),
+    )
+
+
+def _poke_i32(stream: np.ndarray, at: np.ndarray, vals: np.ndarray) -> None:
+    v = vals.astype(np.int64) & 0xFFFFFFFF
+    for b in range(4):
+        stream[at + b] = ((v >> (8 * b)) & 0xFF).astype(np.uint8)
+
+
+def _poke_u16(stream: np.ndarray, at: np.ndarray, vals: np.ndarray) -> None:
+    v = vals.astype(np.int64) & 0xFFFF
+    stream[at] = (v & 0xFF).astype(np.uint8)
+    stream[at + 1] = ((v >> 8) & 0xFF).astype(np.uint8)

@@ -1,0 +1,165 @@
+"""Host-side collation columns: everything the name-collation engine
+needs per decoded split, reduced to fixed-width int32 columns plus two
+small ragged blobs (read names, raw CIGARs).
+
+Same stance as :mod:`dedup.signature`: the host owns the ragged gathers
+while the batch's sideband is still in hand; the chip owns the dense
+collation passes downstream.  The 64-bit read-name hash pair defined
+here (murmur3 seeds 0 and :data:`QNAME_SEED2`) is *the* collation key of
+the whole engine — the dedup subsystem's signature columns reuse it, so
+one definition serves markdup, queryname sort, and fixmate.
+
+The name blob is retained because hash buckets are only probably name
+groups: the engine's host verification pass
+(:func:`collate.host.verify_buckets`) compares actual name bytes before
+any decision trusts a bucket (64-bit collisions are ~never, but "~never"
+is not a correctness argument).  The CIGAR blob feeds fixmate's MC
+(mate-CIGAR) tags.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.cigar import clip_spans_np
+from ..spec.bam import (
+    FLAG_PAIRED,
+    FLAG_SECONDARY,
+    FLAG_SUPPLEMENTARY,
+)
+from ..utils.murmur3 import murmurhash3_int32_batch
+
+#: SoA columns the collation stages need beyond ``io.bam.SORT_FIELDS``.
+COLLATE_EXTRA_FIELDS = ("l_read_name", "n_cigar_op", "l_seq")
+
+#: Second murmur3 seed of the 64-bit read-name hash pair (seed 0 is the
+#: first).  Shared with :mod:`dedup.signature` — the collation key must
+#: be one definition across every workload built on it.
+QNAME_SEED2 = 0x9747B28C
+
+#: Ragged-blob column names rebased by :func:`concat_collation`.
+_BLOB_COLS = (("name_off", "names"), ("cig_off", "cigs"))
+
+
+def name_hash_pair(
+    data: np.ndarray, soa: Dict
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The 64-bit collation key: murmur3 of the qname bytes (sans the
+    trailing NUL) under two seeds, as an (int32, int32) column pair."""
+    name_off = soa["rec_off"].astype(np.int64) + 32
+    name_len = np.maximum(soa["l_read_name"].astype(np.int64) - 1, 0)
+    qh1 = murmurhash3_int32_batch(data, name_off, name_len, 0)
+    qh2 = murmurhash3_int32_batch(data, name_off, name_len, QNAME_SEED2)
+    return qh1, qh2
+
+
+def ragged_slice(
+    data: np.ndarray, offs: np.ndarray, lens: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gather ragged ``data[offs[i] : offs[i]+lens[i]]`` slices into one
+    packed blob; returns ``(blob, blob_offs)`` (``lens`` unchanged).  One
+    fancy-index pass — no per-record Python loop."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    out_off = np.cumsum(lens) - lens
+    if total == 0:
+        return np.empty(0, np.uint8), out_off
+    idx = (
+        np.repeat(offs.astype(np.int64) - out_off, lens)
+        + np.arange(total, dtype=np.int64)
+    )
+    return np.asarray(data, dtype=np.uint8)[idx], out_off
+
+
+def collation_columns(
+    data: np.ndarray, soa: Dict, with_cigars: bool = False
+) -> Dict[str, np.ndarray]:
+    """Fixed-width collation columns for one decoded batch (original
+    order), plus the packed name blob (and, for fixmate, the CIGAR blob).
+
+    int32 columns: ``qh1``/``qh2`` (64-bit name hash), ``flag``,
+    ``refid``, ``pos``, ``span`` (reference span from the CIGAR),
+    ``cand`` (primary pairing candidate: paired and neither secondary
+    nor supplementary — unmapped records *are* candidates here, unlike
+    dedup's: fixmate must pair an unmapped mate), ``name_len``; int64
+    ``name_off`` into the uint8 ``names`` blob.  ``with_cigars`` adds
+    ``n_cig``/``cig_off`` and the raw little-endian-u32 ``cigs`` blob.
+    """
+    flag = soa["flag"].astype(np.int32)
+    refid = soa["refid"].astype(np.int32)
+    pos = soa["pos"].astype(np.int32)
+    qh1, qh2 = name_hash_pair(data, soa)
+    if with_cigars:
+        # Reference spans feed fixmate's TLEN; the queryname path never
+        # walks CIGARs (and its slim read omits the geometry columns).
+        _, _, span = clip_spans_np(data, soa)
+    else:
+        span = np.zeros(len(flag), dtype=np.int64)
+    cand = (
+        ((flag & FLAG_PAIRED) != 0)
+        & ((flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)) == 0)
+    ).astype(np.int32)
+    name_src = soa["rec_off"].astype(np.int64) + 32
+    name_len = np.maximum(
+        soa["l_read_name"].astype(np.int64) - 1, 0
+    ).astype(np.int32)
+    names, name_off = ragged_slice(data, name_src, name_len)
+    cols = {
+        "qh1": qh1,
+        "qh2": qh2,
+        "flag": flag,
+        "refid": refid,
+        "pos": pos,
+        "span": span.astype(np.int32),
+        "cand": cand,
+        "name_len": name_len,
+        "name_off": name_off,
+        "names": names,
+    }
+    if with_cigars:
+        cig_src = (
+            soa["rec_off"].astype(np.int64)
+            + 32
+            + soa["l_read_name"].astype(np.int64)
+        )
+        n_cig = soa["n_cigar_op"].astype(np.int32)
+        cigs, cig_off = ragged_slice(data, cig_src, n_cig * 4)
+        cols.update({"n_cig": n_cig, "cig_off": cig_off, "cigs": cigs})
+    return cols
+
+
+def concat_collation(
+    parts: Sequence[Dict[str, np.ndarray]]
+) -> Dict[str, np.ndarray]:
+    """Concatenate per-split collation dicts into the job-global columns,
+    rebasing the blob offsets into the concatenated blobs."""
+    if not parts:
+        return collation_columns(
+            np.empty(0, np.uint8),
+            {
+                k: np.empty(0, np.int64)
+                for k in (
+                    "rec_off", "rec_len", "flag", "refid", "pos",
+                    "l_read_name", "n_cigar_op",
+                )
+            },
+        )
+    if len(parts) == 1:
+        return parts[0]
+    out: Dict[str, np.ndarray] = {}
+    for off_key, blob_key in _BLOB_COLS:
+        if off_key not in parts[0]:
+            continue
+        base = np.cumsum(
+            [0] + [len(p[blob_key]) for p in parts[:-1]]
+        ).astype(np.int64)
+        out[off_key] = np.concatenate(
+            [p[off_key] + base[i] for i, p in enumerate(parts)]
+        )
+        out[blob_key] = np.concatenate([p[blob_key] for p in parts])
+    for k in parts[0]:
+        if k not in out:
+            out[k] = np.concatenate([p[k] for p in parts])
+    return out

@@ -24,6 +24,11 @@ BAM_WRITE_SPLITTING_BAI = "hadoopbam.bam.write-splitting-bai"
 # dedup/ subsystem): duplicates get FLAG_DUPLICATE (0x400) ORed into
 # their written flag bytes.  Equivalent to sort_bam(mark_duplicates=True).
 BAM_MARK_DUPLICATES = "hadoopbam.bam.mark-duplicates"
+# Output ordering of pipeline.sort_bam: "coordinate" (default) or
+# "queryname" (the collation engine's samtools-natural-order name sort,
+# the CLI's `sort -n`).  The output header's @HD SO: field reports
+# whichever was actually used.
+BAM_SORT_ORDER = "hadoopbam.bam.sort-order"
 ANYSAM_TRUST_EXTS = "hadoopbam.anysam.trust-exts"
 ANYSAM_OUTPUT_FORMAT = "hadoopbam.anysam.output-format"
 ANYSAM_WRITE_HEADER = "hadoopbam.anysam.write-header"

@@ -12,9 +12,15 @@ sort key, so the result is deterministic and bit-identical to
 
 Three passes, all ``lax.sort`` + segmented scatter reductions:
 
-1. **Collation** — sort pair candidates by the 64-bit name hash; a
-   segment of exactly two candidates is a mated pair and the two rows
-   exchange end signature, score, and index by neighbor shift.
+1. **Collation** — the name-collation engine's shared core
+   (:func:`collate.device.collate_core`): sort pair candidates by the
+   64-bit name hash with content tie-breaks; a segment of exactly two
+   candidates is a mated pair and the two rows exchange end signature,
+   score, and index by neighbor shift.  Because the core's tie-breaks
+   are content-only (flag → 5′ position → index), the collation — and
+   therefore the whole decision — accepts coordinate-sorted,
+   queryname-grouped, or arbitrarily shuffled input identically
+   (markdup-on-unsorted is this property, not a separate mode).
 2. **Grouping** — sort everything by (own end signature, mated-first,
    mate end signature).  Rows with equal (self, mate) signature pairs are
    exactly the row-side views of duplicate pair families (both mates of a
@@ -39,13 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..collate.device import _prev, collate_core
+
 _I32MAX = np.int32(2**31 - 1)
-
-
-def _prev(a: jax.Array) -> jax.Array:
-    """Row i-1's value at row i (row 0 sees itself; callers force the
-    first boundary explicitly)."""
-    return jnp.concatenate([a[:1], a[:-1]])
 
 
 @jax.jit
@@ -69,25 +71,16 @@ def _mark_core(
         return sel
 
     # ---- pass 1: name-hash collation of pair candidates ------------------
-    _, _, _, idxs = lax.sort(
-        (1 - cand, qh1, qh2, idx), num_keys=4
+    # The shared engine core (collate/device.py): candidates grouped by
+    # the 64-bit hash with content tie-breaks, a 2-candidate segment's
+    # mates adjacent and exchanged through ``nb``.
+    idxs, _, _, _, mated, nb = collate_core(
+        cand, qh1, qh2, cand, flag, pos5
     )
     cands = cand[idxs]
-    qh1s, qh2s = qh1[idxs], qh2[idxs]
     refids, pos5s, revs = refid[idxs], pos5[idxs], rev[idxs]
     exempts, scores, flags = exempt[idxs], score[idxs], flag[idxs]
-    same = (
-        (cands & _prev(cands)).astype(bool)
-        & (qh1s == _prev(qh1s))
-        & (qh2s == _prev(qh2s))
-    )
-    same = same.at[0].set(False)
-    seg = jnp.cumsum(jnp.where(same, 0, 1)) - 1
-    size = zeros.at[seg].add(1)[seg]
-    mated = (cands == 1) & (size == 2)
-    # A 2-row segment's rows are adjacent: the mate is +1 from the first
-    # row, -1 from the second.
-    nb = jnp.clip(jnp.where(same, pos - 1, pos + 1), 0, n - 1)
+    qh1s, qh2s = qh1[idxs], qh2[idxs]
     m_refid = jnp.where(mated, refids[nb], 0)
     m_pos5 = jnp.where(mated, pos5s[nb], 0)
     m_rev = jnp.where(mated, revs[nb], 0)

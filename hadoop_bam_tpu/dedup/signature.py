@@ -15,6 +15,7 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
+from ..collate.signature import QNAME_SEED2, name_hash_pair
 from ..ops.cigar import clip_spans_np
 from ..ops.quality import sum_base_qualities_np
 from ..spec.bam import (
@@ -25,14 +26,13 @@ from ..spec.bam import (
     FLAG_SUPPLEMENTARY,
     FLAG_UNMAPPED,
 )
-from ..utils.murmur3 import murmurhash3_int32_batch
 
 #: SoA columns the dedup stage needs beyond ``io.bam.SORT_FIELDS``.
 DEDUP_EXTRA_FIELDS = ("l_read_name", "n_cigar_op", "l_seq")
 
-#: Second murmur3 seed for the read-name hash pair (seed 0 is the first);
-#: 64 collation bits total, so accidental name collisions are negligible.
-_QNAME_SEED2 = 0x9747B28C
+#: The collation engine owns the 64-bit read-name hash pair definition
+#: (collate/signature.py); re-exported under the historical name.
+_QNAME_SEED2 = QNAME_SEED2
 
 #: Scores are clamped so a pair sum can never overflow int32 on device.
 _SCORE_CAP = 1 << 30
@@ -69,11 +69,8 @@ def signature_columns(data: np.ndarray, soa: Dict) -> Dict[str, np.ndarray]:
     score = np.minimum(
         sum_base_qualities_np(data, soa), _SCORE_CAP
     ).astype(np.int32)
-    # Name hash over the qname bytes sans the trailing NUL.
-    name_off = soa["rec_off"].astype(np.int64) + 32
-    name_len = np.maximum(soa["l_read_name"].astype(np.int64) - 1, 0)
-    qh1 = murmurhash3_int32_batch(data, name_off, name_len, 0)
-    qh2 = murmurhash3_int32_batch(data, name_off, name_len, _QNAME_SEED2)
+    # The collation engine's 64-bit name hash pair (qname sans NUL).
+    qh1, qh2 = name_hash_pair(data, soa)
     return {
         "refid": refid,
         "pos5": pos5,

@@ -1201,6 +1201,79 @@ def patch_flags(
     stream[rec_starts + 19] |= np.uint8((bits >> 8) & 0xFF)
 
 
+def _ragged_copy(
+    dst: np.ndarray,
+    dst_off: np.ndarray,
+    src: np.ndarray,
+    src_off: np.ndarray,
+    lens: np.ndarray,
+) -> None:
+    """``dst[dst_off[i] : +lens[i]] = src[src_off[i] : +lens[i]]`` for
+    every i, as one fancy-index pass (no per-record Python loop)."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    base = np.cumsum(lens) - lens
+    within = np.arange(total, dtype=np.int64) - np.repeat(base, lens)
+    dst[np.repeat(dst_off.astype(np.int64), lens) + within] = src[
+        np.repeat(src_off.astype(np.int64), lens) + within
+    ]
+
+
+def rebuild_record_stream(
+    data: np.ndarray,
+    rec_off: np.ndarray,
+    rec_len: np.ndarray,
+    cut_off: np.ndarray,
+    cut_len: np.ndarray,
+    append_blob: np.ndarray,
+    append_off: np.ndarray,
+    append_len: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Re-emit records with a per-record tag splice and append — the
+    write-side machinery under the fixmate MC-tag patch.
+
+    Each output record is ``u32 size word + body[:cut_off] +
+    body[cut_off+cut_len:] + append_blob[append_off : +append_len]``
+    with the size word updated to the new body length.  A record with
+    ``cut_len == 0`` and ``append_len == 0`` round-trips byte-for-byte
+    (set ``cut_off = rec_len``).  Everything is vectorized ragged
+    copies; the source payload is never mutated (the ``patch_flags``
+    stance — the sort/collate pipelines rewrite only gathered output).
+
+    Returns ``(stream, new_rec_off, new_rec_len)`` — new body offsets
+    and lengths in the fresh stream, ready to wrap as a RecordBatch.
+    """
+    rec_off = rec_off.astype(np.int64)
+    rec_len = rec_len.astype(np.int64)
+    cut_off = cut_off.astype(np.int64)
+    cut_len = cut_len.astype(np.int64)
+    append_len = append_len.astype(np.int64)
+    new_len = rec_len - cut_len + append_len
+    full = 4 + new_len
+    starts = np.cumsum(full) - full
+    out = np.empty(int(full.sum()), dtype=np.uint8)
+    for b in range(4):  # little-endian u32 size words
+        out[starts + b] = ((new_len >> (8 * b)) & 0xFF).astype(np.uint8)
+    _ragged_copy(out, starts + 4, data, rec_off, cut_off)
+    _ragged_copy(
+        out,
+        starts + 4 + cut_off,
+        data,
+        rec_off + cut_off + cut_len,
+        rec_len - cut_off - cut_len,
+    )
+    _ragged_copy(
+        out,
+        starts + 4 + rec_len - cut_len,
+        append_blob,
+        append_off,
+        append_len,
+    )
+    return out, starts + 4, new_len
+
+
 def _write_part_device(
     batch,
     order: Optional[np.ndarray],

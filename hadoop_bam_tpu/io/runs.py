@@ -167,6 +167,7 @@ def write_manifest(
     run_count: int,
     memory_budget: int,
     mark_duplicates: bool,
+    sort_order: str = "coordinate",
 ) -> None:
     """Checkpoint the completed spill phase: inputs identity, job shape,
     and the byte size of every run sideband.  Written atomically *after*
@@ -192,6 +193,7 @@ def write_manifest(
         "run_count": run_count,
         "memory_budget": memory_budget,
         "mark_duplicates": mark_duplicates,
+        "sort_order": sort_order,
         "runs": runs,
     }
     path = os.path.join(spill_dir, MANIFEST_NAME)
@@ -206,14 +208,16 @@ def load_manifest(
     inputs: List[Dict],
     memory_budget: int,
     mark_duplicates: bool,
+    sort_order: str = "coordinate",
 ) -> Optional[Dict]:
     """The validated checkpoint, or None (missing / stale / mismatched).
 
     Validation is conservative: same format version, same input identity
-    (path+size+mtime_ns), same budget and markdup setting (both change
-    the spill plan), and every named run file present at its recorded
-    size.  Anything off → redo phase 1; a checkpoint is an optimization,
-    never a correctness dependency."""
+    (path+size+mtime_ns), same budget, markdup setting and sort order
+    (all three change the spill plan — a coordinate checkpoint must
+    never seed a queryname rerun), and every named run file present at
+    its recorded size.  Anything off → redo phase 1; a checkpoint is an
+    optimization, never a correctness dependency."""
     path = os.path.join(spill_dir, MANIFEST_NAME)
     try:
         with open(path) as f:
@@ -225,6 +229,7 @@ def load_manifest(
         or doc.get("inputs") != inputs
         or doc.get("memory_budget") != memory_budget
         or bool(doc.get("mark_duplicates")) != bool(mark_duplicates)
+        or doc.get("sort_order", "coordinate") != sort_order
         or doc.get("run_count") != len(doc.get("runs", []))
     ):
         return None

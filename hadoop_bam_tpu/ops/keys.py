@@ -63,3 +63,23 @@ def split_keys_np(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         (keys >> np.int64(32)).astype(np.int32),
         (keys & np.int64(0xFFFFFFFF)).astype(np.uint32),
     )
+
+
+def pack_hash64_np(qh1: np.ndarray, qh2: np.ndarray) -> np.ndarray:
+    """The collation engine's 64-bit name-hash key as one int64 column:
+    ``qh1`` in the high word, ``qh2`` (zero-extended) in the low — the
+    packed form of the (qh1, qh2) operand pair the device collation
+    sorts by (collate/device.py), for host-side oracles and sideband
+    storage.  Lexicographic (int32, uint32) order == signed-int64 order,
+    the ops/sort.py key contract."""
+    return (qh1.astype(np.int64) << np.int64(32)) | (
+        qh2.astype(np.uint32).astype(np.int64)
+    )
+
+
+def split_hash64_np(h: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`pack_hash64_np`: int64 → (qh1 int32, qh2 int32)."""
+    return (
+        (h >> np.int64(32)).astype(np.int32),
+        (h & np.int64(0xFFFFFFFF)).astype(np.int32),
+    )

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .conf import (
     BAM_MARK_DUPLICATES,
+    BAM_SORT_ORDER,
     BAM_WRITE_SPLITTING_BAI,
     ERRORS_MODE,
     EXECUTOR_ATTEMPT_TIMEOUT_MS,
@@ -103,8 +104,24 @@ def sort_bam(
     mark_duplicates: bool = False,
     resource_cache=None,
     errors: Optional[str] = None,
+    sort_order: Optional[str] = None,
 ) -> SortStats:
-    """Coordinate-sort BAM file(s) into one merged BAM.
+    """Sort BAM file(s) into one merged BAM.
+
+    ``sort_order`` selects the output ordering: ``"coordinate"`` (the
+    default — the reference's TestBAM job) or ``"queryname"`` (the
+    collation engine: records grouped on-device by their 64-bit name
+    hash, buckets ranked host-side with the exact samtools
+    ``strnum_cmp`` natural comparator, ties broken by flag → position →
+    read index; the CLI's ``sort -n``).  ``None`` defers to the
+    ``hadoopbam.bam.sort-order`` conf key.  The output header's
+    ``@HD SO:`` field reports whichever order was actually written —
+    never an unconditional claim.  Queryname keys come from the
+    collation engine, so ``sort_order="queryname"`` is incompatible
+    with ``mesh``/``distributed``, ``mark_duplicates`` (which needs the
+    coordinate stream; markdup itself already accepts unsorted input by
+    collating signatures) and an explicit ``device_parse=True`` (the
+    device-parse path builds coordinate keys).
 
     ``backend``: "device" (single-chip sort with host↔device transfers
     overlapped against split reads and part writes), or "host" (NumPy
@@ -197,6 +214,34 @@ def sort_bam(
         ) or "strict"
     if errors not in ("strict", "salvage"):
         raise ValueError(f"errors must be strict|salvage, got {errors!r}")
+    if sort_order is None:
+        sort_order = (
+            conf.get(BAM_SORT_ORDER, "coordinate")
+            if conf is not None
+            else "coordinate"
+        ) or "coordinate"
+    if sort_order not in ("coordinate", "queryname"):
+        raise ValueError(
+            f"sort_order must be coordinate|queryname, got {sort_order!r}"
+        )
+    queryname = sort_order == "queryname"
+    if queryname:
+        if mesh is not None or distributed is not None:
+            raise ValueError(
+                "sort_order='queryname' is single-host (the collation "
+                "engine's rank pass is not mesh-distributed yet)"
+            )
+        if mark_duplicates:
+            raise ValueError(
+                "mark_duplicates needs the coordinate stream; markdup "
+                "already accepts unsorted/queryname-grouped input by "
+                "collating signatures — run it without sort_order"
+            )
+        if device_parse:
+            raise ValueError(
+                "device_parse builds coordinate keys; queryname keys "
+                "come from the collation engine"
+            )
     # Executor hardening knobs (attempt deadline + retry backoff), shared
     # by every write phase below.
     timeout_ms = conf.get_int(EXECUTOR_ATTEMPT_TIMEOUT_MS, 0) if conf else 0
@@ -208,7 +253,9 @@ def sort_bam(
         header = resource_cache.header(in_paths[0])[0]
     else:
         header = read_header(in_paths[0])
-    header = header.with_sort_order("coordinate")
+    # The header claims the order actually written (satellite fix: this
+    # used to stamp "coordinate" unconditionally on every write path).
+    header = header.with_sort_order(sort_order)
     if memory_budget is not None:
         if mesh is not None or distributed is not None:
             raise ValueError(
@@ -233,6 +280,15 @@ def sort_bam(
             device_write_enabled,
         )
 
+        key_column = None
+        if queryname:
+            # The rank prepass: one extra streaming read builds the
+            # collation columns (≈20 B/record + name bytes — the same
+            # "columns stay in memory, payloads stay bounded" stance as
+            # out-of-core markdup), and the resulting read-order rank
+            # becomes the external sort's key column — unique int64s,
+            # so spill runs and exact range planning work unchanged.
+            key_column = _queryname_rank_column(fmt, splits, errors)
         return _sort_bam_external(
             fmt,
             splits,
@@ -251,6 +307,8 @@ def sort_bam(
             errors=errors,
             attempt_timeout=exec_timeout,
             retry_backoff=exec_backoff,
+            sort_order=sort_order,
+            key_column=key_column,
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -258,6 +316,11 @@ def sort_bam(
     use_device = (
         backend == "device" and distributed is None and mesh is None
     )
+    if queryname:
+        # Queryname keys come from the collation engine (its lax.sort
+        # grouping pass IS the device stage); the coordinate key
+        # upload/sort machinery below stays cold.
+        use_device = False
     if device_parse is None:
         env = os.environ.get("HBAM_DEVICE_PARSE")
         if env is not None:
@@ -311,6 +374,12 @@ def sort_bam(
     read_fields = (
         ("rec_off", "rec_len") if use_device_parse else SORT_FIELDS
     )
+    collate_cols: List[dict] = []
+    if queryname:
+        # Name hashes need the qname geometry on top of the key inputs.
+        read_fields = tuple(
+            dict.fromkeys(SORT_FIELDS + ("l_read_name",))
+        )
     sig_cols: List[dict] = []
     if mark_duplicates:
         # The dedup signature needs the clip/qual/name geometry columns on
@@ -329,13 +398,18 @@ def sort_bam(
                 fmt,
                 splits,
                 fields=read_fields,
-                with_keys=not use_device_parse,
+                with_keys=not (use_device_parse or queryname),
                 errors=errors,
             )
         ):
             if mark_duplicates:
                 with span("sort_bam.markdup_signature"):
                     sig_cols.append(signature_columns(b.data, b.soa))
+            if queryname:
+                from .collate import collation_columns
+
+                with span("collate.stage.signature", category="stage"):
+                    collate_cols.append(collation_columns(b.data, b.soa))
             # Only the record extents stay live (the other fixed-field
             # columns would just inflate host peak).
             b.soa = {
@@ -394,7 +468,17 @@ def sort_bam(
             else np.empty(0, np.int64)
         )
 
-    if distributed is not None or mesh is not None:
+    if queryname and n:
+        # The collation engine: one device grouping pass over the
+        # job-global name-hash columns, host natural-order ranking of
+        # the verified bucket representatives, one lexsort finish.
+        from .collate import concat_collation, queryname_perm
+
+        backend = "collate-queryname"
+        with span("sort_bam.queryname_sort", category="stage"):
+            perm, _qstats = queryname_perm(concat_collation(collate_cols))
+        collate_cols = []
+    elif distributed is not None or mesh is not None:
         ds = distributed
         if ds is None:
             mesh = mesh or make_mesh()
@@ -577,6 +661,221 @@ def markdup_bam(
     (``memory_budget``, ``backend``, ``level``, …)."""
     kwargs["mark_duplicates"] = True
     return sort_bam(in_paths, out_path, **kwargs)
+
+
+def _queryname_rank_column(fmt, splits, errors: str) -> np.ndarray:
+    """The out-of-core queryname prepass: stream the splits once for
+    their collation columns, run the engine, return each record's
+    read-order *output rank* as an int64 column.  Ranks are unique, so
+    they drop into the external sort's spill/range machinery as
+    ordinary keys."""
+    from .collate import collation_columns, concat_collation, queryname_perm
+
+    fields = tuple(dict.fromkeys(SORT_FIELDS + ("l_read_name",)))
+    cols: List[dict] = []
+    with span("sort_bam.queryname_rank_prepass", category="stage"):
+        for b in _read_splits_pipelined(
+            fmt, splits, fields=fields, with_keys=False, errors=errors
+        ):
+            with span("collate.stage.signature", category="stage"):
+                cols.append(collation_columns(b.data, b.soa))
+        perm, _ = queryname_perm(concat_collation(cols))
+    rank = np.empty(len(perm), dtype=np.int64)
+    rank[perm] = np.arange(len(perm), dtype=np.int64)
+    return rank
+
+
+@dataclass
+class FixmateStats:
+    n_records: int
+    n_splits: int
+    n_pairs: int
+    n_singletons: int
+    n_orphans: int
+    backend: str
+
+
+def fixmate_bam(
+    in_paths: Sequence[str] | str,
+    out_path: str,
+    conf: Optional[Configuration] = None,
+    split_size: int = 32 << 20,
+    level: int = 6,
+    memory_budget: Optional[int] = None,
+    max_attempts: int = 3,
+    part_dir: Optional[str] = None,
+    write_workers: Optional[int] = None,
+    write_splitting_bai: bool = False,
+    errors: Optional[str] = None,
+) -> FixmateStats:
+    """Fill mate information from collated pairs, preserving record
+    order (the ``samtools fixmate`` role, without requiring name-grouped
+    input): mate coordinates, mate-unmapped/reverse flags, TLEN (the
+    samtools 5′-to-5′ rule), MC mate-CIGAR tags, and placement of
+    unmapped reads next to their mapped mates.  See
+    :mod:`hadoop_bam_tpu.collate.fixmate` for the exact semantics and
+    documented deviations.
+
+    Two passes over the input: pass A streams the splits for the
+    fixed-width collation columns (plus the small name/CIGAR blobs) and
+    runs the engine's device grouping + host verification; pass B
+    rewrites each split's records per the edit plan
+    (:func:`io.bam.rebuild_record_stream` — source payloads never
+    mutate) and writes one part per split through the elastic executor.
+    In-core (default) pass A retains the decoded batches; with
+    ``memory_budget`` set, pass B re-reads each split instead, so
+    materialized record bytes stay bounded while the columns (~20
+    B/record + name/CIGAR bytes) ride in memory — the out-of-core
+    markdup stance.  The output header is the input's: fixmate changes
+    neither order nor grouping, so it has nothing new to claim.
+
+    ``errors="salvage"`` survives corrupt members like the sort paths;
+    note both passes must then see the same surviving records, which
+    holds for persistent corruption (the fault harness's bit-flips) but
+    means transient-fault drills should prefer the strict reader."""
+    if isinstance(in_paths, str):
+        in_paths = [in_paths]
+    from .collate import (
+        FIXMATE_FIELDS,
+        apply_fixmate,
+        collate_by_name,
+        collation_columns,
+        compute_fixmate_edits,
+        concat_collation,
+        verify_and_repair,
+    )
+
+    fmt = BamInputFormat(conf)
+    if conf is not None:
+        write_splitting_bai = write_splitting_bai or conf.get_boolean(
+            BAM_WRITE_SPLITTING_BAI
+        )
+    if errors is None:
+        errors = (
+            conf.get(ERRORS_MODE, "strict") if conf is not None else "strict"
+        ) or "strict"
+    if errors not in ("strict", "salvage"):
+        raise ValueError(f"errors must be strict|salvage, got {errors!r}")
+    timeout_ms = conf.get_int(EXECUTOR_ATTEMPT_TIMEOUT_MS, 0) if conf else 0
+    exec_timeout = timeout_ms / 1e3 if timeout_ms > 0 else None
+    exec_backoff = (
+        conf.get_int(EXECUTOR_BACKOFF_MS, 50) if conf else 50
+    ) / 1e3
+    header = read_header(in_paths[0])
+    if memory_budget is not None:
+        split_size = max(64 << 10, min(split_size, memory_budget // 16))
+    with span("fixmate.plan"):
+        splits = fmt.get_splits(in_paths, split_size=split_size)
+    keep_batches = memory_budget is None
+    read_fields = tuple(dict.fromkeys(FIXMATE_FIELDS))
+
+    batches: List[Optional[RecordBatch]] = []
+    cols_parts: List[dict] = []
+    row_bases: List[int] = [0]
+    with span("fixmate.read", category="stage"):
+        for b in _read_splits_pipelined(
+            fmt, splits, fields=read_fields, with_keys=False, errors=errors
+        ):
+            with span("collate.stage.signature", category="stage"):
+                cols_parts.append(
+                    collation_columns(b.data, b.soa, with_cigars=True)
+                )
+            b.device_data = None  # fixmate rewrites host-side
+            row_bases.append(row_bases[-1] + b.n_records)
+            batches.append(b if keep_batches else None)
+    n = row_bases[-1]
+    METRICS.count("fixmate.records", n)
+
+    with span("fixmate.collate", category="stage"):
+        cols = concat_collation(cols_parts)
+        cols_parts = []
+        with span("collate.stage.device", category="stage"):
+            col = collate_by_name(cols)
+        with span("collate.stage.verify", category="stage"):
+            col, _ = verify_and_repair(col, cols)
+    with span("fixmate.stage.edits", category="stage"):
+        edits = compute_fixmate_edits(cols, col)
+    cols = None
+    col = None
+
+    with span("fixmate.write", category="stage"), \
+            contextlib.ExitStack() as stack:
+        if part_dir is not None:
+            td = part_dir
+            os.makedirs(td, exist_ok=True)
+        else:
+            td = stack.enter_context(
+                tempfile.TemporaryDirectory(
+                    dir=os.path.dirname(os.path.abspath(out_path)) or "."
+                )
+            )
+        executor = ElasticExecutor(
+            td,
+            max_attempts=max_attempts,
+            max_workers=write_workers,
+            validate_part=bgzf_part_valid,
+            quarantine=errors == "salvage",
+            attempt_timeout=exec_timeout,
+            retry_backoff=exec_backoff,
+        )
+        deflate_threads = max(
+            1, (os.cpu_count() or 4) // executor.max_workers
+        )
+        from .io.bam import write_part_fast
+        from .ops.flate import deflate_lanes_tier_enabled
+
+        use_device_deflate = deflate_lanes_tier_enabled(conf)
+
+        def write_one(pi: int, tmp: str) -> None:
+            b = batches[pi]
+            if b is None:
+                b = fmt.read_split(
+                    splits[pi], fields=read_fields, with_keys=False,
+                    errors=errors,
+                )
+            patched = apply_fixmate(b, edits, row_bases[pi])
+            if not keep_batches:
+                b = None
+            sb_stream = None
+            try:
+                if write_splitting_bai:
+                    sb_stream = open(tmp + ".sb", "wb")
+                with trace_ctx(part=pi), span(
+                    "pipeline.stage.write_part", category="item"
+                ), open(tmp, "wb") as f:
+                    write_part_fast(
+                        f,
+                        patched,
+                        order=None,
+                        level=level,
+                        splitting_bai_stream=sb_stream,
+                        threads=deflate_threads,
+                        device_deflate=use_device_deflate,
+                        device_write=False,  # rebuilt stream: no residency
+                    )
+            finally:
+                if sb_stream is not None:
+                    sb_stream.close()
+            if write_splitting_bai:
+                os.replace(
+                    tmp + ".sb",
+                    os.path.join(td, f"part-r-{pi:05d}.splitting-bai"),
+                )
+
+        executor.run(list(range(max(1, len(splits)))), write_one
+                     if splits else _write_empty_part)
+        merge_bam_parts(
+            td, out_path, header, write_splitting_bai=write_splitting_bai
+        )
+    return FixmateStats(
+        n_records=n,
+        n_splits=len(splits),
+        n_pairs=edits.counts["pairs"],
+        n_singletons=edits.counts["singletons"],
+        n_orphans=edits.counts["orphans"],
+        backend="collate-fixmate"
+        + ("[budget]" if memory_budget is not None else ""),
+    )
 
 
 def _device_roundtrip_ms() -> float:
@@ -923,8 +1222,17 @@ def _sort_bam_external(
     errors: str = "strict",
     attempt_timeout: Optional[float] = None,
     retry_backoff: float = 0.05,
+    sort_order: str = "coordinate",
+    key_column: Optional[np.ndarray] = None,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
+
+    ``key_column`` (int64, global read order) overrides the per-record
+    coordinate keys — the queryname path passes each record's
+    precomputed output rank here, and the spill/range machinery runs
+    unchanged over those unique keys.  ``sort_order`` rides into the
+    spill manifest so a crash-resume never mixes checkpoints across
+    orderings.
 
     Phase 1 streams splits in file order, accumulating decoded batches until
     the uncompressed budget fills, then sorts the chunk (device or host) and
@@ -1005,7 +1313,8 @@ def _sort_bam_external(
         dupmask_path = os.path.join(spill_dir, "dupmask.npy")
         manifest = (
             load_manifest(
-                spill_dir, identity, memory_budget, mark_duplicates
+                spill_dir, identity, memory_budget, mark_duplicates,
+                sort_order=sort_order,
             )
             if identity is not None
             else None
@@ -1060,8 +1369,16 @@ def _sort_bam_external(
 
             with span("sort_bam.spill"):
                 for b in _read_splits_pipelined(
-                    fmt, splits, fields=read_fields, errors=errors
+                    fmt,
+                    splits,
+                    fields=read_fields,
+                    with_keys=key_column is None,
+                    errors=errors,
                 ):
+                    if key_column is not None:
+                        # Queryname ranks (or any precomputed key): the
+                        # prepass indexed them by global read order.
+                        b.keys = key_column[n : n + b.n_records]
                     if mark_duplicates:
                         with span("sort_bam.markdup_signature"):
                             sig_cols.append(
@@ -1112,6 +1429,7 @@ def _sort_bam_external(
                     run_count=run_count,
                     memory_budget=memory_budget,
                     mark_duplicates=mark_duplicates,
+                    sort_order=sort_order,
                 )
         METRICS.count("sort_bam.records", n)
         METRICS.count("sort_bam.splits", len(splits))

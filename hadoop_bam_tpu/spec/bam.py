@@ -93,21 +93,47 @@ class BamHeader:
                         return f[3:]
         return "unknown"
 
-    def with_sort_order(self, so: str) -> "BamHeader":
+    def grouping(self) -> str:
+        """The @HD GO: field (record grouping: none/query/reference), or
+        "none" — the SAM-spec default — when absent."""
+        for line in self.text.split("\n"):
+            if line.startswith("@HD"):
+                for f in line.split("\t"):
+                    if f.startswith("GO:"):
+                        return f[3:]
+        return "none"
+
+    def with_sort_order(
+        self, so: str, grouping: Optional[str] = None
+    ) -> "BamHeader":
         """Rewritten @HD SO: field (util/GetSortedBAMHeader.java:36-57
-        semantics: force the header's sort order before a sorted write)."""
+        semantics: force the header's sort order before a sorted write).
+
+        The header claims what the write path actually produced — never
+        an unconditional "coordinate" (the pipelines thread their real
+        sort order here).  ``grouping`` additionally rewrites the GO:
+        field (e.g. ``GO:query`` for name-grouped-but-not-sorted
+        output); a stale GO: is always stripped when SO: is rewritten,
+        since a sorted stream's grouping claim no longer holds."""
         lines = self.text.split("\n")
         hd_seen = False
         for i, line in enumerate(lines):
             if line.startswith("@HD"):
                 hd_seen = True
                 fields = [
-                    f for f in line.split("\t") if not f.startswith("SO:")
+                    f
+                    for f in line.split("\t")
+                    if not f.startswith(("SO:", "GO:"))
                 ]
                 fields.append(f"SO:{so}")
+                if grouping is not None:
+                    fields.append(f"GO:{grouping}")
                 lines[i] = "\t".join(fields)
         if not hd_seen:
-            lines.insert(0, f"@HD\tVN:1.6\tSO:{so}")
+            hd = f"@HD\tVN:1.6\tSO:{so}"
+            if grouping is not None:
+                hd += f"\tGO:{grouping}"
+            lines.insert(0, hd)
         return BamHeader("\n".join(lines), list(self.refs))
 
     def encode(self) -> bytes:

@@ -66,6 +66,12 @@ def pytest_configure(config):
         "(corrupt members, torn writes, kill -9 resume, socket drops; "
         "run everywhere — no kernels involved)",
     )
+    config.addinivalue_line(
+        "markers",
+        "collate: name-collation engine (collate/) tests — queryname "
+        "sort, fixmate, markdup-on-unsorted, collision rescue (run "
+        "everywhere; the grouping pass is lax.sort, no Pallas kernels)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

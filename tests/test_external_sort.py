@@ -63,6 +63,11 @@ def test_external_matches_in_memory_oracle(bam_60k, tmp_path):
     k_mem, r_mem = _read_all(out_mem)
     assert np.array_equal(k_ext, k_mem)
     assert r_ext == r_mem  # byte-identical records in identical stable order
+    # The output header claims the order actually written (PR 9
+    # satellite: no more unconditional SO:coordinate on any write path).
+    from hadoop_bam_tpu.io.bam import read_header
+
+    assert read_header(out_ext).sort_order() == "coordinate"
 
 
 def test_external_device_backend(bam_60k, tmp_path):

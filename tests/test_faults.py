@@ -304,6 +304,59 @@ def test_salvage_sort_end_to_end_and_cli_metrics(bam_corpus, tmp_path, capsys):
     assert np.all(keys[:-1] <= keys[1:])
 
 
+def test_salvage_queryname_sort_cli(bam_corpus, tmp_path):
+    """The collation workloads inherit the PR 7 survival guarantees:
+    ``sort -n --errors salvage`` over a bit-flipped corpus quarantines
+    the corrupt members and still emits the survivors in exact samtools
+    natural name order."""
+    ranks = [3, 17]
+    xp = _corrupt(bam_corpus, tmp_path / "qn.bam", ranks)
+    out = str(tmp_path / "qn_sorted.bam")
+    from hadoop_bam_tpu.cli import main
+
+    before = snapshot()
+    rc = main(
+        ["sort", xp, "-o", out, "--level", "1", "-n",
+         "--errors", "salvage"]
+    )
+    assert rc == 0
+    d = delta(before)["counters"]
+    assert d.get("salvage.members_quarantined") == len(ranks)
+    hdr, got = bam.read_bam(out)
+    assert hdr.sort_order() == "queryname"
+    oracle = _surviving_oracle(bam_corpus, ranks)
+    assert sorted(r.raw for r in got) == sorted(oracle)
+    from hadoop_bam_tpu.collate import natural_compare
+
+    names = [r.read_name.encode() for r in got]
+    assert all(
+        natural_compare(names[i], names[i + 1]) <= 0
+        for i in range(len(names) - 1)
+    )
+
+
+def test_salvage_fixmate_cli(bam_corpus, tmp_path):
+    """``fixmate --errors salvage``: corrupt members quarantine, the
+    survivors pass through order-preserved (the corpus is unpaired, so
+    fixmate must be a byte-exact pass-through of exactly the salvage
+    oracle's record list)."""
+    ranks = [5, 12]
+    xp = _corrupt(bam_corpus, tmp_path / "fm.bam", ranks)
+    out = str(tmp_path / "fm_fixed.bam")
+    from hadoop_bam_tpu.cli import main
+
+    before = snapshot()
+    rc = main(
+        ["fixmate", xp, "-o", out, "--level", "1",
+         "--errors", "salvage"]
+    )
+    assert rc == 0
+    d = delta(before)["counters"]
+    assert d.get("salvage.members_quarantined") == len(ranks)
+    _, got = bam.read_bam(out)
+    assert [r.raw for r in got] == _surviving_oracle(bam_corpus, ranks)
+
+
 def test_salvage_on_clean_file_identical_to_strict(bam_corpus, tmp_path):
     o1 = str(tmp_path / "strict.bam")
     o2 = str(tmp_path / "salvage.bam")
