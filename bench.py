@@ -306,6 +306,15 @@ def _measure(platform: str) -> dict:
         out.update(_serve_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["serve_bench_error"] = str(e)[:120]
+    # Overload resilience (both platforms): goodput and typed-refusal
+    # behavior of the daemon at 2x its measured capacity, deadline miss
+    # accounting, shed-reply latency, and the OOM degradation rate under
+    # an injected arena.oom storm — the PR 10 acceptance numbers, per
+    # round rather than asserted once.
+    try:
+        out.update(_overload_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["overload_bench_error"] = str(e)[:120]
     # Robustness diagnostics (both platforms): the salvage policy layer's
     # cost on a clean file (must be ≈0 — the disarmed seams and the
     # strict-first fast path are the design) and whether a sort over a
@@ -372,6 +381,158 @@ def _serve_bench(tmp: str) -> dict:
         "serve_view_cold_ms": round(cold_s * 1e3, 2),
         "serve_view_warm_ms": round(warm_s * 1e3, 2),
         "serve_warm_vs_cold_latency": round(cold_s / max(warm_s, 1e-9), 2),
+    }
+
+
+def _overload_bench(tmp: str) -> dict:
+    """Overload-resilience diagnostics through a live daemon.
+
+    Capacity is measured first (a short serial warm-view loop), then the
+    daemon is offered ~2x that rate from concurrent clients with
+    per-request deadlines and retries disabled:
+
+    - ``serve_overload_goodput``: accepted-request QPS under the 2x
+      offered load (a healthy admission layer sheds the excess and keeps
+      goodput near capacity instead of collapsing);
+    - ``serve_deadline_miss_rate``: fraction of offered requests that
+      expired (client- or server-side) — bounded-latency proof;
+    - ``serve_shed_p99_ms``: p99 client-observed latency of *shed*
+      replies (saying "no" must stay cheap under overload);
+    - ``serve_oom_tierdown_rate``: with an ``arena.oom`` storm armed,
+      the fraction of requests that had to tier down to the host codec
+      (evict-retry absorbs the rest) — every request still answers.
+    """
+    import threading
+
+    from hadoop_bam_tpu import faults
+    from hadoop_bam_tpu.conf import (
+        Configuration,
+        SERVE_ADMISSION_TOKENS,
+        SERVE_BATCH_WINDOW_MS,
+        SERVE_MAX_QUEUE,
+    )
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.serve import BamDaemon, ServeClient
+    from hadoop_bam_tpu.serve.client import (
+        DeadlineExceededError,
+        ServeShedError,
+    )
+    from hadoop_bam_tpu.spec import indices
+
+    n = int(os.environ.get("HBAM_BENCH_OVERLOAD_RECORDS", "20000"))
+    src = os.path.join(tmp, "overload_src.bam")
+    synth_bam(src, n)
+    srt = os.path.join(tmp, "overload_sorted.bam")
+    sort_bam([src], srt, backend="host", level=1)
+    with open(srt + ".bai", "wb") as f:
+        indices.build_bai(srt).save(f)
+    sock = os.path.join(tmp, "overload.sock")
+    conf = Configuration(
+        {
+            SERVE_ADMISSION_TOKENS: "2",
+            SERVE_MAX_QUEUE: "4",
+            SERVE_BATCH_WINDOW_MS: "0",
+        }
+    )
+    daemon = BamDaemon(socket_path=sock, warmup=False, conf=conf)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=daemon.serve_forever, args=(ready,), daemon=True
+    )
+    t.start()
+    if not ready.wait(120):
+        raise RuntimeError("overload bench daemon did not come up")
+    region = "chr1:10000000-10100000"
+    probe = ServeClient(socket_path=sock, retries=0)
+    try:
+        # Capacity: serial warm QPS over ~0.5 s.
+        probe.view(srt, region, level=1)
+        reqs = 0
+        t0 = time.time()
+        while time.time() - t0 < 0.5:
+            probe.view(srt, region, level=1)
+            reqs += 1
+        capacity_qps = reqs / (time.time() - t0)
+        # Offered load ≈ 2x capacity from 2x the threads a serial loop
+        # amounts to, each as fast as it can go for ~1.5 s.
+        n_threads = 8
+        duration = 1.5
+        per_req_budget_ms = max(10.0, 4e3 / max(capacity_qps, 1.0))
+        lock = threading.Lock()
+        stats = {"offered": 0, "ok": 0, "shed": 0, "deadline": 0,
+                 "error": 0}
+        shed_lat_ms = []
+
+        def storm():
+            c = ServeClient(socket_path=sock, retries=0)
+            end = time.time() + duration
+            while time.time() < end:
+                t1 = time.time()
+                try:
+                    c.view(srt, region, level=1,
+                           deadline_ms=per_req_budget_ms)
+                    key = "ok"
+                except ServeShedError:
+                    key = "shed"
+                    with lock:
+                        shed_lat_ms.append((time.time() - t1) * 1e3)
+                except DeadlineExceededError:
+                    key = "deadline"
+                except Exception:
+                    key = "error"
+                with lock:
+                    stats["offered"] += 1
+                    stats[key] += 1
+
+        threads = [threading.Thread(target=storm) for _ in range(n_threads)]
+        t0 = time.time()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.time() - t0
+        goodput = stats["ok"] / wall
+        miss_rate = stats["deadline"] / max(stats["offered"], 1)
+        shed_lat_ms.sort()
+        shed_p99 = (
+            shed_lat_ms[int(0.99 * (len(shed_lat_ms) - 1))]
+            if shed_lat_ms
+            else 0.0
+        )
+        # OOM degradation: arm an arena.oom storm and re-drive warm
+        # views; every request must still answer (evict-retry first,
+        # host tier-down when the retry fails too).
+        oom_reqs = 40
+        before = daemon._stats()["metrics"]["counters"].get(
+            "serve.oom.tierdowns", 0
+        )
+        faults.arm("arena.oom:n=*")
+        try:
+            for _ in range(oom_reqs):
+                # Drop residency so every request actually decodes (a
+                # warm arena hit would bypass the codec seam entirely).
+                daemon.ctx.arena.release_all()
+                probe.view(srt, region, level=1)
+        finally:
+            faults.disarm()
+        after = daemon._stats()["metrics"]["counters"].get(
+            "serve.oom.tierdowns", 0
+        )
+        oom_rate = (after - before) / oom_reqs
+    finally:
+        try:
+            probe.shutdown()
+        except Exception:
+            pass
+        t.join(timeout=30)
+    return {
+        "serve_capacity_qps": round(capacity_qps, 1),
+        "serve_overload_goodput": round(goodput, 1),
+        "serve_overload_offered": stats["offered"],
+        "serve_overload_shed": stats["shed"],
+        "serve_deadline_miss_rate": round(miss_rate, 4),
+        "serve_shed_p99_ms": round(shed_p99, 2),
+        "serve_oom_tierdown_rate": round(oom_rate, 3),
     }
 
 

@@ -393,10 +393,14 @@ def _cmd_serve(args) -> int:
     """Run the resident daemon until a ``shutdown`` request (or SIGINT)."""
     from .conf import (
         Configuration,
+        SERVE_ADMISSION_TOKENS,
         SERVE_ARENA_BYTES,
         SERVE_BATCH_WINDOW_MS,
         SERVE_CACHE_BYTES,
+        SERVE_JOURNAL,
         SERVE_MAX_INFLIGHT,
+        SERVE_MAX_QUEUE,
+        SERVE_MAX_QUEUE_MS,
     )
     from .serve.server import BamDaemon
 
@@ -410,12 +414,23 @@ def _cmd_serve(args) -> int:
         conf.set_int(SERVE_BATCH_WINDOW_MS, args.batch_window_ms)
     if args.max_inflight is not None:
         conf.set_int(SERVE_MAX_INFLIGHT, args.max_inflight)
+    if args.admission_tokens is not None:
+        conf.set_int(SERVE_ADMISSION_TOKENS, args.admission_tokens)
+    if args.max_queue is not None:
+        conf.set_int(SERVE_MAX_QUEUE, args.max_queue)
+    if args.max_queue_ms is not None:
+        conf.set_int(SERVE_MAX_QUEUE_MS, args.max_queue_ms)
+    if args.journal is not None:
+        conf.set(SERVE_JOURNAL, args.journal)
     daemon = BamDaemon(
         conf=conf,
         socket_path=args.socket,
         port=args.port,
         warmup=not args.no_warmup,
     )
+    # SIGTERM/SIGINT drain like the shutdown op: finish in-flight jobs,
+    # journal their terminal states, then exit the accept loop.
+    daemon.install_signal_handlers()
     daemon.start()
     if daemon.warmup_report is not None:
         w = daemon.warmup_report
@@ -671,6 +686,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-warmup", action="store_true",
         help="skip the startup kernel-geometry pre-compilation "
              "(hadoopbam.serve.warmup)")
+    s.add_argument(
+        "--admission-tokens", type=int, default=None,
+        help="admission concurrency budget in cost units (view=1, "
+             "flagstat=2, sort=4; hadoopbam.serve.admission-tokens)")
+    s.add_argument(
+        "--max-queue", type=int, default=None,
+        help="admission queue depth bound — beyond it requests shed "
+             "with code SHED + a retry_after_ms hint "
+             "(hadoopbam.serve.max-queue)")
+    s.add_argument(
+        "--max-queue-ms", type=int, default=None,
+        help="queue-wait p95 bound in ms — beyond it requests shed with "
+             "code RETRY_AFTER (hadoopbam.serve.max-queue-ms; 0 "
+             "disables the wait rule)")
+    s.add_argument(
+        "--journal", default=None, metavar="FILE",
+        help="crash-safe job journal (append-only fsync'd JSONL, "
+             "hadoopbam.serve.journal): a restarted daemon reports "
+             "accurate terminal job states, resumes interrupted sorts "
+             "byte-identically via their part-dir checkpoints, and "
+             "answers unknown ids with code JOB_LOST")
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_serve)
 

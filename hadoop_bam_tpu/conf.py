@@ -92,6 +92,22 @@ SERVE_BATCH_WINDOW_MS = "hadoopbam.serve.batch-window-ms"
 # Max concurrently-running submitted jobs (sort submissions run in a
 # bounded pool; view/flagstat answer inline per connection).
 SERVE_MAX_INFLIGHT = "hadoopbam.serve.max-inflight"
+# Admission control (serve/admission.py): the token-style concurrency
+# budget shared by the data-plane ops (view=1, flagstat=2, sort=4 cost
+# units; control-plane ops are never gated), the admission queue's depth
+# bound (crossing it sheds with code SHED + a retry_after_ms hint), and
+# the queue-wait p95 bound in milliseconds (crossing it sheds with code
+# RETRY_AFTER; 0 disables the wait rule, depth still bounds).
+SERVE_ADMISSION_TOKENS = "hadoopbam.serve.admission-tokens"
+SERVE_MAX_QUEUE = "hadoopbam.serve.max-queue"
+SERVE_MAX_QUEUE_MS = "hadoopbam.serve.max-queue-ms"
+# Crash-safe job journal path (serve/journal.py): append-only JSONL of
+# job submissions + state transitions, fsync'd per append.  A restarted
+# daemon pointed at the same journal reports accurate terminal states,
+# resumes interrupted sorts through the spill-manifest/part checkpoints
+# (byte-identical), and marks anything unresumable "lost" instead of
+# forgetting it.  Unset = no journal (jobs die with the process).
+SERVE_JOURNAL = "hadoopbam.serve.journal"
 # Pre-compile the pow2 geometry buckets of the device kernels at daemon
 # startup (serve/warmup.py) so first-request latency is warm; "false"
 # skips the warm-up (first requests then pay the compiles).

@@ -20,10 +20,10 @@ from __future__ import annotations
 import os
 from typing import Optional, Union
 
-from .plan import Directive, FaultPlan
+from .plan import Directive, FaultPlan, InjectedResourceExhausted
 
-__all__ = ["ACTIVE", "Directive", "FaultPlan", "arm", "arm_from_conf",
-           "arm_from_env", "disarm"]
+__all__ = ["ACTIVE", "Directive", "FaultPlan", "InjectedResourceExhausted",
+           "arm", "arm_from_conf", "arm_from_env", "disarm"]
 
 #: The armed plan, or None (the common case — seams check this and stop).
 ACTIVE: Optional[FaultPlan] = None

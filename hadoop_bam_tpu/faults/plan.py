@@ -58,6 +58,11 @@ Directive reference:
 ``serve.drop``       close the connection without replying; ``op``
                      (request-op filter), ``n``.
 ``serve.stall``      sleep ``ms`` before replying; ``op``, ``n``.
+``arena.oom``        raise a device ``RESOURCE_EXHAUSTED`` stand-in at a
+                     device-allocation seam (the lane batcher's shared
+                     decode, the codec-tier launches) — drives the serve
+                     layer's evict-retry-tierdown OOM path
+                     deterministically; ``n``.
 ===================  =====================================================
 
 Match sets: ``*`` (any), ``3``, ``0-2``, ``1,4,7``.
@@ -94,9 +99,27 @@ _SITES = frozenset(
         "exec.die",
         "serve.drop",
         "serve.stall",
+        "arena.oom",
     )
 )
 _UNLIMITED = -1
+
+
+class InjectedResourceExhausted(MemoryError):
+    """The ``arena.oom`` directive's device-OOM stand-in.
+
+    Real device exhaustion surfaces as an ``XlaRuntimeError`` whose
+    message carries ``RESOURCE_EXHAUSTED``; this class reproduces that
+    shape (``utils.backend.is_resource_exhausted`` matches both), so the
+    recovery path proven against the injection is the one a real OOM
+    takes.
+    """
+
+    def __init__(self, site: str = "device"):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device allocation failure "
+            f"at {site} (arena.oom fault directive)"
+        )
 
 
 def _match(spec: Optional[str], value) -> bool:
@@ -290,6 +313,12 @@ class FaultPlan:
             raise RuntimeError(
                 f"injected crash for item {item} attempt {attempt}"
             )
+
+    def arena_oom(self, site: str = "device") -> bool:
+        """The device-allocation seam: fire = raise-an-OOM-now.  Callers
+        raise :class:`InjectedResourceExhausted` so the failure travels
+        the exact path a real ``RESOURCE_EXHAUSTED`` would."""
+        return self._fire("arena.oom", where=site) is not None
 
     def serve_action(self, op: Optional[str]) -> Optional[Dict]:
         """The serve-socket seam: ``{"action": "drop"}`` (close without a

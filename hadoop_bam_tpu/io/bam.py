@@ -721,10 +721,16 @@ def read_virtual_range(
                 )
                 dev_cell[0] = dev
                 return out, offs
-            except Exception:
+            except Exception as e:
                 # Device tier failure is never fatal to a read — tier
                 # down to the native host codec for the whole window.
                 METRICS.count("bam.device_inflate_fallback", 1)
+                from ..utils.backend import is_resource_exhausted
+
+                if is_resource_exhausted(e):
+                    # HBM exhaustion (not a decode bug): itemized so the
+                    # OOM degradation path is auditable end to end.
+                    METRICS.count("bam.oom_tierdown", 1)
         return native.inflate_blocks(
             data,
             np.asarray(co, dtype=np.int64),

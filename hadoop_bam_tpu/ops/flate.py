@@ -1226,8 +1226,16 @@ def _lanes_decode_members(
         out_l, ok_l, dev = inflate_lanes_ex(
             comp, clens, isz, keep_device=keep_device
         )
-    except Exception:
+    except Exception as e:
         METRICS.count("flate.lanes_launch_error", 1)
+        from ..utils.backend import is_resource_exhausted
+
+        if is_resource_exhausted(e):
+            # Device memory exhausted is a *capacity* failure, not a
+            # decode failure: counted separately so the serve layer's
+            # OOM degradation (and the run manifest) can tell "HBM was
+            # full" from "the kernel rejected the member".
+            METRICS.count("flate.oom_tierdown", 1)
         if stats is not None:
             stats.tierdown_ok0 += len(idx)
         return {}, len(idx), None

@@ -46,6 +46,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from .. import faults
 from ..utils import nio
+from ..utils.deadline import Deadline, DeadlineExceeded
 from ..utils.tracing import METRICS
 
 
@@ -101,6 +102,7 @@ class ElasticExecutor:
         retry_backoff: float = 0.0,
         quarantine: bool = False,
         validate_part: Optional[Callable[[str], bool]] = None,
+        deadline: Optional[Deadline] = None,
     ) -> None:
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
@@ -114,6 +116,11 @@ class ElasticExecutor:
         self.retry_backoff = retry_backoff
         self.quarantine = quarantine
         self.validate_part = validate_part
+        # The request's end-to-end deadline (serve jobs thread it here):
+        # checked before every attempt and composed with attempt_timeout
+        # (the per-attempt watchdog never outlives the overall budget).
+        # None — the batch CLI's case — is one branch per attempt.
+        self.deadline = deadline
 
     def _backoff(self, item: int, attempt: int) -> None:
         """Exponential backoff before retry ``attempt`` (≥1) of ``item``,
@@ -126,12 +133,28 @@ class ElasticExecutor:
         time.sleep(base * jitter)
 
     def _run_attempt(self, work_fn, item, tmp: str) -> None:
-        """One attempt, under the optional wall-clock deadline.  With a
-        deadline, the work runs in a watchdog thread: on expiry the
-        attempt is *recorded* failed and retried while the stuck thread is
+        """One attempt, under the optional wall-clock bounds.  With a
+        bound, the work runs in a watchdog thread: on expiry the attempt
+        is *recorded* failed and retried while the stuck thread is
         abandoned (its tmp name is attempt-unique, so a zombie completing
-        late can never clobber a newer attempt's rename)."""
-        if self.attempt_timeout is None:
+        late can never clobber a newer attempt's rename).
+
+        Two bounds compose: the per-attempt ``attempt_timeout`` (Hadoop's
+        task-timeout stance — expiry is retried) and the request-scoped
+        ``deadline`` (expiry is terminal: the watchdog waits only the
+        remaining budget and raises ``DeadlineExceeded``, which the
+        attempt loop does NOT retry — retrying cannot buy time back)."""
+        timeout = self.attempt_timeout
+        if self.deadline is not None:
+            if self.deadline.expired:
+                # Never *start* work on a spent budget (an injected
+                # pre-attempt stall — exec.delay — must not slip a
+                # sub-millisecond attempt through the watchdog window).
+                METRICS.count("executor.deadline_exceeded", 1)
+                self.deadline.check("executor")  # raises
+            remaining = max(self.deadline.remaining_ms() / 1e3, 0.001)
+            timeout = remaining if timeout is None else min(timeout, remaining)
+        if timeout is None:
             work_fn(item, tmp)
             return
         box: List = [None]
@@ -144,8 +167,11 @@ class ElasticExecutor:
 
         t = threading.Thread(target=target, daemon=True)
         t.start()
-        t.join(self.attempt_timeout)
+        t.join(timeout)
         if t.is_alive():
+            if self.deadline is not None and self.deadline.expired:
+                METRICS.count("executor.deadline_exceeded", 1)
+                self.deadline.check("executor")  # raises DeadlineExceeded
             METRICS.count("executor.attempt_timeouts", 1)
             raise AttemptTimeout(
                 f"attempt exceeded deadline of {self.attempt_timeout}s"
@@ -189,6 +215,12 @@ class ElasticExecutor:
                     pass
             errs: List[str] = []
             for attempt in range(self.max_attempts):
+                if self.deadline is not None and self.deadline.expired:
+                    # Terminal, not a retryable attempt failure: the
+                    # request's budget is gone, so further attempts only
+                    # burn device time nobody will wait for.
+                    METRICS.count("executor.deadline_exceeded", 1)
+                    self.deadline.check("executor")  # raises
                 # Hadoop's _temporary convention: the leading underscore
                 # keeps in-flight attempts invisible to the part-[mr]-* glob
                 # the mergers use (util/NIOFileUtil.java:24).
@@ -220,6 +252,8 @@ class ElasticExecutor:
                                 os.remove(os.path.join(self.out_dir, fn))
                             except OSError:
                                 pass
+                    if isinstance(e, DeadlineExceeded):
+                        raise  # terminal (see above); tmp already swept
             with lock:
                 failures[i] = errs
 

@@ -105,6 +105,7 @@ def sort_bam(
     resource_cache=None,
     errors: Optional[str] = None,
     sort_order: Optional[str] = None,
+    deadline=None,
 ) -> SortStats:
     """Sort BAM file(s) into one merged BAM.
 
@@ -176,6 +177,14 @@ def sort_bam(
     job (:mod:`hadoop_bam_tpu.dedup`), and the part writes OR
     ``FLAG_DUPLICATE`` into each duplicate's flag bytes just before
     deflate.  Works on every sort path, including ``memory_budget`` —
+
+    ``deadline`` (a :class:`utils.deadline.Deadline`) is the request's
+    end-to-end budget — the serve daemon threads it from the client's
+    ``deadline_ms``.  It is checked at the phase boundaries and before
+    every part-write attempt (the elastic executor composes it with
+    ``attempt-timeout-ms``); expiry raises ``DeadlineExceeded`` instead
+    of burning device time.  None (the batch default) costs one branch
+    per seam.
     there the record *bytes* stay budget-bounded while the signature
     columns (~18 bytes/record, like samtools markdup's per-read state)
     stay in memory.
@@ -309,6 +318,7 @@ def sort_bam(
             retry_backoff=exec_backoff,
             sort_order=sort_order,
             key_column=key_column,
+            deadline=deadline,
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -591,6 +601,7 @@ def sort_bam(
             quarantine=errors == "salvage",
             attempt_timeout=exec_timeout,
             retry_backoff=exec_backoff,
+            deadline=deadline,
         )
         # Split the native deflate thread budget across concurrent writers.
         deflate_threads = max(
@@ -1224,6 +1235,7 @@ def _sort_bam_external(
     retry_backoff: float = 0.05,
     sort_order: str = "coordinate",
     key_column: Optional[np.ndarray] = None,
+    deadline=None,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -1438,6 +1450,11 @@ def _sort_bam_external(
             METRICS.count("sort_bam.duplicates", n_dup)
 
         # ---- Phase 2: exact key-range merge ------------------------------
+        if deadline is not None:
+            # Phase boundary: the spill runs just written are durable
+            # checkpoints, so expiring here loses nothing a resume can't
+            # reuse — the cheapest possible place to stop.
+            deadline.check("pipeline")
         runs = [Run.open(spill_dir, k) for k in range(run_count)]
         with span("sort_bam.plan_ranges"):
             ranges = plan_ranges(runs, memory_budget) if runs else []
@@ -1455,6 +1472,7 @@ def _sort_bam_external(
             quarantine=errors == "salvage",
             attempt_timeout=attempt_timeout,
             retry_backoff=retry_backoff,
+            deadline=deadline,
         )
         deflate_threads = max(
             1, (os.cpu_count() or 4) // executor.max_workers

@@ -25,23 +25,42 @@ admitted requests overlapping in-flight device work):
   subcommands.
 """
 
+from .admission import (
+    ERROR_CODES,
+    AdmissionController,
+    ShedError,
+)
 from .arena import HbmArena
 from .batching import LaneBatcher
 from .cache import LruByteCache, ResourceCache, file_identity
-from .client import ServeClient, ServeError
+from .client import (
+    DeadlineExceededError,
+    JobLostError,
+    ServeClient,
+    ServeError,
+    ServeShedError,
+)
 from .endpoints import ServeContext, flagstat, view_blob, view_records
+from .journal import JobJournal
 from .server import BamDaemon, default_socket_path
 from .warmup import compile_count, ensure_compile_watcher, warm_kernels
 
 __all__ = [
+    "AdmissionController",
     "BamDaemon",
+    "DeadlineExceededError",
+    "ERROR_CODES",
     "HbmArena",
+    "JobJournal",
+    "JobLostError",
     "LaneBatcher",
     "LruByteCache",
     "ResourceCache",
     "ServeClient",
     "ServeContext",
     "ServeError",
+    "ServeShedError",
+    "ShedError",
     "compile_count",
     "default_socket_path",
     "ensure_compile_watcher",

@@ -81,6 +81,23 @@ class HbmArena:
                 self.used_bytes -= nb_old
                 METRICS.count(f"{self.name}.evict", 1)
 
+    def evict_lru(self, n: int = 1) -> int:
+        """Forcibly drop the ``n`` least-recently-used entries — the OOM
+        recovery lever: on a device ``RESOURCE_EXHAUSTED`` the serve
+        layer evicts residency (freeing HBM with the dropped references)
+        and retries once before tiering the request down to the host
+        path.  Returns how many entries were dropped (0 when empty);
+        counts ``serve.oom.evictions`` per entry."""
+        dropped = 0
+        with self._lock:
+            while self._entries and dropped < n:
+                _, (nb, _) = self._entries.popitem(last=False)
+                self.used_bytes -= nb
+                dropped += 1
+        if dropped:
+            METRICS.count("serve.oom.evictions", dropped)
+        return dropped
+
     def release_all(self) -> None:
         """Drop everything (daemon drain: HBM frees with the references)."""
         with self._lock:

@@ -26,6 +26,8 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults
+from ..utils.deadline import Deadline, DeadlineExceeded
 from ..utils.tracing import METRICS
 
 #: Lane capacity of one lockstep codec launch (ops/pallas/inflate_lanes.py).
@@ -54,9 +56,11 @@ def default_decode_fn(conf=None) -> Callable:
 
 
 class _Pending:
-    __slots__ = ("raw", "co", "cs", "us", "out", "offs", "err", "done")
+    __slots__ = (
+        "raw", "co", "cs", "us", "out", "offs", "err", "done", "deadline",
+    )
 
-    def __init__(self, raw, co, cs, us):
+    def __init__(self, raw, co, cs, us, deadline=None):
         self.raw = raw
         self.co = co
         self.cs = cs
@@ -65,6 +69,7 @@ class _Pending:
         self.offs = None
         self.err: Optional[BaseException] = None
         self.done = threading.Event()
+        self.deadline: Optional[Deadline] = deadline
 
     @property
     def n_members(self) -> int:
@@ -109,12 +114,21 @@ class LaneBatcher:
         coffsets: np.ndarray,
         csizes: np.ndarray,
         usizes: np.ndarray,
+        deadline: Optional[Deadline] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Blockingly decode one request's members; same contract as
         ``native.inflate_blocks``: ``(out, out_offsets)`` with member i's
-        payload at ``out[out_offsets[i]:out_offsets[i+1]]``."""
+        payload at ``out[out_offsets[i]:out_offsets[i+1]]``.
+
+        ``deadline`` (the request's end-to-end budget) is checked at
+        admission and again when the worker drains the queue: a request
+        whose deadline expired while waiting for a launch window is
+        failed with ``DeadlineExceeded`` and never occupies a lane —
+        expired work must not burn a shared launch."""
         if self._closed:
             raise RuntimeError("LaneBatcher is closed")
+        if deadline is not None:
+            deadline.check("batcher")
         raw_a = (
             raw
             if isinstance(raw, np.ndarray)
@@ -125,6 +139,7 @@ class LaneBatcher:
             np.asarray(coffsets, dtype=np.int64),
             np.asarray(csizes, dtype=np.int32),
             np.asarray(usizes, dtype=np.int32),
+            deadline=deadline,
         )
         with self._lock:
             self._queue.append(p)
@@ -157,6 +172,7 @@ class LaneBatcher:
             # arrival before launching.
             if self.window_s:
                 time.sleep(self.window_s)
+            expired: List[_Pending] = []
             with self._lock:
                 if not self._queue:
                     self._wake.clear()
@@ -165,16 +181,38 @@ class LaneBatcher:
                 lanes = 0
                 while self._queue:
                     nxt = self._queue[0]
+                    if (
+                        nxt.deadline is not None
+                        and nxt.deadline.expired
+                    ):
+                        # Dead on arrival at the launch: fail it out of
+                        # band, never spend a lane on it.
+                        expired.append(self._queue.pop(0))
+                        continue
                     if batch and lanes + nxt.n_members > self.max_lanes:
                         break  # next launch takes it (capacity packing)
                     batch.append(self._queue.pop(0))
                     lanes += nxt.n_members
                 if not self._queue:
                     self._wake.clear()
-            self._launch(batch)
+            for p in expired:
+                try:
+                    p.deadline.check("batcher")
+                except DeadlineExceeded as e:
+                    p.err = e
+                p.done.set()
+            if batch:
+                self._launch(batch)
 
     def _launch(self, batch: List[_Pending]) -> None:
         try:
+            if faults.ACTIVE is not None and faults.ACTIVE.arena_oom(
+                "lane_batcher"
+            ):
+                # The deterministic device-OOM drill: surfaces to every
+                # waiter exactly like a real RESOURCE_EXHAUSTED from the
+                # decode launch would.
+                raise faults.InjectedResourceExhausted("lane_batcher")
             # One synthetic stream: each member's compressed bytes are
             # self-contained, so back-to-back concatenation is a valid
             # input for any of the decode tiers.

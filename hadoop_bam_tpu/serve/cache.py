@@ -32,6 +32,18 @@ def file_identity(path: str) -> FileIdentity:
     return (path, st.st_size, st.st_mtime_ns)
 
 
+class _Flight:
+    """One in-flight load: waiters block on ``done`` and read
+    ``value``/``err`` — the stampede-dedup rendezvous."""
+
+    __slots__ = ("done", "value", "err")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.value: Any = None
+        self.err: Optional[BaseException] = None
+
+
 class LruByteCache:
     """Thread-safe identity-validating LRU cache under a byte budget."""
 
@@ -46,6 +58,10 @@ class LruByteCache:
             OrderedDict()
         )
         self.used_bytes = 0
+        # Per-key in-flight loads (stampede dedup): one loader runs per
+        # (kind, path) at a time; concurrent misses wait and share.
+        self._inflight_lock = threading.Lock()
+        self._inflight: dict = {}
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -110,17 +126,48 @@ class LruByteCache:
         loader: Callable[[str], Any],
         sizer: Callable[[Any], int],
     ):
-        """get() falling through to ``loader(path)`` + put().  The load
-        runs outside the cache lock (loads can be slow I/O); concurrent
-        misses may load twice and last-put wins — both copies are valid,
-        so this trades a rare duplicate load for zero lock-hold I/O."""
+        """get() falling through to ``loader(path)`` + put().
+
+        The load runs outside the cache lock (loads can be slow I/O) but
+        is **deduplicated per key**: concurrent misses on the same
+        ``(kind, path)`` used to each run the loader (a cache stampede —
+        N clients hitting a cold index paid N full index reads); now the
+        first miss is the leader, the rest wait on its completion event
+        and share the result (``serve.cache.stampede_wait`` counts the
+        waiters).  A failing load propagates its exception to every
+        waiter of that flight; the next request starts a fresh flight.
+        """
         ident = file_identity(path)
         v = self.get(kind, path, identity=ident)
         if v is not None:
             return v
-        v = loader(path)
-        self.put(kind, path, v, sizer(v), identity=ident)
-        return v
+        key = (kind, path)
+        with self._inflight_lock:
+            flight = self._inflight.get(key)
+            leader = flight is None
+            if leader:
+                flight = self._inflight[key] = _Flight()
+        if not leader:
+            METRICS.count(f"{self.name}.stampede_wait", 1)
+            flight.done.wait()
+            if flight.err is not None:
+                raise flight.err
+            return flight.value
+        try:
+            # Identity re-read under leadership: the file may have been
+            # rewritten between our miss and winning the flight.
+            ident = file_identity(path)
+            v = loader(path)
+            self.put(kind, path, v, sizer(v), identity=ident)
+            flight.value = v
+            return v
+        except BaseException as e:
+            flight.err = e
+            raise
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(key, None)
+            flight.done.set()
 
     def stats(self) -> dict:
         with self._lock:

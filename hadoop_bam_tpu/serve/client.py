@@ -25,11 +25,16 @@ import socket
 import time
 from typing import Optional
 
+from ..utils.deadline import Deadline
+from .admission import DEADLINE_EXCEEDED, JOB_LOST, RETRY_AFTER, SHED
 from .server import recv_msg, send_msg
 
 
 class ServeError(RuntimeError):
-    """The daemon replied ok=false (the error string is the message)."""
+    """The daemon replied ok=false (the error string is the message).
+    ``code`` carries the protocol error code when the reply had one."""
+
+    code: Optional[str] = None
 
 
 class ServeConnectionError(ServeError, ConnectionError):
@@ -37,6 +42,59 @@ class ServeConnectionError(ServeError, ConnectionError):
     retryable class; the daemon may be fine and merely mid-drain.  Also a
     ``ConnectionError`` so pre-existing callers catching ``OSError`` for
     connection trouble keep working."""
+
+
+class ServeShedError(ServeError):
+    """The daemon refused to admit the request (codes ``SHED`` /
+    ``RETRY_AFTER``).  ``retry_after_ms`` is the server-computed backoff
+    hint; idempotent requests honor it automatically."""
+
+    def __init__(self, message: str, code: str = SHED, retry_after_ms: int = 50):
+        super().__init__(message)
+        self.code = code
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class DeadlineExceededError(ServeError):
+    """The request's end-to-end deadline expired (server- or client-side
+    detected).  Never auto-retried — the budget is spent."""
+
+    code = DEADLINE_EXCEEDED
+
+
+class JobLostError(ServeError):
+    """The daemon does not know this job id (code ``JOB_LOST``): it
+    restarted and the journal could not account for the job, or the id
+    never existed.  Terminal — ``wait`` raises it instead of polling an
+    id that can never resolve."""
+
+    code = JOB_LOST
+
+
+#: code → typed exception; tests assert this map covers every code the
+#: server can emit (``admission.ERROR_CODES``), so new codes cannot
+#: silently degrade to the untyped ServeError.
+_CODE_ERRORS = {
+    SHED: ServeShedError,
+    RETRY_AFTER: ServeShedError,
+    DEADLINE_EXCEEDED: DeadlineExceededError,
+    JOB_LOST: JobLostError,
+}
+
+
+def error_from_reply(reply: dict) -> ServeError:
+    """The typed exception for an ``ok: false`` reply (the client half of
+    the error-code round trip)."""
+    msg = reply.get("error", "unknown daemon error")
+    code = reply.get("code")
+    cls = _CODE_ERRORS.get(code)
+    if cls is ServeShedError:
+        return ServeShedError(
+            msg, code=code, retry_after_ms=reply.get("retry_after_ms", 50)
+        )
+    if cls is not None:
+        return cls(msg)
+    return ServeError(msg)
 
 
 #: Exceptions worth retrying at the transport layer.  ``socket.timeout``
@@ -85,17 +143,46 @@ class ServeClient:
                 "daemon closed the connection without a reply"
             )
         if not reply.get("ok"):
-            raise ServeError(reply.get("error", "unknown daemon error"))
+            raise error_from_reply(reply)
         return reply
 
-    def _request(self, obj: dict, idempotent: bool = False) -> dict:
+    def _request(
+        self,
+        obj: dict,
+        idempotent: bool = False,
+        deadline: Optional[Deadline] = None,
+    ) -> dict:
         """One request; idempotent ones retry transport failures with
-        exponential backoff (``retries`` attempts beyond the first)."""
+        exponential backoff (``retries`` attempts beyond the first) and
+        shed replies by the server's ``retry_after_ms`` hint.
+
+        ``DEADLINE_EXCEEDED`` and ``JOB_LOST`` replies are never retried
+        (terminal by definition).  With a ``deadline``, each attempt
+        sends the *remaining* budget as ``deadline_ms`` and the retry
+        loop itself stops — with :class:`DeadlineExceededError` — once
+        the budget is spent, so a client deadline bounds the whole
+        exchange, retries included.
+        """
         attempts = (self.retries + 1) if idempotent else 1
         last: Optional[Exception] = None
         for attempt in range(attempts):
+            if deadline is not None:
+                rem = deadline.remaining_ms()
+                if rem <= 0:
+                    raise DeadlineExceededError(
+                        "client deadline expired "
+                        + ("before the request" if attempt == 0
+                           else "between retries")
+                    )
+                obj = {**obj, "deadline_ms": rem}
+            pause = self.retry_backoff * (2 ** attempt)
             try:
                 return self._request_once(obj)
+            except ServeShedError as e:
+                if not idempotent:
+                    raise  # a shed sort must stay the caller's decision
+                last = e
+                pause = max(pause, e.retry_after_ms / 1e3)
             except ServeError as e:
                 if not isinstance(e, ServeConnectionError):
                     raise  # a real daemon reply: never retry
@@ -103,7 +190,7 @@ class ServeClient:
             except _RETRYABLE as e:
                 last = e
             if attempt + 1 < attempts:
-                time.sleep(self.retry_backoff * (2 ** attempt))
+                time.sleep(pause)
         assert last is not None
         raise (
             last
@@ -113,29 +200,53 @@ class ServeClient:
 
     # -- ops ----------------------------------------------------------------
 
+    @staticmethod
+    def _deadline(deadline_ms: Optional[float]) -> Optional[Deadline]:
+        return None if deadline_ms is None else Deadline.after_ms(deadline_ms)
+
     def ping(self) -> dict:
         return self._request({"op": "ping"}, idempotent=True)
 
-    def view(self, path: str, region: str, level: int = 6) -> bytes:
-        """The region's records as a complete small BAM (bytes)."""
+    def view(
+        self,
+        path: str,
+        region: str,
+        level: int = 6,
+        deadline_ms: Optional[float] = None,
+    ) -> bytes:
+        """The region's records as a complete small BAM (bytes).
+        ``deadline_ms`` is the end-to-end budget: the daemon cancels the
+        work at its next seam once it expires (``DeadlineExceededError``)
+        instead of finishing an answer nobody will read."""
         r = self._request(
             {"op": "view", "path": path, "region": region, "level": level},
             idempotent=True,
+            deadline=self._deadline(deadline_ms),
         )
         return base64.b64decode(r["data_b64"])
 
-    def flagstat(self, path: str) -> dict:
+    def flagstat(
+        self, path: str, deadline_ms: Optional[float] = None
+    ) -> dict:
         return self._request(
-            {"op": "flagstat", "path": path}, idempotent=True
+            {"op": "flagstat", "path": path},
+            idempotent=True,
+            deadline=self._deadline(deadline_ms),
         )["counts"]
 
-    def sort(self, bam, output: str, **kwargs) -> str:
+    def sort(
+        self, bam, output: str, deadline_ms: Optional[float] = None, **kwargs
+    ) -> str:
         """Submit a sort; returns the job id (poll with :meth:`job` or
         block with :meth:`wait`).  Deliberately not auto-retried — a
-        resubmitted request is a *second* job."""
+        resubmitted request is a *second* job.  ``deadline_ms`` bounds
+        the whole *job* server-side (the pipeline checks it down to the
+        part-write attempt loop)."""
         req = {"op": "sort", "bam": bam, "output": output}
         req.update(kwargs)
-        return self._request(req)["job"]
+        return self._request(req, deadline=self._deadline(deadline_ms))[
+            "job"
+        ]
 
     def job(self, job_id: str) -> dict:
         return self._request({"op": "job", "id": job_id}, idempotent=True)
@@ -147,6 +258,7 @@ class ServeClient:
         poll_s: float = 0.05,
         poll_max: float = 1.0,
         max_poll_errors: int = 5,
+        deadline_ms: Optional[float] = None,
     ) -> dict:
         """Poll a submitted job to completion; raises on job failure.
 
@@ -155,7 +267,16 @@ class ServeClient:
         daemon in lockstep), and a streak of up to ``max_poll_errors``
         retryable transport errors — reset connections, stalled reads —
         is ridden out with the same backoff instead of aborting a job
-        that is still running server-side."""
+        that is still running server-side.
+
+        Two loss bounds (the old loop could poll a dead id forever at
+        1 Hz): a ``JOB_LOST`` reply — or a journal-replayed ``lost``
+        status — raises the typed :class:`JobLostError` immediately, and
+        ``deadline_ms`` (the client's own end-to-end budget) caps the
+        polling wall clock with :class:`DeadlineExceededError` on top of
+        ``timeout``'s plain :class:`TimeoutError`.
+        """
+        client_dl = self._deadline(deadline_ms)
         deadline = time.monotonic() + timeout
         delay = poll_s
         errors_in_a_row = 0
@@ -163,6 +284,8 @@ class ServeClient:
             try:
                 st = self.job(job_id)
                 errors_in_a_row = 0
+            except JobLostError:
+                raise  # terminal: the daemon does not know this job
             except _RETRYABLE as e:
                 errors_in_a_row += 1
                 if errors_in_a_row > max_poll_errors:
@@ -174,8 +297,20 @@ class ServeClient:
             if st is not None:
                 if st["status"] == "done":
                     return st
+                if st["status"] == "lost":
+                    raise JobLostError(
+                        st.get("error", f"job {job_id} lost by the daemon")
+                    )
                 if st["status"] == "failed":
-                    raise ServeError(st.get("error", "job failed"))
+                    raise error_from_reply(
+                        {"code": st.get("code"),
+                         "error": st.get("error", "job failed")}
+                    )
+            if client_dl is not None and client_dl.expired:
+                raise DeadlineExceededError(
+                    f"job {job_id} not done within the client deadline "
+                    f"({deadline_ms:.0f} ms)"
+                )
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"job {job_id} not done after {timeout}s"

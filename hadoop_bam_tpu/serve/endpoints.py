@@ -29,6 +29,8 @@ from ..conf import (
     SERVE_CACHE_BYTES,
 )
 from ..spec import bam, bgzf
+from ..utils.backend import is_resource_exhausted
+from ..utils.deadline import Deadline, current_deadline
 from ..utils.intervals import MAX_END, FormatError, parse_interval
 from ..utils.tracing import METRICS, span
 from .arena import HbmArena
@@ -91,10 +93,48 @@ class ServeContext:
         self.arena.release_all()
 
     def _inflate_fn(self):
+        """The read path's member-inflate hook: the cross-request lane
+        batcher, wrapped in the OOM degradation ladder.
+
+        A device ``RESOURCE_EXHAUSTED`` (real, or the ``arena.oom``
+        fault directive) never kills the daemon: first the arena's LRU
+        residency is evicted — freeing HBM with the dropped references —
+        and the shared launch retried once; if the device is still
+        exhausted, *this request* tiers down to the native host codec
+        (``serve.oom.tierdowns``) while every other request keeps its
+        device tier.  The ambient request deadline rides into the
+        batcher so queued-but-expired work is cancelled, not launched.
+        """
         if self.batcher is None:
             return None
         b = self.batcher
-        return lambda raw, co, cs, us: b.submit(raw, co, cs, us)
+        arena = self.arena
+
+        def inflate(raw, co, cs, us):
+            d = current_deadline()
+            try:
+                return b.submit(raw, co, cs, us, deadline=d)
+            except Exception as e:
+                if not is_resource_exhausted(e):
+                    raise
+            arena.evict_lru()
+            try:
+                return b.submit(raw, co, cs, us, deadline=d)
+            except Exception as e:
+                if not is_resource_exhausted(e):
+                    raise
+            METRICS.count("serve.oom.tierdowns", 1)
+            from .. import native
+
+            return native.inflate_blocks(
+                raw if isinstance(raw, np.ndarray)
+                else np.frombuffer(raw, dtype=np.uint8),
+                np.asarray(co, dtype=np.int64),
+                np.asarray(cs, dtype=np.int32),
+                np.asarray(us, dtype=np.int32),
+            )
+
+        return inflate
 
 
 def _pow2_rows(n: int) -> int:
@@ -152,13 +192,17 @@ def _overlap_rows(batch, rid: int, beg0: int, end0: int) -> np.ndarray:
 
 
 def view_records(
-    ctx: ServeContext, path: str, region: str
+    ctx: ServeContext, path: str, region: str,
+    deadline: Optional[Deadline] = None,
 ) -> Tuple[bam.BamHeader, List[Tuple[object, np.ndarray]]]:
     """Resolve a ranged query to (header, [(decoded window, row indices)]).
 
     Windows come from the residency arena when warm; a miss reads the
     chunk span through the lane batcher (shared launches with concurrent
-    requests) and holds the decoded batch for the next hit.
+    requests) and holds the decoded batch for the next hit.  ``deadline``
+    is checked per chunk window (the endpoint seam) — a request that
+    expires mid-query stops decoding instead of finishing an answer
+    nobody will read.
     """
     iv = parse_interval(region)
     hdr, _ = ctx.cache.header(path)
@@ -179,6 +223,8 @@ def view_records(
 
     fmt = BamInputFormat(ctx.conf)
     for c in chunks:
+        if deadline is not None:
+            deadline.check("endpoint")
         key = ("view", ident, c.beg, c.end)
         batch = ctx.arena.get(key)
         if batch is None:
@@ -197,7 +243,8 @@ def view_records(
 
 
 def view_blob(
-    ctx: ServeContext, path: str, region: str, level: int = 6
+    ctx: ServeContext, path: str, region: str, level: int = 6,
+    deadline: Optional[Deadline] = None,
 ) -> bytes:
     """A complete small BAM (header + overlapping records + terminator)
     for the requested region — records in file order, like samtools view.
@@ -210,7 +257,7 @@ def view_blob(
 
     t0 = _time.perf_counter()
     with span("serve.view"):
-        hdr, picks = view_records(ctx, path, region)
+        hdr, picks = view_records(ctx, path, region, deadline=deadline)
         payloads = [
             gather_record_array(batch, rows) for batch, rows in picks
         ]
@@ -247,7 +294,9 @@ FLAGSTAT_KEYS = (
 )
 
 
-def flagstat(ctx: ServeContext, path: str) -> dict:
+def flagstat(
+    ctx: ServeContext, path: str, deadline: Optional[Deadline] = None
+) -> dict:
     """Whole-file flag census (the flagstat-class scan endpoint).
 
     Splits stream through the same read path as the sort (flag column
@@ -265,6 +314,8 @@ def flagstat(ctx: ServeContext, path: str) -> dict:
         fmt = BamInputFormat(ctx.conf)
         counts = {k: 0 for k in FLAGSTAT_KEYS}
         for s in fmt.get_splits([path]):
+            if deadline is not None:
+                deadline.check("endpoint")
             key = ("flagstat", ident, s.vstart, s.vend)
             batch = ctx.arena.get(key)
             if batch is None:

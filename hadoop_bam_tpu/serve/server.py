@@ -27,6 +27,19 @@ Warm state (kernel jit caches via serve/warmup.py, header/index cache,
 HBM residency arena, the cross-request lane batcher) lives in one
 :class:`~hadoop_bam_tpu.serve.endpoints.ServeContext` for the daemon's
 lifetime — the whole point of being resident.
+
+Overload resilience (PR 10): every data-plane op passes the bounded
+admission layer (serve/admission.py) — overload gets a *typed* refusal
+(``code: SHED | RETRY_AFTER`` with a server-computed ``retry_after_ms``)
+instead of unbounded queueing; a request's ``deadline_ms`` becomes a
+:class:`~hadoop_bam_tpu.utils.deadline.Deadline` checked at every seam
+down to the executor attempt loop (``code: DEADLINE_EXCEEDED``); device
+``RESOURCE_EXHAUSTED`` degrades (arena evict → retry → host tier) rather
+than killing the daemon; and with a journal configured
+(serve/journal.py) job submissions/transitions survive a daemon crash —
+a restart reports accurate terminal states, resumes interrupted sorts
+byte-identically through the PR 7 checkpoints, and answers unknown ids
+with ``code: JOB_LOST``.  SIGTERM/SIGINT drain like the ``shutdown`` op.
 """
 
 from __future__ import annotations
@@ -34,6 +47,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import signal
 import socket
 import struct
 import tempfile
@@ -44,17 +58,32 @@ from typing import Dict, List, Optional, Tuple
 from .. import faults
 from ..conf import (
     Configuration,
+    SERVE_ADMISSION_TOKENS,
+    SERVE_JOURNAL,
     SERVE_MAX_INFLIGHT,
+    SERVE_MAX_QUEUE,
+    SERVE_MAX_QUEUE_MS,
     SERVE_PORT,
     SERVE_SOCKET,
     SERVE_WARMUP,
 )
+from ..utils.deadline import Deadline, DeadlineExceeded, deadline_scope
 from ..utils.tracing import (
     METRICS,
     delta,
     prometheus_text,
     snapshot,
     transfers_report,
+)
+from . import journal as journal_mod
+from .admission import (
+    DEADLINE_EXCEEDED,
+    DEFAULT_MAX_QUEUE,
+    DEFAULT_MAX_QUEUE_MS,
+    DEFAULT_TOKENS,
+    JOB_LOST,
+    AdmissionController,
+    ShedError,
 )
 from .endpoints import ServeContext, flagstat, view_blob
 
@@ -112,6 +141,7 @@ class BamDaemon:
         max_inflight: Optional[int] = None,
         warmup: Optional[bool] = None,
         warmup_kwargs: Optional[dict] = None,
+        journal_path: Optional[str] = None,
     ):
         self.conf = conf or Configuration()
         faults.arm_from_conf(self.conf)  # drills via hadoopbam.faults.plan
@@ -146,6 +176,23 @@ class BamDaemon:
             max_workers=self.max_inflight,
             thread_name_prefix="hbam-serve-job",
         )
+        # Admission control: the bounded front door for data-plane ops.
+        self.admission = AdmissionController(
+            tokens=self.conf.get_int(SERVE_ADMISSION_TOKENS, DEFAULT_TOKENS),
+            max_queue=self.conf.get_int(SERVE_MAX_QUEUE, DEFAULT_MAX_QUEUE),
+            max_queue_ms=self.conf.get_int(
+                SERVE_MAX_QUEUE_MS, DEFAULT_MAX_QUEUE_MS
+            ),
+        )
+        # Crash-safe job journal (None = jobs die with the process, the
+        # pre-PR-10 behavior; every journal touch below is one branch).
+        self.journal_path = journal_path or self.conf.get(SERVE_JOURNAL)
+        self._journal = (
+            journal_mod.JobJournal(self.journal_path)
+            if self.journal_path
+            else None
+        )
+        self._drain_requested = threading.Event()
         self._started_snapshot = snapshot()
 
     # -- lifecycle ----------------------------------------------------------
@@ -157,9 +204,13 @@ class BamDaemon:
         return {"host": self.host, "port": self.port}
 
     def start(self) -> None:
-        """Bind the listener and run the startup warm-up (idempotent)."""
+        """Bind the listener and run the startup warm-up (idempotent);
+        with a journal configured, replay it first so recovered jobs are
+        answerable from the first accepted connection."""
         if self._listener is not None:
             return
+        if self._journal is not None:
+            self._recover_journal()
         if self.warmup and self.warmup_report is None:
             from .warmup import warm_kernels
 
@@ -181,6 +232,74 @@ class BamDaemon:
         self._listener = lst
         METRICS.count("serve.daemon_starts", 1)
 
+    def _recover_journal(self) -> None:
+        """Replay the journal: restore terminal states, resume what the
+        PR 7 checkpoints can reproduce byte-identically, mark the rest
+        lost.  Never raises — recovery failure degrades to an empty job
+        table, not a daemon that won't start."""
+        try:
+            jobs = journal_mod.replay(self.journal_path)
+        except ValueError:
+            METRICS.count("serve.journal.corrupt", 1)
+            return
+        plan = journal_mod.recovery_plan(jobs)
+        seq = 0
+        with self._jobs_lock:
+            for jid, job in jobs.items():
+                # Ids look like job-0042; keep numbering past them so a
+                # resumed daemon never reuses a journaled id.
+                try:
+                    seq = max(seq, int(jid.rsplit("-", 1)[-1]))
+                except ValueError:
+                    pass
+                entry = {
+                    "status": job["status"],
+                    "output": (job.get("req") or {}).get("output"),
+                }
+                for k in ("stats", "error"):
+                    if k in job:
+                        entry[k] = job[k]
+                action = plan.get(jid)
+                if action == "lost":
+                    entry["status"] = "lost"
+                    entry["error"] = (
+                        "job interrupted by a daemon crash and not "
+                        "resumable (no part_dir checkpoint, or the "
+                        "input files changed)"
+                    )
+                    METRICS.count("serve.journal.lost", 1)
+                elif action == "resume":
+                    entry["status"] = "queued"
+                else:
+                    METRICS.count("serve.journal.replayed", 1)
+                self._jobs[jid] = entry
+            self._job_seq = max(self._job_seq, seq)
+        for jid, action in sorted(plan.items()):
+            if action != "resume":
+                continue
+            METRICS.count("serve.journal.resumed", 1)
+            if self._journal is not None:
+                self._journal.state(jid, "resumed")
+            self._job_pool.submit(
+                self._run_sort, jid, dict(jobs[jid]["req"])
+            )
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain, exactly like the ``shutdown``
+        op (finish in-flight jobs, then exit the accept loop).  A no-op
+        off the main thread (Python restricts signal handling there) —
+        the CLI calls this; embedded/test daemons use :meth:`stop`."""
+
+        def _handler(signum, frame):
+            METRICS.count("serve.signal_drains", 1)
+            self._drain_requested.set()
+
+        try:
+            signal.signal(signal.SIGTERM, _handler)
+            signal.signal(signal.SIGINT, _handler)
+        except ValueError:
+            pass  # not the main thread
+
     def serve_forever(self, ready: Optional[threading.Event] = None) -> None:
         """Blocking accept loop until a ``shutdown`` request (or
         :meth:`stop`).  ``ready`` is set once requests can connect —
@@ -190,6 +309,11 @@ class BamDaemon:
             ready.set()
         try:
             while not self._stop.is_set():
+                if self._drain_requested.is_set():
+                    # Signal-initiated drain: same semantics as the
+                    # shutdown op, minus a reply socket.
+                    self._drain()
+                    break
                 try:
                     conn, _ = self._listener.accept()
                 except socket.timeout:
@@ -224,6 +348,8 @@ class BamDaemon:
                 os.unlink(self.socket_path)
             except OSError:
                 pass
+        if self._journal is not None:
+            self._journal.close()
         self.ctx.close()
 
     # -- request handling ---------------------------------------------------
@@ -240,6 +366,23 @@ class BamDaemon:
                 t0 = _time.perf_counter()
                 try:
                     reply, stop_after = self._dispatch(req)
+                except ShedError as e:
+                    # Typed load shedding: the client gets the code AND
+                    # the server-computed backoff hint — overload is an
+                    # answer, not a timeout.
+                    reply = {
+                        "ok": False,
+                        "code": e.code,
+                        "error": str(e),
+                        "retry_after_ms": e.retry_after_ms,
+                    }
+                except DeadlineExceeded as e:
+                    reply = {
+                        "ok": False,
+                        "code": DEADLINE_EXCEEDED,
+                        "error": str(e),
+                        "seam": e.seam,
+                    }
                 except Exception as e:  # noqa: BLE001 - reply, don't die
                     METRICS.count("serve.request_errors", 1)
                     reply = {
@@ -275,6 +418,12 @@ class BamDaemon:
     def _dispatch(self, req: dict) -> Tuple[dict, bool]:
         op = req.get("op")
         METRICS.count(f"serve.op.{op}", 1)
+        # The end-to-end deadline, if the client sent one: checked here
+        # (dispatch seam) and carried through admission, the endpoint
+        # window loops, the lane batcher, and the executor attempt loop.
+        deadline = Deadline.from_request(req)
+        if deadline is not None:
+            deadline.check("dispatch")
         if op == "ping":
             return (
                 {
@@ -286,12 +435,15 @@ class BamDaemon:
                 False,
             )
         if op == "view":
-            blob = view_blob(
-                self.ctx,
-                req["path"],
-                req["region"],
-                level=int(req.get("level", 6)),
-            )
+            with self.admission.acquire(op, deadline=deadline), \
+                    deadline_scope(deadline):
+                blob = view_blob(
+                    self.ctx,
+                    req["path"],
+                    req["region"],
+                    level=int(req.get("level", 6)),
+                    deadline=deadline,
+                )
             return (
                 {
                     "ok": True,
@@ -300,16 +452,38 @@ class BamDaemon:
                 False,
             )
         if op == "flagstat":
-            return ({"ok": True, "counts": flagstat(self.ctx, req["path"])}, False)
+            with self.admission.acquire(op, deadline=deadline), \
+                    deadline_scope(deadline):
+                counts = flagstat(self.ctx, req["path"], deadline=deadline)
+            return ({"ok": True, "counts": counts}, False)
         if op == "sort":
             if self._draining.is_set():
                 return ({"ok": False, "error": "daemon is draining"}, False)
-            return ({"ok": True, "job": self._submit_sort(req)}, False)
+            # The job holds its admission tokens for its whole lifetime
+            # (released in _run_sort), so queued+running jobs weigh on
+            # the same budget concurrent views contend for.
+            ticket = self.admission.acquire(op, deadline=deadline)
+            try:
+                jid = self._submit_sort(req, ticket, deadline)
+            except BaseException:
+                ticket.release()
+                raise
+            return ({"ok": True, "job": jid}, False)
         if op == "job":
             with self._jobs_lock:
                 job = self._jobs.get(req.get("id"))
             if job is None:
-                return ({"ok": False, "error": "unknown job id"}, False)
+                # Typed: a restarted daemon without (or beyond) journal
+                # coverage must tell waiters the job is gone, not leave
+                # them polling an id that can never resolve.
+                return (
+                    {
+                        "ok": False,
+                        "code": JOB_LOST,
+                        "error": f"unknown job id {req.get('id')!r}",
+                    },
+                    False,
+                )
             return ({"ok": True, **job}, False)
         if op == "stats":
             return ({"ok": True, **self._stats()}, False)
@@ -333,7 +507,9 @@ class BamDaemon:
 
     # -- sort jobs ----------------------------------------------------------
 
-    def _submit_sort(self, req: dict) -> str:
+    def _submit_sort(
+        self, req: dict, ticket=None, deadline: Optional[Deadline] = None
+    ) -> str:
         with self._jobs_lock:
             self._job_seq += 1
             jid = f"job-{self._job_seq:04d}"
@@ -341,13 +517,39 @@ class BamDaemon:
                 "status": "queued",
                 "output": req.get("output"),
             }
-        self._job_pool.submit(self._run_sort, jid, dict(req))
+        if self._journal is not None:
+            # Durable before the pool sees it: a crash between this
+            # append and the submit leaves a journaled job the restart
+            # resumes (or reports lost) — never one nobody remembers.
+            paths = req.get("bam")
+            if isinstance(paths, str):
+                paths = [paths]
+            self._journal.submit(
+                jid,
+                {k: v for k, v in req.items() if k != "op"},
+                journal_mod.input_identity(list(paths or [])),
+            )
+        self._job_pool.submit(self._run_sort, jid, dict(req), ticket, deadline)
         METRICS.count("serve.jobs_submitted", 1)
         return jid
 
-    def _run_sort(self, jid: str, req: dict) -> None:
+    def _journal_state(self, jid: str, status: str, **extra) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.state(jid, status, **extra)
+            except OSError:
+                METRICS.count("serve.journal.append_errors", 1)
+
+    def _run_sort(
+        self,
+        jid: str,
+        req: dict,
+        ticket=None,
+        deadline: Optional[Deadline] = None,
+    ) -> None:
         with self._jobs_lock:
             self._jobs[jid]["status"] = "running"
+        self._journal_state(jid, "running")
         try:
             from ..pipeline import sort_bam
 
@@ -360,26 +562,38 @@ class BamDaemon:
                 conf=self.conf,
                 level=int(req.get("level", 6)),
                 memory_budget=req.get("memory_budget"),
+                part_dir=req.get("part_dir"),
                 write_splitting_bai=bool(req.get("write_splitting_bai")),
                 mark_duplicates=bool(req.get("mark_duplicates")),
+                sort_order=req.get("sort_order"),
                 resource_cache=self.ctx.cache,
+                deadline=deadline,
             )
+            stats_d = {
+                "n_records": stats.n_records,
+                "n_splits": stats.n_splits,
+                "backend": stats.backend,
+                "n_duplicates": stats.n_duplicates,
+            }
             with self._jobs_lock:
-                self._jobs[jid].update(
-                    status="done",
-                    stats={
-                        "n_records": stats.n_records,
-                        "n_splits": stats.n_splits,
-                        "backend": stats.backend,
-                        "n_duplicates": stats.n_duplicates,
-                    },
-                )
-        except Exception as e:  # noqa: BLE001 - job status carries it
+                self._jobs[jid].update(status="done", stats=stats_d)
+            self._journal_state(jid, "done", stats=stats_d)
+        except DeadlineExceeded as e:
             METRICS.count("serve.jobs_failed", 1)
             with self._jobs_lock:
                 self._jobs[jid].update(
-                    status="failed", error=f"{type(e).__name__}: {e}"
+                    status="failed", code=DEADLINE_EXCEEDED, error=str(e)
                 )
+            self._journal_state(jid, "failed", error=str(e))
+        except Exception as e:  # noqa: BLE001 - job status carries it
+            METRICS.count("serve.jobs_failed", 1)
+            err = f"{type(e).__name__}: {e}"
+            with self._jobs_lock:
+                self._jobs[jid].update(status="failed", error=err)
+            self._journal_state(jid, "failed", error=err)
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     # -- stats / drain ------------------------------------------------------
 
@@ -408,6 +622,7 @@ class BamDaemon:
             "serve.jobs.max_inflight": self.max_inflight,
             "serve.draining": int(self._draining.is_set()),
         }
+        g.update(self.admission.gauges())
         if self.ctx.batcher is not None:
             g["serve.batch.queue_depth"] = self.ctx.batcher.queue_depth()
         return g

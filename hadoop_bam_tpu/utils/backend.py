@@ -100,6 +100,22 @@ def backend_initialized() -> bool:
         return True
 
 
+def is_resource_exhausted(e: BaseException) -> bool:
+    """Is this exception a device out-of-memory (``RESOURCE_EXHAUSTED``)?
+
+    Matches the real thing — ``XlaRuntimeError``/``jaxlib`` errors whose
+    message carries the XLA status name — and the fault harness's
+    :class:`~hadoop_bam_tpu.faults.InjectedResourceExhausted` stand-in
+    (which embeds the same token), so the serve layer's evict-retry-
+    tierdown recovery is driven identically by injection and reality.
+    ``MemoryError`` counts too: on the CPU/interpret tiers, host
+    allocation failure is the same condition.
+    """
+    if isinstance(e, MemoryError):
+        return True
+    return "RESOURCE_EXHAUSTED" in f"{type(e).__name__}: {e}"
+
+
 def _merge_host_device_flag(flags: str, n_devices: int) -> str:
     """Return XLA_FLAGS with ``--xla_force_host_platform_device_count`` set
     to at least ``n_devices`` (replacing a smaller existing value)."""

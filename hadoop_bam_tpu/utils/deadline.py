@@ -1,0 +1,119 @@
+"""End-to-end deadlines: one budget carried from client to kernel seam.
+
+Hadoop-BAM inherits its liveness story from the Hadoop task runtime — a
+task that exceeds ``mapreduce.task.timeout`` is killed and retried — but
+that bound is per *attempt*, not per *request*: a caller has no way to
+say "this answer is worthless after 500 ms".  This module is the missing
+request-scoped bound, the Clipper-style inference-serving deadline: a
+:class:`Deadline` is created once (client side, or at daemon dispatch
+from the request's ``deadline_ms``) and carried through every seam that
+can burn time — admission queueing, the lane-batcher queue, endpoint
+window loops, the elastic-executor attempt loop — each of which calls
+:meth:`Deadline.check` and raises :class:`DeadlineExceeded` instead of
+doing work nobody will read.
+
+Deliberately in ``utils`` (not ``serve``): the executor and batcher
+seams live below the serve layer and must not import it.
+
+Disarmed contract (the PR 7 stance): with no deadline set, every seam is
+one ``is None`` branch and records no counters — asserted by the
+zero-overhead test in tests/test_faults.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+from .tracing import METRICS
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request's end-to-end deadline expired at ``seam``.
+
+    Distinct from shed (the work was never admitted) and from the
+    retryable transport errors (retrying cannot help — the budget is
+    gone); the serve protocol maps it to the ``DEADLINE_EXCEEDED`` error
+    code and clients must not auto-retry it.
+    """
+
+    def __init__(self, seam: str, remaining_ms: float = 0.0):
+        self.seam = seam
+        super().__init__(
+            f"deadline exceeded at the {seam} seam "
+            f"({abs(remaining_ms):.1f} ms over)"
+        )
+
+
+class Deadline:
+    """An absolute monotonic expiry, checked (never polled) at seams.
+
+    Seam names are metric-name components (lowercase, no dots):
+    ``dispatch`` / ``admission`` / ``batcher`` / ``endpoint`` /
+    ``executor`` / ``pipeline`` / ``client``.
+    """
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: float):
+        self.expires_at = float(expires_at)
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(time.monotonic() + float(ms) / 1e3)
+
+    @classmethod
+    def from_request(cls, req: dict) -> Optional["Deadline"]:
+        """The request's remaining budget (``deadline_ms``), or None.
+        A malformed value is treated as absent — a garbled deadline must
+        not turn into an unbounded one *or* a hard reject."""
+        ms = req.get("deadline_ms")
+        if ms is None:
+            return None
+        try:
+            return cls.after_ms(float(ms))
+        except (TypeError, ValueError):
+            return None
+
+    def remaining_ms(self) -> float:
+        return (self.expires_at - time.monotonic()) * 1e3
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def check(self, seam: str) -> None:
+        """Raise (and count) if expired; free otherwise."""
+        rem = self.remaining_ms()
+        if rem <= 0.0:
+            METRICS.count("serve.deadline.exceeded", 1)
+            METRICS.count(f"serve.deadline.exceeded.{seam}", 1)
+            raise DeadlineExceeded(seam, rem)
+
+
+# Ambient per-thread deadline: the serve handler thread sets it once and
+# the seams it calls into synchronously (read_split → inflate_fn → the
+# lane batcher) pick it up without every signature growing a parameter.
+# Work handed to OTHER threads (the executor pool) gets the deadline
+# explicitly — thread-locals do not follow a ThreadPoolExecutor submit.
+_TLS = threading.local()
+
+
+def current_deadline() -> Optional[Deadline]:
+    return getattr(_TLS, "deadline", None)
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[Deadline]) -> Iterator[None]:
+    """Ambient deadline for the current thread (None = leave unset)."""
+    if deadline is None:
+        yield
+        return
+    old = getattr(_TLS, "deadline", None)
+    _TLS.deadline = deadline
+    try:
+        yield
+    finally:
+        _TLS.deadline = old

@@ -540,10 +540,16 @@ TIER_DECISION_PREFIXES = (
     "sort_bam.device_parse_fallback",
     "sort_bam.device_parse_residency",
     "flate.inflate_device_residency",
+    "flate.oom_tierdown",
+    "bam.oom_tierdown",
+    "serve.oom.",
 )
 
 #: Counter prefixes that record a degraded/error mode the run survived.
-FAULT_MODE_PREFIXES = ("salvage.", "bgzf.missing_eof", "faults.")
+FAULT_MODE_PREFIXES = (
+    "salvage.", "bgzf.missing_eof", "faults.",
+    "serve.admission.shed", "serve.deadline.", "serve.journal.",
+)
 
 
 class RunManifest:
@@ -597,6 +603,9 @@ _FALLBACK_REASONS = {
     "bam.device_deflate_fallback": "device deflate tier errored; native zlib took the part",
     "sort_bam.device_parse_error": "device parse errored on a split",
     "sort_bam.device_parse_fallback": "device parse disagreed with the host walk; host keys used",
+    "serve.oom.tierdowns": "device memory exhausted; the host codec took the affected request(s)",
+    "flate.oom_tierdown": "device memory exhausted during a codec launch; members tiered down",
+    "bam.oom_tierdown": "device memory exhausted during a window inflate; native zlib took the window",
 }
 
 

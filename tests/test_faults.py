@@ -388,7 +388,14 @@ def test_disarmed_strict_clean_run_is_zero_overhead(bam_corpus, tmp_path):
         k
         for k in d
         if k.startswith(("faults.", "salvage.", "io.read_retries",
-                         "executor.invalid_part", "bgzf.missing_eof"))
+                         "executor.invalid_part", "bgzf.missing_eof",
+                         # PR 10 seams: admission / deadline / OOM /
+                         # journal are one disarmed branch each — a
+                         # clean batch run must record none of them.
+                         "serve.admission.", "serve.deadline.",
+                         "serve.oom.", "serve.journal.",
+                         "executor.deadline_exceeded",
+                         "flate.oom_tierdown", "bam.oom_tierdown"))
     ]
     assert leaked == []
 
@@ -788,6 +795,277 @@ def test_serve_connection_drop_and_stall_retried(tmp_path):
         faults.disarm()
         client.shutdown()
         t.join(timeout=20)
+
+
+# ---------------------------------------------------------------------------
+# PR 10 chaos drill: concurrent load + arena.oom + exec.die (the kill -9
+# stand-in) + restart → typed replies, no hang, byte-identical resume
+# ---------------------------------------------------------------------------
+
+
+def _spawn_daemon_subprocess(sock, jpath, extra_env=None, extra_args=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("HBAM_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "hadoop_bam_tpu", "serve",
+            "--socket", sock, "--journal", jpath, "--no-warmup",
+            *extra_args,
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    from hadoop_bam_tpu.serve import ServeClient
+
+    client = ServeClient(socket_path=sock, timeout=30.0, retries=0)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"daemon exited rc={proc.returncode} before ready"
+            )
+        try:
+            if client.ping()["ok"]:
+                return proc, client
+        except Exception:
+            time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("daemon subprocess never became ready")
+
+
+def test_chaos_drill_overload_oom_die_and_byte_identical_resume(tmp_path):
+    """The PR 10 acceptance drill, end to end in real processes:
+
+    a daemon with ``arena.oom`` (device OOM storm) and ``exec.die``
+    (the kill -9 stand-in, mid-sort) armed serves N concurrent clients —
+    typed shed/deadline replies, OOM degradation instead of death — then
+    dies at part 1 of an out-of-core sort.  A fresh daemon on the same
+    journal resumes the interrupted job through the spill-manifest +
+    validated-part checkpoints and its output is byte-identical to an
+    uninterrupted run."""
+    from hadoop_bam_tpu.serve import (
+        DeadlineExceededError,
+        ServeClient,
+        ServeShedError,
+    )
+    from hadoop_bam_tpu.serve import journal as journal_mod
+    from hadoop_bam_tpu.spec import indices
+
+    # Fixtures: the sort input and its uninterrupted-run oracle, plus a
+    # small sorted+indexed BAM for the concurrent view load.
+    src = str(tmp_path / "in.bam")
+    _build_bam(src, n=2500, seed=17)
+    budget = 64 << 10
+    out_clean = str(tmp_path / "uninterrupted.bam")
+    sort_bam([src], out_clean, backend="host", level=1,
+             memory_budget=budget)
+    view_bam = str(tmp_path / "view.bam")
+    sort_bam([src], view_bam, backend="host", level=1)
+    with open(view_bam + ".bai", "wb") as f:
+        indices.build_bai(view_bam).save(f)
+    from hadoop_bam_tpu.serve.endpoints import ServeContext, view_blob
+
+    octx = ServeContext.from_conf(with_batcher=False)
+    try:
+        view_oracle = view_blob(octx, view_bam, "c1:1-200000", level=1)
+    finally:
+        octx.close()
+
+    sock = str(tmp_path / "chaos.sock")
+    jpath = str(tmp_path / "chaos.jsonl")
+    out = str(tmp_path / "resumed.bam")
+    pdir = str(tmp_path / "parts")
+    proc, client = _spawn_daemon_subprocess(
+        sock, jpath,
+        extra_env={
+            # OOM storm on the first decode launches + hard process
+            # death at part 1 of the sort's merge phase (part 0 and the
+            # spill manifest land first — the checkpoints the resume
+            # trusts).
+            "HBAM_FAULTS": "arena.oom:n=4;exec.die:items=1,attempts=*,n=1",
+        },
+        extra_args=["--admission-tokens", "2", "--max-queue", "1"],
+    )
+
+    # Concurrent mixed load: every request must terminate with either a
+    # correct answer or a TYPED refusal — never a hang, never a daemon
+    # death.  (Timeouts below would fail the test loudly.)
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "conn": 0}
+    olock = threading.Lock()
+
+    def storm(k):
+        c = ServeClient(socket_path=sock, timeout=30.0, retries=0)
+        for i in range(6):
+            try:
+                blob = c.view(view_bam, "c1:1-200000", level=1,
+                              deadline_ms=1 if (k == 0 and i == 0) else 20_000)
+                assert blob == view_oracle
+                key = "ok"
+            except ServeShedError:
+                key = "shed"
+            except DeadlineExceededError:
+                key = "deadline"
+            except (OSError, ConnectionError):
+                key = "conn"
+            with olock:
+                outcomes[key] += 1
+
+    threads = [threading.Thread(target=storm, args=(k,)) for k in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "a client hung"
+    assert outcomes["ok"] >= 1, outcomes
+    assert outcomes["conn"] == 0, outcomes
+    assert outcomes["deadline"] >= 1, outcomes  # the 1 ms budget expired
+
+    # The daemon degraded through the OOM storm (evict-retry-tierdown)
+    # and counted it; it never died.
+    stats = client.stats()
+    cnt = stats["metrics"]["counters"]
+    assert cnt.get("serve.oom.tierdowns", 0) >= 1, cnt
+    assert cnt.get("faults.fired.arena.oom", 0) >= 1
+
+    # Submit the sort that will kill the daemon mid-merge.
+    jid = client.sort(
+        src, out, level=1, memory_budget=budget, part_dir=pdir,
+    )
+    proc.wait(timeout=180)
+    assert proc.returncode == 137  # exec.die: SIGKILL's exit code
+    assert not os.path.exists(out)
+    assert os.path.exists(os.path.join(pdir, "spill", "manifest.json"))
+    jobs = journal_mod.replay(jpath)
+    assert jobs[jid]["status"] == "running"  # journaled, not terminal
+    assert journal_mod.recovery_plan(jobs) == {jid: "resume"}
+
+    # Restart on the same journal, faults disarmed: the daemon resumes
+    # the interrupted job and reproduces the uninterrupted bytes.
+    proc2, client2 = _spawn_daemon_subprocess(sock, jpath)
+    try:
+        st = client2.wait(jid, timeout=150)
+        assert st["status"] == "done"
+        assert st["stats"]["n_records"] == 2500
+        with open(out_clean, "rb") as f1, open(out, "rb") as f2:
+            assert f1.read() == f2.read()
+        cnt2 = client2.stats()["metrics"]["counters"]
+        assert cnt2.get("serve.journal.resumed") == 1
+        assert cnt2.get("sort_bam.resume_spill_reused") == 1
+    finally:
+        try:
+            client2.shutdown()
+        except Exception:
+            proc2.kill()
+        proc2.wait(timeout=60)
+
+
+@pytest.mark.slow
+def test_soak_mixed_traffic_with_faults_daemon_survives(tmp_path):
+    """30 s soak: mixed view/flagstat/sort traffic with fault cycles
+    (arena.oom storms, serve.drop, exec.delay) armed and disarmed while
+    requests fly.  Zero daemon deaths, queue gauges bounded, and the
+    daemon still answers cleanly at the end."""
+    from hadoop_bam_tpu.conf import (
+        Configuration,
+        SERVE_ADMISSION_TOKENS,
+        SERVE_MAX_QUEUE,
+    )
+    from hadoop_bam_tpu.serve import (
+        DeadlineExceededError,
+        ServeClient,
+        ServeError,
+        ServeShedError,
+    )
+    from hadoop_bam_tpu.spec import indices
+
+    src = str(tmp_path / "soak_in.bam")
+    _build_bam(src, n=1200, seed=23)
+    view_bam = str(tmp_path / "soak_view.bam")
+    sort_bam([src], view_bam, backend="host", level=1)
+    with open(view_bam + ".bai", "wb") as f:
+        indices.build_bai(view_bam).save(f)
+    conf = Configuration(
+        {SERVE_ADMISSION_TOKENS: "3", SERVE_MAX_QUEUE: "2"}
+    )
+    d, t, sock = _start_daemon(tmp_path, conf=conf)
+    stop = threading.Event()
+    failures = []
+    max_queue_seen = [0]
+
+    def traffic(k):
+        c = ServeClient(socket_path=sock, timeout=20.0, retries=1,
+                        retry_backoff=0.01)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                if k == 0 and i % 7 == 0:
+                    jid = c.sort(
+                        view_bam, str(tmp_path / f"soak_{k}_{i}.bam"),
+                        level=1,
+                    )
+                    c.wait(jid, timeout=60)
+                elif i % 3 == 0:
+                    c.flagstat(view_bam)
+                else:
+                    c.view(view_bam, "c1:1-150000", level=1,
+                           deadline_ms=10_000)
+            except (ServeShedError, DeadlineExceededError):
+                pass  # typed refusals are the design working
+            except ServeError as e:
+                failures.append(f"{type(e).__name__}: {e}")
+            except (OSError, ConnectionError) as e:
+                failures.append(f"{type(e).__name__}: {e}")
+
+    def chaos():
+        while not stop.is_set():
+            faults.arm("arena.oom:n=6")
+            d.ctx.arena.release_all()  # force real decodes
+            time.sleep(1.0)
+            faults.disarm()
+            faults.arm("serve.drop:op=view,n=2;exec.delay:items=*,ms=50,n=4")
+            time.sleep(1.0)
+            faults.disarm()
+            time.sleep(0.5)
+
+    def gauge_watch():
+        probe = ServeClient(socket_path=sock, timeout=20.0, retries=2)
+        while not stop.is_set():
+            try:
+                g = probe.stats()["gauges"]
+                max_queue_seen[0] = max(
+                    max_queue_seen[0],
+                    int(g.get("serve.admission.queue_depth", 0)),
+                )
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    workers = [
+        threading.Thread(target=traffic, args=(k,)) for k in range(4)
+    ] + [threading.Thread(target=chaos), threading.Thread(target=gauge_watch)]
+    for w in workers:
+        w.start()
+    time.sleep(30.0)
+    stop.set()
+    for w in workers:
+        w.join(timeout=60)
+    faults.disarm()
+    assert t.is_alive(), "the daemon accept loop died during the soak"
+    # Retried transport errors can surface when serve.drop eats the
+    # retry budget too — but untyped failures must stay rare noise, not
+    # the norm.
+    assert len(failures) <= 6, failures[:10]
+    assert max_queue_seen[0] <= 2  # the queue bound held
+    probe = ServeClient(socket_path=sock, timeout=20.0, retries=2)
+    assert probe.ping()["ok"]
+    assert probe.view(view_bam, "c1:1-150000", level=1)
+    probe.shutdown()
+    t.join(timeout=30)
 
 
 def test_wait_job_backoff_and_retryable_polls(monkeypatch):

@@ -11,30 +11,37 @@ counter deltas — ``serve.cache.miss``, ``serve.arena.hit``,
 """
 
 import io
+import json
 import os
 import struct
 import threading
+import time
 
 import numpy as np
 import pytest
 
-from hadoop_bam_tpu import native
+from hadoop_bam_tpu import faults, native
 from hadoop_bam_tpu.pipeline import sort_bam
 from hadoop_bam_tpu.serve import (
     BamDaemon,
+    DeadlineExceededError,
     HbmArena,
+    JobLostError,
     LaneBatcher,
     LruByteCache,
     ResourceCache,
     ServeClient,
     ServeContext,
     ServeError,
+    ServeShedError,
     ensure_compile_watcher,
     flagstat,
     view_blob,
     warm_kernels,
 )
+from hadoop_bam_tpu.serve import admission, journal
 from hadoop_bam_tpu.spec import bam, bgzf, indices
+from hadoop_bam_tpu.utils.deadline import Deadline, DeadlineExceeded
 from hadoop_bam_tpu.utils.tracing import delta, snapshot
 
 pytestmark = pytest.mark.serve
@@ -484,6 +491,513 @@ def test_cli_view_and_flagstat_one_shot(sorted_bam, tmp_path, capsys):
     printed = json.loads(capsys.readouterr().out)
     assert printed == expect_fs
     assert printed["total"] == 240
+
+
+# ---------------------------------------------------------------------------
+# Overload resilience (PR 10): admission control + typed shedding
+# ---------------------------------------------------------------------------
+
+
+def test_admission_tokens_queue_and_shed_reply_shape():
+    """The admission unit contract: a full queue sheds immediately with
+    code SHED and a positive retry hint; a freed token admits the
+    queued waiter; control-plane ops are never gated."""
+    ctrl = admission.AdmissionController(tokens=1, max_queue=0)
+    t1 = ctrl.acquire("view")
+    s0 = snapshot()
+    with pytest.raises(admission.ShedError) as ei:
+        ctrl.acquire("view")
+    assert ei.value.code == admission.SHED
+    assert ei.value.retry_after_ms >= 10
+    d = delta(s0)["counters"]
+    assert d["serve.admission.shed"] == 1
+    assert d["serve.admission.shed.queue_full"] == 1
+    # Control plane bypasses admission even while saturated.
+    assert ctrl.acquire("ping") is admission.NULL_TICKET
+    # With queue room, a waiter parks until the token frees.
+    ctrl2 = admission.AdmissionController(tokens=1, max_queue=4)
+    hold = ctrl2.acquire("view")
+    got = []
+
+    def waiter():
+        got.append(ctrl2.acquire("view"))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not got  # still queued
+    assert ctrl2.gauges()["serve.admission.queue_depth"] == 1
+    hold.release()
+    th.join(timeout=5)
+    assert got and got[0].cost == 1
+    got[0].release()
+    t1.release()
+
+
+def test_admission_queued_deadline_expires_in_queue():
+    ctrl = admission.AdmissionController(tokens=1, max_queue=4)
+    hold = ctrl.acquire("view")
+    s0 = snapshot()
+    with pytest.raises(DeadlineExceeded) as ei:
+        ctrl.acquire("view", deadline=Deadline.after_ms(80))
+    assert ei.value.seam == "admission"
+    d = delta(s0)["counters"]
+    assert d["serve.deadline.exceeded.admission"] == 1
+    hold.release()
+
+
+def test_daemon_sheds_views_while_job_holds_tokens(sorted_bam, tmp_path):
+    """Daemon-level shed: a running sort holds its admission tokens for
+    the whole job, so with a 1-token budget and no queue a concurrent
+    view gets the typed SHED reply (with the backoff hint) instead of
+    unbounded queueing — and is admitted again once the job finishes."""
+    from hadoop_bam_tpu.conf import (
+        Configuration,
+        SERVE_ADMISSION_TOKENS,
+        SERVE_MAX_QUEUE,
+    )
+
+    conf = Configuration(
+        {SERVE_ADMISSION_TOKENS: "1", SERVE_MAX_QUEUE: "0"}
+    )
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    out = str(tmp_path / "shed_sorted.bam")
+    # Hold the job in its first part-write attempt so the token stays
+    # taken for a deterministic window.
+    faults.arm("exec.delay:items=*,attempts=0,ms=800,n=1")
+    try:
+        jid = client.sort(sorted_bam, out, level=1)
+        shed_client = ServeClient(socket_path=d.socket_path, retries=0)
+        with pytest.raises(ServeShedError) as ei:
+            shed_client.view(sorted_bam, "chr1:100000-300000")
+        assert ei.value.code in (admission.SHED, admission.RETRY_AFTER)
+        assert ei.value.retry_after_ms >= 10
+        client.wait(jid, timeout=60)
+        # Tokens released with the job: the same view now answers.
+        assert shed_client.view(sorted_bam, "chr1:100000-300000")
+        stats = client.stats()
+        assert stats["metrics"]["counters"]["serve.admission.shed"] >= 1
+        g = stats["gauges"]
+        assert g["serve.admission.tokens"] == 1
+        assert g["serve.admission.tokens_in_use"] == 0
+    finally:
+        faults.disarm()
+        client.shutdown()
+        t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Overload resilience: end-to-end deadlines at every seam
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expired_at_dispatch_is_typed(sorted_bam, tmp_path):
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        # Client-side bound: an already-spent budget never even sends.
+        with pytest.raises(DeadlineExceededError):
+            client.view(sorted_bam, "chr1:100000-300000", deadline_ms=0)
+        assert client.stats()["metrics"]["counters"].get(
+            "serve.op.view", 0
+        ) == 0
+        # Server-side dispatch seam: ship an expired budget directly
+        # (bypassing the client check) — the reply is the typed code.
+        with pytest.raises(DeadlineExceededError):
+            client._request(
+                {"op": "view", "path": sorted_bam,
+                 "region": "chr1:100000-300000", "deadline_ms": 0}
+            )
+        cnt = client.stats()["metrics"]["counters"]
+        assert cnt["serve.deadline.exceeded"] >= 1
+        assert cnt["serve.deadline.exceeded.dispatch"] >= 1
+    finally:
+        client.shutdown()
+        t.join(timeout=20)
+
+
+def test_deadline_batcher_seam_never_burns_a_launch():
+    p = np.frombuffer(b"deadline-batch" * 16, np.uint8)
+    work = _members(p)
+    b = LaneBatcher(window_s=0.3)
+    s0 = snapshot()
+    try:
+        # Expired at admission: raises before entering the queue.
+        with pytest.raises(DeadlineExceeded) as ei:
+            b.submit(*work, deadline=Deadline.after_ms(0))
+        assert ei.value.seam == "batcher"
+        # Expires while queued (deadline < window): the worker fails it
+        # out of band and never spends a lane on it.
+        with pytest.raises(DeadlineExceeded):
+            b.submit(*work, deadline=Deadline.after_ms(30))
+    finally:
+        b.close()
+    d = delta(s0)["counters"]
+    assert d["serve.deadline.exceeded.batcher"] == 2
+    assert "serve.batch.launches" not in d
+    # An unexpired deadline decodes normally.
+    b2 = LaneBatcher(window_s=0.0)
+    try:
+        out, _ = b2.submit(*work, deadline=Deadline.after_ms(60_000))
+        assert out.tobytes() == p.tobytes()
+    finally:
+        b2.close()
+
+
+def test_deadline_executor_seam_terminal_not_retried(tmp_path):
+    from hadoop_bam_tpu.parallel.executor import ElasticExecutor
+
+    calls = []
+
+    def work(item, tmp):
+        calls.append(item)
+        with open(tmp, "wb") as f:
+            f.write(bgzf.compress_block(b"x"))
+
+    s0 = snapshot()
+    ex = ElasticExecutor(
+        str(tmp_path / "out"), max_attempts=3,
+        deadline=Deadline.after_ms(0),
+    )
+    with pytest.raises(DeadlineExceeded) as ei:
+        ex.run([0, 1], work)
+    assert ei.value.seam == "executor"
+    assert calls == []  # no attempt ran, let alone retried
+    d = delta(s0)["counters"]
+    assert d["executor.deadline_exceeded"] >= 1
+    # Composition with attempt_timeout: the watchdog waits only the
+    # remaining budget, and expiry is terminal (no retry burn).
+    def slow(item, tmp):
+        time.sleep(5.0)
+
+    ex2 = ElasticExecutor(
+        str(tmp_path / "out2"), max_attempts=3, attempt_timeout=30.0,
+        deadline=Deadline.after_ms(200),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        ex2.run([0], slow)
+    assert time.monotonic() - t0 < 5.0  # bounded by the deadline, not 30 s
+
+
+def test_deadline_endpoint_seam_and_sort_job(sorted_bam, tmp_path):
+    """An expired deadline inside endpoint execution (between chunk
+    windows) and a whole sort job bounded by its deadline — both typed,
+    both counted, daemon alive after each."""
+    ctx = ServeContext.from_conf(with_batcher=False)
+    s0 = snapshot()
+    try:
+        with pytest.raises(DeadlineExceeded) as ei:
+            view_blob(
+                ctx, sorted_bam, "chr1:100000-300000",
+                deadline=Deadline.after_ms(0),
+            )
+        assert ei.value.seam == "endpoint"
+    finally:
+        ctx.close()
+    assert delta(s0)["counters"]["serve.deadline.exceeded.endpoint"] == 1
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        out = str(tmp_path / "dl_sorted.bam")
+        faults.arm("exec.delay:items=*,attempts=*,ms=400,n=*")
+        try:
+            jid = client.sort(sorted_bam, out, level=1, deadline_ms=150)
+            with pytest.raises(DeadlineExceededError):
+                client.wait(jid, timeout=60)
+        finally:
+            faults.disarm()
+        assert client.ping()["ok"]  # the daemon survived the expiry
+    finally:
+        client.shutdown()
+        t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Overload resilience: OOM-safe degradation (evict → retry → tier down)
+# ---------------------------------------------------------------------------
+
+
+def _ctx_with_batcher(window_ms: float = 1.0) -> ServeContext:
+    from hadoop_bam_tpu.conf import Configuration, SERVE_BATCH_WINDOW_MS
+
+    return ServeContext.from_conf(
+        Configuration({SERVE_BATCH_WINDOW_MS: str(int(window_ms))})
+    )
+
+
+def test_oom_evict_retry_then_tierdown_byte_exact(sorted_bam):
+    oracle_ctx = ServeContext.from_conf(with_batcher=False)
+    try:
+        oracle = view_blob(oracle_ctx, sorted_bam, "chr1:100000-300000")
+    finally:
+        oracle_ctx.close()
+    ctx = _ctx_with_batcher()
+    try:
+        # Warm something into the arena so the eviction has a victim.
+        view_blob(ctx, sorted_bam, "chr2:100000-300000")
+        assert len(ctx.arena) >= 1
+        # One injected OOM: evict + retry succeeds on the device path —
+        # no tier-down, byte-exact result.
+        faults.arm("arena.oom:n=1")
+        s0 = snapshot()
+        try:
+            blob = view_blob(ctx, sorted_bam, "chr1:100000-300000")
+        finally:
+            faults.disarm()
+        d = delta(s0)["counters"]
+        assert blob == oracle
+        assert d["serve.oom.evictions"] == 1
+        assert "serve.oom.tierdowns" not in d
+        assert d["faults.fired.arena.oom"] == 1
+        # Persistent OOM: evict + retry also fails → host tier takes the
+        # request; still byte-exact, daemon-side state intact.
+        ctx.arena.release_all()  # force a real decode for chr1 again
+        faults.arm("arena.oom:n=*")
+        s0 = snapshot()
+        try:
+            blob = view_blob(ctx, sorted_bam, "chr1:100000-300000")
+        finally:
+            faults.disarm()
+        d = delta(s0)["counters"]
+        assert blob == oracle
+        assert d["serve.oom.tierdowns"] == 1
+    finally:
+        ctx.close()
+
+
+def test_oom_counters_surface_in_run_manifest():
+    from hadoop_bam_tpu.utils.tracing import run_manifest
+
+    man = run_manifest(counters={"serve.oom.tierdowns": 3})
+    assert man.degraded is True
+    assert any("memory exhausted" in r for r in man.reasons)
+    assert man.tier_decisions.get("serve.oom.tierdowns") == 3
+
+
+# ---------------------------------------------------------------------------
+# Cache stampede dedup (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_stampede_single_loader_shared_result(tmp_path):
+    p = str(tmp_path / "f")
+    with open(p, "wb") as f:
+        f.write(b"x")
+    cache = LruByteCache(budget_bytes=1 << 20, name="serve.cache")
+    loads = []
+    gate = threading.Event()
+    barrier = threading.Barrier(9)  # 8 getters + the main thread
+
+    def loader(path):
+        loads.append(path)
+        gate.wait(5)  # hold the flight open so everyone piles on
+        return object()
+
+    results = []
+
+    def get():
+        barrier.wait()
+        results.append(
+            cache.get_or_load("blob", p, loader, lambda v: 8)
+        )
+
+    s0 = snapshot()
+    threads = [threading.Thread(target=get) for _ in range(8)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.2)  # everyone reaches the flight before it resolves
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    d = delta(s0)["counters"]
+    assert len(loads) == 1  # exactly one loader ran
+    assert len(set(map(id, results))) == 1  # everyone shares the result
+    assert d.get("serve.cache.stampede_wait", 0) == 7
+    # A failing flight propagates to its waiters, then clears: the next
+    # call runs a fresh loader.
+    def boom(path):
+        raise IOError("index went away")
+
+    with pytest.raises(IOError):
+        cache.get_or_load("blob2", p, boom, lambda v: 8)
+    assert cache.get_or_load("blob2", p, lambda path: "v", lambda v: 8) == "v"
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe job journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_replay_and_torn_tail(tmp_path, sorted_bam):
+    jpath = str(tmp_path / "jobs.jsonl")
+    j = journal.JobJournal(jpath)
+    ident = journal.input_identity([sorted_bam])
+    j.submit("job-0001", {"bam": sorted_bam, "output": "/o1"}, ident)
+    j.state("job-0001", "running")
+    j.state("job-0001", "done", stats={"n_records": 7})
+    j.submit("job-0002", {"bam": sorted_bam, "output": "/o2",
+                          "part_dir": str(tmp_path / "parts")}, ident)
+    j.state("job-0002", "running")
+    j.close()
+    jobs = journal.replay(jpath)
+    assert jobs["job-0001"]["status"] == "done"
+    assert jobs["job-0001"]["stats"] == {"n_records": 7}
+    assert jobs["job-0002"]["status"] == "running"
+    plan = journal.recovery_plan(jobs)
+    assert plan == {"job-0002": "resume"}  # terminal jobs need nothing
+    # Torn tail: a crash mid-append leaves a partial line — dropped and
+    # counted, everything before it intact.
+    with open(jpath, "ab") as f:
+        f.write(b'{"v":1,"event":"state","job":"job-0002","sta')
+    s0 = snapshot()
+    jobs2 = journal.replay(jpath)
+    assert jobs2 == jobs
+    assert delta(s0)["counters"]["serve.journal.torn_tail"] == 1
+    # Stale identity: touch the input → the interrupted job must be
+    # lost, never resumed against different bytes.
+    st = os.stat(sorted_bam)
+    os.utime(sorted_bam, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    s0 = snapshot()
+    plan2 = journal.recovery_plan(journal.replay(jpath))
+    assert plan2 == {"job-0002": "lost"}
+    assert delta(s0)["counters"]["serve.journal.stale"] == 1
+
+
+def test_daemon_restart_reports_terminal_states_and_job_lost(
+    sorted_bam, tmp_path
+):
+    """Restart amnesia is gone: a daemon pointed at the journal of its
+    previous life reports the finished job's terminal state, and unknown
+    ids get the typed JOB_LOST reply instead of an infinite poll."""
+    jpath = str(tmp_path / "daemon.jsonl")
+    d, t, client = _start_daemon(tmp_path, journal_path=jpath)
+    out = str(tmp_path / "j_sorted.bam")
+    jid = client.sort(sorted_bam, out, level=1)
+    st = client.wait(jid, timeout=60)
+    assert st["status"] == "done"
+    client.shutdown()
+    t.join(timeout=30)
+    # Second life, same journal (the first daemon removed its socket on
+    # drain, so the path is free to rebind).
+    d2, t2, client2 = _start_daemon(tmp_path, journal_path=jpath)
+    try:
+        replayed = client2.job(jid)
+        assert replayed["status"] == "done"
+        assert replayed["stats"]["n_records"] == st["stats"]["n_records"]
+        with pytest.raises(JobLostError):
+            client2.job("job-9999")
+        with pytest.raises(JobLostError):
+            client2.wait("job-9999", timeout=10)
+    finally:
+        client2.shutdown()
+        t2.join(timeout=30)
+
+
+def test_daemon_restart_marks_unresumable_job_lost(sorted_bam, tmp_path):
+    """An interrupted job without a part_dir checkpoint cannot be
+    honestly re-run: the restarted daemon reports it ``lost`` and
+    ``wait`` surfaces the typed JobLostError (satellite fix for the
+    infinite 1 s poll loop)."""
+    jpath = str(tmp_path / "lost.jsonl")
+    j = journal.JobJournal(jpath)
+    j.submit(
+        "job-0001",
+        {"bam": sorted_bam, "output": str(tmp_path / "never.bam")},
+        journal.input_identity([sorted_bam]),
+    )
+    j.state("job-0001", "running")
+    j.close()
+    d, t, client = _start_daemon(tmp_path, journal_path=jpath)
+    try:
+        st = client.job("job-0001")
+        assert st["status"] == "lost"
+        with pytest.raises(JobLostError):
+            client.wait("job-0001", timeout=10)
+        # The next submission must not reuse the journaled id space.
+        jid = client.sort(sorted_bam, str(tmp_path / "next.bam"), level=1)
+        assert jid != "job-0001"
+        client.wait(jid, timeout=60)
+    finally:
+        client.shutdown()
+        t.join(timeout=30)
+
+
+def test_signal_drain_requests_same_path_as_shutdown(sorted_bam, tmp_path):
+    """SIGTERM/SIGINT drain like the shutdown op: the accept loop sees
+    the request flag, finishes in-flight jobs, and exits — exercised via
+    the flag (real handlers install only on the main thread; the CLI
+    wires them through install_signal_handlers)."""
+    d, t, client = _start_daemon(tmp_path)
+    out = str(tmp_path / "sig_sorted.bam")
+    jid = client.sort(sorted_bam, out, level=1)
+    d._drain_requested.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    with pytest.raises(OSError):
+        client.ping()
+    # The in-flight job finished before the daemon exited.
+    from hadoop_bam_tpu.io.bam import read_header
+
+    assert os.path.exists(out)
+    assert read_header(out).n_refs == 2
+
+
+# ---------------------------------------------------------------------------
+# Error-code round trip + metric-name lint (CI satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_error_codes_round_trip_client_server():
+    """Every protocol error code maps to a typed client exception whose
+    ``code`` survives the round trip — a new server-side code that the
+    client would silently degrade to the untyped ServeError fails here."""
+    from hadoop_bam_tpu.serve.client import _CODE_ERRORS, error_from_reply
+
+    assert set(_CODE_ERRORS) == set(admission.ERROR_CODES)
+    for code in admission.ERROR_CODES:
+        e = error_from_reply(
+            {"ok": False, "code": code, "error": "x", "retry_after_ms": 7}
+        )
+        assert isinstance(e, ServeError) and type(e) is not ServeError
+        assert e.code == code
+    # Shed replies carry the server hint through.
+    e = error_from_reply(
+        {"ok": False, "code": admission.SHED, "error": "x",
+         "retry_after_ms": 123}
+    )
+    assert isinstance(e, ServeShedError) and e.retry_after_ms == 123
+    # Codeless replies stay the plain ServeError (back compat).
+    assert type(error_from_reply({"ok": False, "error": "x"})) is ServeError
+
+
+def test_new_metric_names_match_dotted_lowercase_rule():
+    """The PR 10 metric names (admission/deadline/oom/journal) all obey
+    the dotted-lowercase namespace rule the tracing lint enforces."""
+    import re
+
+    from hadoop_bam_tpu.utils.tracing import METRIC_NAME_PATTERN
+
+    pat = re.compile(METRIC_NAME_PATTERN)
+    for name in (
+        "serve.admission.admitted",
+        "serve.admission.shed",
+        "serve.admission.shed.queue_full",
+        "serve.admission.shed.slow_queue",
+        "serve.admission.queue_wait.ms",
+        "serve.deadline.exceeded",
+        "serve.deadline.exceeded.dispatch",
+        "serve.oom.evictions",
+        "serve.oom.tierdowns",
+        "serve.journal.appends",
+        "serve.journal.torn_tail",
+        "serve.journal.resumed",
+        "serve.journal.lost",
+        "serve.journal.stale",
+        "executor.deadline_exceeded",
+        "flate.oom_tierdown",
+        "bam.oom_tierdown",
+    ):
+        assert pat.match(name), name
 
 
 def test_daemon_latency_histograms_gauges_and_prometheus(

@@ -351,6 +351,46 @@ def test_trace_report_fixture_reduction():
     assert "top stall: bam.stage.deflate" in txt
 
 
+def test_trace_report_folds_queue_wait_into_stage_report():
+    """Admission queue-wait events (category "queue") fold into the same
+    busy/idle table as pipeline stages — overload shows up in the stall
+    harness, and a queue-dominated trace ranks the queue as top stall."""
+    tr = trace_report_mod()
+    events = [
+        {"name": "serve.view", "cat": "stage", "ph": "X",
+         "ts": 0.0, "dur": 2000.0, "pid": 1, "tid": 1},
+        {"name": "serve.admission.wait", "cat": "queue", "ph": "X",
+         "ts": 2000.0, "dur": 8000.0, "pid": 1, "tid": 2,
+         "args": {"op": "view"}},
+    ]
+    rep = tr.stage_report(events)
+    assert "serve.admission.wait" in rep["stages"]
+    assert rep["queue_wait_ms"] == pytest.approx(8.0)
+    assert rep["top_stall"]["stage"] == "serve.admission.wait"
+    assert "admission queue wait" in tr.format_report(rep)
+    # A queue-free trace reports zero wait and is otherwise unchanged.
+    rep2 = tr.stage_report(
+        [e for e in events if e["cat"] == "stage"]
+    )
+    assert rep2["queue_wait_ms"] == 0.0
+
+
+def test_armed_tracer_records_admission_queue_events():
+    from hadoop_bam_tpu.serve.admission import AdmissionController
+    from hadoop_bam_tpu.utils.tracing import TRACER
+
+    ctrl = AdmissionController(tokens=1, max_queue=4)
+    TRACER.start(capacity=64)
+    try:
+        t = ctrl.acquire("view")
+        t.release()
+        evs = [e for e in TRACER.chrome_events() if e["cat"] == "queue"]
+        assert evs and evs[0]["name"] == "serve.admission.wait"
+        assert evs[0]["args"]["op"] == "view"
+    finally:
+        TRACER.stop()
+
+
 def test_trace_report_cli_runs():
     r = subprocess.run(
         [sys.executable, str(REPO / "tools" / "trace_report.py"),

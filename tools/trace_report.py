@@ -84,7 +84,8 @@ def _intersect_len(a: List[Interval], b: List[Interval]) -> float:
 
 
 def stage_report(
-    events: List[dict], category: str = "stage"
+    events: List[dict], category: str = "stage",
+    queue_category: str = "queue",
 ) -> Optional[dict]:
     """Reduce stage events to per-stage busy/idle/overlap + the top stall.
 
@@ -92,21 +93,32 @@ def stage_report(
     to milliseconds.  Zero-duration events (transfer instants) contribute
     counts but no busy time.  Returns None when the trace has no events
     in ``category``.
+
+    Admission queue-wait events (``cat == queue_category`` — the serve
+    daemon's ``serve.admission.wait``) are folded into the same report as
+    stages of their own, so time spent *waiting to be admitted* shows up
+    in the busy/idle/overlap table and can win the top-stall ranking —
+    an overloaded daemon's dominant "stage" is its queue.  The report's
+    ``queue_wait_ms`` totals that time separately.
     """
     by_stage: Dict[str, List[Interval]] = {}
     n_events: Dict[str, int] = {}
     items: Dict[str, set] = {}
+    queue_names: set = set()
     t_min, t_max = float("inf"), float("-inf")
     for e in events:
-        if e.get("cat") != category:
+        cat = e.get("cat")
+        if cat != category and cat != queue_category:
             continue
         name = e["name"]
+        if cat == queue_category:
+            queue_names.add(name)
         t0 = float(e["ts"])
         t1 = t0 + float(e.get("dur", 0.0))
         by_stage.setdefault(name, []).append((t0, t1))
         n_events[name] = n_events.get(name, 0) + 1
         args = e.get("args") or {}
-        for k in ("split", "part"):
+        for k in ("split", "part", "op"):
             if k in args:
                 items.setdefault(name, set()).add((k, args[k]))
         t_min = min(t_min, t0)
@@ -151,6 +163,9 @@ def stage_report(
         "wall_ms": wall / 1e3,
         "covered_ms": covered / 1e3,
         "overlap_frac": (multi / covered) if covered > 0 else 0.0,
+        "queue_wait_ms": sum(
+            _union_len(merged[k]) for k in queue_names
+        ) / 1e3,
         "stages": stages,
         "top_stall": {
             "stage": top[0],
@@ -185,6 +200,11 @@ def format_report(rep: dict) -> str:
         f"top stall: {t['stage']} — {t['exclusive_ms']:.3f} ms exclusive "
         f"({t['busy_frac']:.1%} of wall busy)"
     )
+    if rep.get("queue_wait_ms"):
+        lines.append(
+            f"admission queue wait: {rep['queue_wait_ms']:.3f} ms "
+            "(folded into the table above as its own stage)"
+        )
     return "\n".join(lines)
 
 
