@@ -144,12 +144,20 @@ def _measure(platform: str) -> dict:
     # instead of biasing whichever ran last.
     run_sort(src, out_d, "device")
     run_sort(src, out_h, "host")
+    # HBM accounting for the headline runs: the residency ledger's
+    # high-watermark delta over the measured device sorts — how many
+    # device bytes the pipeline actually held at once, per read.  A
+    # CPU-only round reads 0 here (no device residency to ledger).
+    from hadoop_bam_tpu.utils.hbm import LEDGER as _HBM
+
+    _HBM.reset_peak()
     t_d, t_h = [], []
     for _ in range(3):
         t_d.append(run_sort(src, out_d, "device"))
         t_h.append(run_sort(src, out_h, "host"))
     t_device = min(t_d)
     t_host = min(t_h)
+    hbm_peak = int(_HBM.peak_bytes)
 
     # Correctness gate: the device output must be complete and sorted
     # (vectorized re-read — the per-record oracle check lives in tests/).
@@ -174,6 +182,13 @@ def _measure(platform: str) -> dict:
         "vs_baseline": round(t_host / t_device, 3),
         "platform": platform,
         "n_records": N_RECORDS,
+        # Residency-ledger high watermark over the measured sorts (and
+        # its per-read normalization): the HBM working-set number the
+        # DeviceStream double-buffering refactor must not regress.  A
+        # run with hbm.leaked_bytes > 0 is degraded via the manifest
+        # below and never updates a headline (BENCH_NOTES).
+        "sort_hbm_peak_bytes": hbm_peak,
+        "hbm_bytes_per_read": round(hbm_peak / N_RECORDS, 3),
     }
     # Run provenance for the headline number: backend/platform actually
     # used, every device-tier decision counter with its reason, and the

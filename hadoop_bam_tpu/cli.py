@@ -170,6 +170,28 @@ def _arm_trace(args, conf=None) -> bool:
     return True
 
 
+def _check_drained() -> None:
+    """End-of-run HBM leak check: any device allocation still held
+    (outside the serve arena, which keeps residency by design) is
+    force-closed as a leak — counted under ``hbm.leaked_bytes`` with its
+    holder named, flagged as a degradation reason in the run manifest,
+    and emitted onto the trace — instead of the run crashing or the pin
+    staying invisible.  Runs before the trace/metrics exports so the
+    verdict lands in both artifacts."""
+    from .utils.hbm import LEDGER
+
+    rep = LEDGER.assert_drained()
+    if rep["leaked_bytes"]:
+        holders = ", ".join(
+            f"{h}={n}B" for h, n in sorted(rep["holders"].items())
+        )
+        print(
+            f"warning: {rep['leaked_bytes']} HBM bytes leaked "
+            f"({holders}); run flagged degraded",
+            file=sys.stderr,
+        )
+
+
 def _export_trace(args) -> None:
     """Write the Chrome trace-event JSON and disarm (stderr status line —
     stdout may be carrying a BAM blob for ``view -o -``)."""
@@ -250,6 +272,7 @@ def _cmd_sort(args, mark_duplicates: bool = False) -> int:
             part_dir=args.part_dir,
             sort_order=sort_order,
         )
+    _check_drained()
     if traced:
         _export_trace(args)
     dup = (
@@ -320,6 +343,7 @@ def _cmd_fixmate(args) -> int:
         memory_budget=args.memory_budget,
         part_dir=args.part_dir,
     )
+    _check_drained()
     if traced:
         _export_trace(args)
     print(
@@ -357,6 +381,7 @@ def _cmd_view(args) -> int:
         blob = view_blob(ctx, args.bam, args.region, level=args.level)
     finally:
         ctx.close()
+        _check_drained()
         if traced:
             _export_trace(args)
     if args.output == "-":
@@ -383,6 +408,7 @@ def _cmd_flagstat(args) -> int:
         counts = flagstat(ctx, args.bam)
     finally:
         ctx.close()
+        _check_drained()
         if traced:
             _export_trace(args)
     print(json.dumps(counts, indent=2, sort_keys=True))
@@ -397,6 +423,9 @@ def _cmd_serve(args) -> int:
         SERVE_ARENA_BYTES,
         SERVE_BATCH_WINDOW_MS,
         SERVE_CACHE_BYTES,
+        SERVE_FLIGHTREC,
+        SERVE_FLIGHTREC_BYTES,
+        SERVE_FLIGHTREC_CADENCE_MS,
         SERVE_JOURNAL,
         SERVE_MAX_INFLIGHT,
         SERVE_MAX_QUEUE,
@@ -422,6 +451,12 @@ def _cmd_serve(args) -> int:
         conf.set_int(SERVE_MAX_QUEUE_MS, args.max_queue_ms)
     if args.journal is not None:
         conf.set(SERVE_JOURNAL, args.journal)
+    if args.flightrec is not None:
+        conf.set(SERVE_FLIGHTREC, args.flightrec)
+    if args.flightrec_cadence_ms is not None:
+        conf.set_int(SERVE_FLIGHTREC_CADENCE_MS, args.flightrec_cadence_ms)
+    if args.flightrec_bytes is not None:
+        conf.set_int(SERVE_FLIGHTREC_BYTES, args.flightrec_bytes)
     daemon = BamDaemon(
         conf=conf,
         socket_path=args.socket,
@@ -707,6 +742,22 @@ def build_parser() -> argparse.ArgumentParser:
              "accurate terminal job states, resumes interrupted sorts "
              "byte-identically via their part-dir checkpoints, and "
              "answers unknown ids with code JOB_LOST")
+    s.add_argument(
+        "--flightrec", default=None, metavar="BASE",
+        help="flight recorder ring base path "
+             "(hadoopbam.serve.flightrec): periodic gauge/counter/HBM "
+             "snapshots to a bounded two-segment JSONL ring, finalized "
+             "on drain — after a kill -9, replay the daemon's final "
+             "seconds with tools/flightrec_report.py")
+    s.add_argument(
+        "--flightrec-cadence-ms", type=int, default=None,
+        help="flight-recorder snapshot cadence in milliseconds "
+             "(hadoopbam.serve.flightrec-cadence-ms; default 500)")
+    s.add_argument(
+        "--flightrec-bytes", type=_parse_size, default=None,
+        metavar="BYTES",
+        help="flight-recorder ring byte budget across both segments "
+             "(hadoopbam.serve.flightrec-bytes; default 1m)")
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_serve)
 

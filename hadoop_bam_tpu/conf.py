@@ -108,6 +108,17 @@ SERVE_MAX_QUEUE_MS = "hadoopbam.serve.max-queue-ms"
 # (byte-identical), and marks anything unresumable "lost" instead of
 # forgetting it.  Unset = no journal (jobs die with the process).
 SERVE_JOURNAL = "hadoopbam.serve.journal"
+# Daemon flight recorder (serve/flightrec.py): a bounded on-disk JSONL
+# ring of periodic metrics/gauge/ledger snapshots (queue depth, admission
+# tokens, arena/cache/HBM occupancy, shed + OOM counters), written at the
+# configured cadence and finalized on SIGTERM drain.  After a kill -9 the
+# ring is replayable by the stdlib-only tools/flightrec_report.py, so the
+# journal-driven restart can also *explain* what the daemon was doing in
+# its final seconds.  FLIGHTREC is the ring's base path (two alternating
+# segment files <base>.0/<base>.1 bound total size); unset = no recorder.
+SERVE_FLIGHTREC = "hadoopbam.serve.flightrec"
+SERVE_FLIGHTREC_CADENCE_MS = "hadoopbam.serve.flightrec-cadence-ms"
+SERVE_FLIGHTREC_BYTES = "hadoopbam.serve.flightrec-bytes"
 # Pre-compile the pow2 geometry buckets of the device kernels at daemon
 # startup (serve/warmup.py) so first-request latency is warm; "false"
 # skips the warm-up (first requests then pay the compiles).

@@ -37,6 +37,7 @@ from ..conf import (
     Configuration,
 )
 from ..spec import bam, bgzf, indices
+from ..utils.hbm import LEDGER
 from ..utils.intervals import Interval, parse_intervals
 from ..utils.tracing import METRICS, span
 from .guesser import BamSplitGuesser
@@ -120,7 +121,11 @@ class ChunkedRecords:
 
     def release_device(self) -> None:
         """Drop the HBM-resident flat payload so it frees once the part
-        writes are done (the write-path residency lifetime)."""
+        writes are done (the write-path residency lifetime).  The
+        explicit ledger release is the audited event — skipping it is
+        exactly the leak shape the ledger's drill re-creates."""
+        if self.device_flat is not None:
+            LEDGER.release(self.device_flat)
         self.device_flat = None
         self.chunk_base = None
 
@@ -161,9 +166,22 @@ class ChunkedRecords:
                 import jax.numpy as jnp
 
                 parts = [b.device_data for b in batches]
-                device_flat = (
-                    parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-                )
+                if len(parts) == 1:
+                    # Ownership handoff, no copy: the split window IS the
+                    # write stream now.
+                    device_flat = LEDGER.transfer(
+                        parts[0], "bam.write_flat", kind="write_stream"
+                    )
+                else:
+                    # Device-to-device concat adopts the donors: their
+                    # per-split windows close cleanly in the ledger and
+                    # the flat stream carries the residency forward.
+                    device_flat = LEDGER.adopt(
+                        jnp.concatenate(parts),
+                        kind="write_stream",
+                        holder="bam.write_flat",
+                        donors=parts,
+                    )
                 chunk_base = np.cumsum(
                     [0] + [len(b.data) for b in batches[:-1]]
                 ).astype(np.int64)
@@ -858,8 +876,15 @@ def read_virtual_range(
         METRICS.count("bam.records_kept", len(soa["rec_off"]))
     # The device-resident copy is only exact on the no-spill fast path
     # (spill blocks are host-inflated into a grown buffer the device
-    # never saw).
-    device_data = dev_cell[0] if plen == len(out) else None
+    # never saw).  Exact: the batch takes ledger ownership of the HBM
+    # window; inexact: give it straight back so the codec's registration
+    # doesn't read as a leak.
+    device_data = None
+    if dev_cell[0] is not None:
+        if plen == len(out):
+            device_data = LEDGER.transfer(dev_cell[0], "bam.split_window")
+        else:
+            LEDGER.release(dev_cell[0])
     return RecordBatch(
         soa=soa, data=arr, keys=keys, device_data=device_data
     )
@@ -1325,11 +1350,18 @@ def _write_part_device(
         dm = dup_mask[order] if order is not None else dup_mask
         if not dm.any():
             dm = None
+    gathered = None
     try:
         from ..ops.pallas.gather_stream import gather_stream_device
 
         gathered, _ = gather_stream_device(
             stream_dev, src, lens, dup_mask=dm
+        )
+        # The permuted gather column is a second resident stream for the
+        # duration of the deflate — ledgered so the HBM track shows the
+        # write-phase bump and a dropped release would be named.
+        LEDGER.register(
+            gathered, kind="write_gather", holder="bam.device_write"
         )
         blob = _flate.deflate_blocks_device(
             None,
@@ -1346,6 +1378,9 @@ def _write_part_device(
         # Never fatal to a write — the host gather path is bit-correct.
         METRICS.count("bam.device_write_fallback", 1)
         return None
+    finally:
+        if gathered is not None:
+            LEDGER.release(gathered)
     if dm is not None:
         METRICS.count("bam.duplicate_flags_patched", int(dm.sum()))
     METRICS.count("bam.device_write_parts", 1)

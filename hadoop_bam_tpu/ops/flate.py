@@ -1362,11 +1362,20 @@ def inflate_blocks_device(
         starts = np.asarray(
             [out_offsets[i] for i in range(n) if usizes[i] > 0], np.int32
         )
+        from ..utils.hbm import LEDGER
         from ..utils.tracing import METRICS
 
         dev_flat = _device_flatten(
             dev2d, jnp.asarray(lane_of), jnp.asarray(starts),
             jnp.asarray(isz), int(out_offsets[-1]),
+        )
+        # Residency ledger: the inflate tier now owns a split window in
+        # HBM; the read path transfers ownership when it attaches the
+        # window to a RecordBatch, and whoever holds it last must
+        # release it — an unreleased window is a named leak.
+        dev_flat = LEDGER.register(
+            dev_flat, kind="split_window", holder="flate.inflate_device",
+            nbytes=int(out_offsets[-1]),
         )
         METRICS.count("flate.inflate_device_residency", 1)
     return out, out_offsets, dev_flat
@@ -1604,15 +1613,22 @@ def bgzf_compress_device(
     # 4-byte CRC column comes back d2h.
     dev_crcs: Optional[np.ndarray] = None
     if a is None:
+        from ..utils.hbm import LEDGER
         from .pallas.crc32 import crc32_device
 
-        dev_crcs = np.asarray(
-            crc32_device(
-                device_input,
-                np.arange(nblk, dtype=np.int64) * block_payload,
-                lens.astype(np.int64),
-            )
+        crc_dev = crc32_device(
+            device_input,
+            np.arange(nblk, dtype=np.int64) * block_payload,
+            lens.astype(np.int64),
         )
+        # The on-chip CRC column is ledgered for its (short) residency:
+        # registered, fetched, released — device bytes accounted even
+        # when the lifetime is one statement.
+        LEDGER.register(
+            crc_dev, kind="crc_column", holder="flate.deflate_crc"
+        )
+        dev_crcs = np.asarray(crc_dev)
+        LEDGER.release(crc_dev)
         count_d2h(dev_crcs.nbytes, "write_crc")
     total = int((18 + 8) * nblk + clens.sum())
     if append_terminator:

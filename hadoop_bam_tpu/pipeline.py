@@ -34,6 +34,7 @@ from .conf import (
     EXECUTOR_BACKOFF_MS,
     Configuration,
 )
+from .utils.hbm import LEDGER
 from .utils.tracing import METRICS, span, trace_ctx
 from .io.bam import (
     SORT_FIELDS,
@@ -61,6 +62,20 @@ class SortStats:
     n_ranges: int = 0  # out-of-core path: merge key ranges
     peak_bytes: int = 0  # out-of-core path: largest materialized chunk
     n_duplicates: int = 0  # markdup fusion stage: records flagged 0x400
+
+
+def _release_split_residency(b: RecordBatch) -> None:
+    """Give a split's HBM-resident window back through the residency
+    ledger and drop the reference.  Every path that is done with a
+    split's ``device_data`` — the unused-handoff case, the post-parse
+    drop, the post-adopt cleanup, the out-of-core spill loop — comes
+    through here, so a skipped release shows up as a *named*
+    ``hbm.leaked.<holder>`` counter instead of a silent HBM pin (the
+    PR 5 bug class; the leak drill monkeypatches exactly this helper)."""
+    dd = getattr(b, "device_data", None)
+    if dd is not None:
+        LEDGER.release(dd)
+    b.device_data = None
 
 
 def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
@@ -430,7 +445,7 @@ def sort_bam(
                 # Neither the device-parse path nor the device write
                 # consumes the residency handoff; don't pin HBM with
                 # unused split windows.
-                b.device_data = None
+                _release_split_residency(b)
             batches.append(b)
             if use_device_parse:
                 # The split's record stream ships to the chip as raw bytes;
@@ -459,7 +474,7 @@ def sort_bam(
                 # as the read proceeds instead of pinning every split —
                 # unless the device write path will gather parts from it.
                 if not use_device_write:
-                    b.device_data = None
+                    _release_split_residency(b)
             elif use_device:
                 pending.append(b.keys)
                 if (si + 1) % upload_every == 0:
@@ -575,11 +590,14 @@ def sort_bam(
         batches, with_keys=False, keep_device=use_device_write
     )
     if use_device_write:
-        # The flat device copy (if any) now owns the resident bytes; drop
-        # the per-split references so the originals free before the
-        # writes start instead of doubling HBM for the whole write phase.
+        # The flat device copy (if any) now owns the resident bytes
+        # (from_batches adopted the donors in the ledger); drop the
+        # per-split references so the originals free before the writes
+        # start instead of doubling HBM for the whole write phase.  When
+        # the adoption didn't happen (a split lacked residency, or the
+        # concat failed) the release here is the real one.
         for b in batches:
-            b.device_data = None
+            _release_split_residency(b)
     with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
         if part_dir is not None:
             # Persistent part dir: the parts are crash-restart units — a
@@ -791,7 +809,7 @@ def fixmate_bam(
                 cols_parts.append(
                     collation_columns(b.data, b.soa, with_cigars=True)
                 )
-            b.device_data = None  # fixmate rewrites host-side
+            _release_split_residency(b)  # fixmate rewrites host-side
             row_bases.append(row_bases[-1] + b.n_records)
             batches.append(b if keep_batches else None)
     n = row_bases[-1]
@@ -1404,8 +1422,10 @@ def _sort_bam_external(
                     # path cannot consume the inflate tier's residency
                     # handoff, so drop the device window per split — before
                     # this fix the refs silently pinned every split's
-                    # inflated bytes in HBM until its run flushed.
-                    b.device_data = None
+                    # inflated bytes in HBM until its run flushed.  The
+                    # ledger audits this exact release (the PR 5 drill
+                    # monkeypatches it away and asserts the named leak).
+                    _release_split_residency(b)
                     n += b.n_records
                     if acc and acc_bytes + len(b.data) > memory_budget:
                         flush()

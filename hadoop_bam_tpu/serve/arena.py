@@ -23,6 +23,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
+from ..utils.hbm import LEDGER
 from ..utils.tracing import METRICS
 
 
@@ -50,9 +51,27 @@ class HbmArena:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
         self.used_bytes = 0
+        METRICS.set_gauge(f"{self.name}.budget_bytes", budget_bytes)
+        self._publish_gauges()
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    def _publish_gauges(self) -> None:
+        """First-class occupancy gauges (``MetricsRegistry.set_gauge``):
+        the serve ``metrics`` op exports these in Prometheus text
+        without the server re-collecting arena numbers per scrape."""
+        METRICS.set_gauge(f"{self.name}.used_bytes", self.used_bytes)
+        METRICS.set_gauge(f"{self.name}.entries", len(self._entries))
+
+    @staticmethod
+    def _ledger_drop(batch) -> None:
+        """Release a dropped window's HBM residency through the ledger
+        (HBM frees when the last reference dies; the ledger release is
+        the audited bookkeeping event)."""
+        dd = getattr(batch, "device_data", None)
+        if dd is not None:
+            LEDGER.release(dd)
 
     def get(self, key: Hashable):
         with self._lock:
@@ -72,14 +91,22 @@ class HbmArena:
             old = self._entries.pop(key, None)
             if old is not None:
                 self.used_bytes -= old[0]
+                if old[1] is not batch:
+                    self._ledger_drop(old[1])
             self._entries[key] = (nb, batch)
             self.used_bytes += nb
             if getattr(batch, "device_data", None) is not None:
                 METRICS.count(f"{self.name}.device_resident", 1)
+                # Ownership handoff: the arena now holds the window's
+                # HBM residency across requests (by design — excluded
+                # from the end-of-run leak check).
+                LEDGER.transfer(batch.device_data, self.name)
             while self.used_bytes > self.budget and len(self._entries) > 1:
-                _, (nb_old, _) = self._entries.popitem(last=False)
+                _, (nb_old, b_old) = self._entries.popitem(last=False)
                 self.used_bytes -= nb_old
+                self._ledger_drop(b_old)
                 METRICS.count(f"{self.name}.evict", 1)
+            self._publish_gauges()
 
     def evict_lru(self, n: int = 1) -> int:
         """Forcibly drop the ``n`` least-recently-used entries — the OOM
@@ -91,9 +118,11 @@ class HbmArena:
         dropped = 0
         with self._lock:
             while self._entries and dropped < n:
-                _, (nb, _) = self._entries.popitem(last=False)
+                _, (nb, b_old) = self._entries.popitem(last=False)
                 self.used_bytes -= nb
+                self._ledger_drop(b_old)
                 dropped += 1
+            self._publish_gauges()
         if dropped:
             METRICS.count("serve.oom.evictions", dropped)
         return dropped
@@ -101,8 +130,11 @@ class HbmArena:
     def release_all(self) -> None:
         """Drop everything (daemon drain: HBM frees with the references)."""
         with self._lock:
+            for _, b_old in self._entries.values():
+                self._ledger_drop(b_old)
             self._entries.clear()
             self.used_bytes = 0
+            self._publish_gauges()
 
     def stats(self) -> dict:
         with self._lock:

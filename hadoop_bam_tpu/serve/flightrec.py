@@ -1,0 +1,221 @@
+"""Daemon flight recorder: the last seconds before death, on disk.
+
+The PR 10 journal makes a killed daemon's *jobs* recoverable; nothing
+makes its *state* explainable — after a ``kill -9`` the operator knows
+what was queued, not whether the daemon was drowning in admission waits,
+evicting the arena in a loop, or watching HBM climb.  This module is the
+black box: a background thread snapshots the daemon's gauges (queue
+depth, admission tokens, arena/cache/HBM occupancy) and its
+degradation-class counters (sheds, OOM tierdowns, journal events, HBM
+leaks) to an on-disk JSONL ring at a configurable cadence.
+
+The ring is two alternating segment files ``<base>.0`` / ``<base>.1``:
+the writer appends to the active segment (flushed per line — a SIGKILL
+loses at most the torn final line, since flushed bytes are in the kernel)
+and, when the active segment crosses half the byte budget, truncates the
+other segment and switches to it.  Total disk is bounded by the budget;
+the survivable history is at least half of it.  On a graceful drain the
+recorder writes one ``"final": true`` snapshot, so a ring *without* a
+final record is itself evidence of an unclean death.
+
+Replay is ``tools/flightrec_report.py`` — stdlib-only, torn-tail
+tolerant, ordered by ``seq`` across both segments.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.tracing import METRICS
+
+DEFAULT_CADENCE_MS = 500
+DEFAULT_RING_BYTES = 1 << 20
+
+#: Counter prefixes worth replaying after a crash: the degradation story.
+SNAPSHOT_COUNTER_PREFIXES = (
+    "serve.admission.shed",
+    "serve.admission.admitted",
+    "serve.oom.",
+    "serve.deadline.",
+    "serve.journal.",
+    "serve.jobs_",
+    "serve.request_errors",
+    "hbm.leaked",
+    "hbm.double_copy",
+)
+
+
+def default_source() -> Dict[str, dict]:
+    """Fallback snapshot source: registry gauges + degradation counters
+    (the daemon passes a richer closure over its live context)."""
+    counters = METRICS.report()["counters"]
+    return {
+        "gauges": METRICS.gauges(),
+        "counters": {
+            k: v
+            for k, v in counters.items()
+            if k.startswith(SNAPSHOT_COUNTER_PREFIXES)
+        },
+    }
+
+
+def segment_paths(base: str) -> Tuple[str, str]:
+    return base + ".0", base + ".1"
+
+
+class FlightRecorder:
+    """Bounded JSONL ring writer with a periodic snapshot thread."""
+
+    def __init__(
+        self,
+        base_path: str,
+        cadence_s: float = DEFAULT_CADENCE_MS / 1e3,
+        max_bytes: int = DEFAULT_RING_BYTES,
+        source: Optional[Callable[[], dict]] = None,
+    ) -> None:
+        self.base = base_path
+        self.cadence = max(0.02, float(cadence_s))
+        self.max_bytes = max(8 << 10, int(max_bytes))
+        self._source = source or default_source
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._f = None
+        self._active = 0
+        self._seq = 0
+        self._finalized = False
+
+    # -- segment management -------------------------------------------------
+
+    def _scan_existing(self) -> None:
+        """Resume numbering after the highest surviving seq (a restarted
+        daemon extends the ring; pre-death history stays replayable until
+        rotation naturally reclaims it)."""
+        best_seq, best_idx = -1, 0
+        for idx, p in enumerate(segment_paths(self.base)):
+            try:
+                with open(p, "rb") as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                            if int(rec.get("seq", -1)) > best_seq:
+                                best_seq = int(rec["seq"])
+                                best_idx = idx
+                        except (ValueError, TypeError):
+                            continue  # torn line
+            except OSError:
+                continue
+        self._seq = best_seq + 1
+        self._active = best_idx
+
+    def _ensure_open(self):
+        if self._f is None:
+            path = segment_paths(self.base)[self._active]
+            self._f = open(path, "ab")
+        return self._f
+
+    def _rotate_if_needed(self) -> None:
+        if self._f is not None and self._f.tell() > self.max_bytes // 2:
+            self._f.close()
+            self._active ^= 1
+            # Truncate the segment we are rotating onto: the ring
+            # reclaims the oldest half.
+            self._f = open(segment_paths(self.base)[self._active], "wb")
+            METRICS.count("serve.flightrec.rotations", 1)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        d = os.path.dirname(os.path.abspath(self.base))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._scan_existing()
+        self.snapshot()  # an immediate baseline record
+        self._thread = threading.Thread(
+            target=self._run, name="hbam-flightrec", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cadence):
+            try:
+                self.snapshot()
+            except Exception:  # noqa: BLE001 - the recorder never kills
+                METRICS.count("serve.flightrec.errors", 1)
+
+    def snapshot(self, final: bool = False) -> dict:
+        """Write one snapshot record (thread-safe; flushed so a SIGKILL
+        after return cannot lose it)."""
+        rec = {
+            "seq": 0,  # patched under the lock
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+            "final": bool(final),
+        }
+        try:
+            rec.update(self._source() or {})
+        except Exception:  # noqa: BLE001 - snapshot beats perfection
+            METRICS.count("serve.flightrec.source_errors", 1)
+        with self._lock:
+            if self._finalized:
+                return rec
+            rec["seq"] = self._seq
+            self._seq += 1
+            f = self._ensure_open()
+            f.write(json.dumps(rec, sort_keys=True).encode() + b"\n")
+            f.flush()
+            self._rotate_if_needed()
+            if final:
+                self._finalized = True
+        METRICS.count("serve.flightrec.snapshots", 1)
+        return rec
+
+    def stop(self, final: bool = True) -> None:
+        """Finalize the ring (SIGTERM drain / shutdown op): one last
+        snapshot flagged ``final`` so replay can tell a clean drain from
+        a kill, then close.  Idempotent."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final:
+            try:
+                self.snapshot(final=True)
+            except Exception:  # noqa: BLE001
+                METRICS.count("serve.flightrec.errors", 1)
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                finally:
+                    self._f = None
+
+
+def load_ring(base: str) -> Tuple[List[dict], int]:
+    """Read a ring back: ``(snapshots ordered by seq, torn_line_count)``.
+    Accepts the base path or either segment path; tolerant of torn final
+    lines (the kill -9 case) and missing segments."""
+    if base.endswith((".0", ".1")) and not os.path.exists(base + ".0"):
+        base = base[:-2]
+    snaps: Dict[int, dict] = {}
+    torn = 0
+    for p in segment_paths(base):
+        try:
+            with open(p, "rb") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        snaps[int(rec["seq"])] = rec
+                    except (ValueError, TypeError, KeyError):
+                        torn += 1
+        except OSError:
+            continue
+    return [snaps[k] for k in sorted(snaps)], torn

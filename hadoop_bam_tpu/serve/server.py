@@ -59,6 +59,9 @@ from .. import faults
 from ..conf import (
     Configuration,
     SERVE_ADMISSION_TOKENS,
+    SERVE_FLIGHTREC,
+    SERVE_FLIGHTREC_BYTES,
+    SERVE_FLIGHTREC_CADENCE_MS,
     SERVE_JOURNAL,
     SERVE_MAX_INFLIGHT,
     SERVE_MAX_QUEUE,
@@ -75,6 +78,7 @@ from ..utils.tracing import (
     snapshot,
     transfers_report,
 )
+from . import flightrec as flightrec_mod
 from . import journal as journal_mod
 from .admission import (
     DEADLINE_EXCEEDED,
@@ -142,6 +146,7 @@ class BamDaemon:
         warmup: Optional[bool] = None,
         warmup_kwargs: Optional[dict] = None,
         journal_path: Optional[str] = None,
+        flightrec_path: Optional[str] = None,
     ):
         self.conf = conf or Configuration()
         faults.arm_from_conf(self.conf)  # drills via hadoopbam.faults.plan
@@ -192,6 +197,26 @@ class BamDaemon:
             if self.journal_path
             else None
         )
+        # Flight recorder: periodic gauge/counter/ledger snapshots to a
+        # bounded on-disk ring — after a kill -9, the replay explains
+        # what the daemon was doing in its final seconds (the journal
+        # already explains what it *owed*).  Unset = no recorder.
+        self.flightrec_path = flightrec_path or self.conf.get(SERVE_FLIGHTREC)
+        self._flightrec = (
+            flightrec_mod.FlightRecorder(
+                self.flightrec_path,
+                cadence_s=self.conf.get_int(
+                    SERVE_FLIGHTREC_CADENCE_MS,
+                    flightrec_mod.DEFAULT_CADENCE_MS,
+                ) / 1e3,
+                max_bytes=self.conf.get_int(
+                    SERVE_FLIGHTREC_BYTES, flightrec_mod.DEFAULT_RING_BYTES
+                ),
+                source=self._flight_snapshot,
+            )
+            if self.flightrec_path
+            else None
+        )
         self._drain_requested = threading.Event()
         self._started_snapshot = snapshot()
 
@@ -230,6 +255,8 @@ class BamDaemon:
         lst.listen(64)
         lst.settimeout(0.1)
         self._listener = lst
+        if self._flightrec is not None:
+            self._flightrec.start()
         METRICS.count("serve.daemon_starts", 1)
 
     def _recover_journal(self) -> None:
@@ -348,6 +375,11 @@ class BamDaemon:
                 os.unlink(self.socket_path)
             except OSError:
                 pass
+        if self._flightrec is not None:
+            # Finalize the ring (idempotent — a drain already wrote the
+            # final snapshot; a kill never reaches here, which is the
+            # point: no final record = unclean death).
+            self._flightrec.stop(final=True)
         if self._journal is not None:
             self._journal.close()
         self.ctx.close()
@@ -605,7 +637,11 @@ class BamDaemon:
         cache = self.ctx.cache.stats()
         with self._jobs_lock:
             statuses = [j["status"] for j in self._jobs.values()]
-        g = {
+        # First-class registry gauges ride along (HBM ledger levels, the
+        # arena's own set_gauge() values): subsystems publish once, every
+        # surface — stats, metrics op, flight recorder — sees them.
+        g = METRICS.gauges()
+        g.update({
             "serve.arena.used_bytes": arena["used_bytes"],
             "serve.arena.budget_bytes": arena["budget_bytes"],
             "serve.arena.entries": arena["entries"],
@@ -621,11 +657,24 @@ class BamDaemon:
             ),
             "serve.jobs.max_inflight": self.max_inflight,
             "serve.draining": int(self._draining.is_set()),
-        }
+        })
         g.update(self.admission.gauges())
         if self.ctx.batcher is not None:
             g["serve.batch.queue_depth"] = self.ctx.batcher.queue_depth()
         return g
+
+    def _flight_snapshot(self) -> dict:
+        """The flight recorder's per-tick source: live gauges + the
+        degradation-class counters (sheds, OOM, journal, HBM leaks)."""
+        counters = METRICS.report()["counters"]
+        return {
+            "gauges": self._gauges(),
+            "counters": {
+                k: v
+                for k, v in counters.items()
+                if k.startswith(flightrec_mod.SNAPSHOT_COUNTER_PREFIXES)
+            },
+        }
 
     def _stats(self) -> dict:
         # Snapshot/delta exclusively — never reset(): the daemon-lifetime
@@ -657,6 +706,11 @@ class BamDaemon:
         reply is on the wire)."""
         self._draining.set()
         self._job_pool.shutdown(wait=True)
+        if self._flightrec is not None:
+            # The drain IS the clean-death marker: the final snapshot
+            # lands before the reply, so a ring whose last record is not
+            # final means the daemon died, not drained.
+            self._flightrec.stop(final=True)
         with self._jobs_lock:
             statuses = [j["status"] for j in self._jobs.values()]
         METRICS.count("serve.drains", 1)

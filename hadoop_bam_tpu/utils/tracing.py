@@ -131,10 +131,26 @@ class MetricsRegistry:
         self._spans: Dict[str, float] = {}
         self._span_counts: Dict[str, int] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, float] = {}
 
     def count(self, name: str, delta: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + delta
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Set a first-class point-in-time gauge (arena occupancy, HBM
+        ledger live/peak bytes, queue depths).  Unlike counters these are
+        levels, not totals: the latest write wins, snapshots carry the
+        current value, and the serve ``metrics`` op exports them in
+        Prometheus text without each subsystem keeping its own ad-hoc
+        gauges block."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauges(self) -> Dict[str, float]:
+        """A copy of the current gauge levels."""
+        with self._lock:
+            return dict(self._gauges)
 
     def add_span(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -159,6 +175,7 @@ class MetricsRegistry:
                 "histograms": {
                     k: h.as_dict() for k, h in self._hists.items()
                 },
+                "gauges": dict(self._gauges),
             }
 
     def histogram(self, name: str) -> Optional[Histogram]:
@@ -186,6 +203,7 @@ class MetricsRegistry:
             self._spans.clear()
             self._span_counts.clear()
             self._hists.clear()
+            self._gauges.clear()
 
 
 METRICS = MetricsRegistry()
@@ -268,6 +286,9 @@ def delta(
                 "sum": av.get("sum", 0.0) - bv.get("sum", 0.0),
             }
     out["histograms"] = hd
+    # Gauges are levels, not totals — a difference of two levels is
+    # meaningless, so the delta carries the *current* (after) levels.
+    out["gauges"] = dict(after.get("gauges", {}))
     return out
 
 
@@ -350,10 +371,13 @@ class Tracer:
         t0: float,
         t1: float,
         args: Optional[dict] = None,
+        merge_ctx: bool = True,
     ) -> None:
         """Append one complete event (perf_counter endpoints).  Ambient
-        :func:`trace_ctx` key/values merge under explicit ``args``."""
-        ctx = getattr(_TLS, "ctx", None)
+        :func:`trace_ctx` key/values merge under explicit ``args``
+        (``merge_ctx=False`` keeps ``args`` pure — counter events, whose
+        args are the series values)."""
+        ctx = getattr(_TLS, "ctx", None) if merge_ctx else None
         if ctx:
             args = {**ctx, **args} if args else dict(ctx)
         ev = (
@@ -381,6 +405,24 @@ class Tracer:
         t = time.perf_counter()
         self.emit(name, category, t, t, args)
 
+    #: Reserved category for counter-track events (``ph: "C"`` on export).
+    COUNTER_CATEGORY = "counter"
+
+    def counter(self, name: str, values: Dict[str, float]) -> None:
+        """A Chrome counter-track sample (``ph: "C"``): Perfetto renders
+        the named series as a stacked area chart alongside the stage
+        timeline — the HBM residency ledger samples ``hbm.live_bytes``
+        per allocation kind here, so memory-over-time is a *track*, not
+        an inference.  Ambient ``trace_ctx`` args are deliberately not
+        merged (they would become phantom series)."""
+        if not self.armed:
+            return
+        t = time.perf_counter()
+        self.emit(
+            name, self.COUNTER_CATEGORY, t, t, dict(values),
+            merge_ctx=False,
+        )
+
     def events(self) -> List[tuple]:
         """The live events, oldest first."""
         with self._lock:
@@ -398,6 +440,19 @@ class Tracer:
         pid = os.getpid()
         out = []
         for name, cat, t0, t1, tid, args in self.events():
+            if cat == self.COUNTER_CATEGORY:
+                # Counter-track sample: Perfetto draws args' numeric
+                # values as series of the named counter track.
+                ev = {
+                    "name": name,
+                    "ph": "C",
+                    "ts": round(t0 * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args or {},
+                }
+                out.append(ev)
+                continue
             ev = {
                 "name": name,
                 "cat": cat,
@@ -549,6 +604,7 @@ TIER_DECISION_PREFIXES = (
 FAULT_MODE_PREFIXES = (
     "salvage.", "bgzf.missing_eof", "faults.",
     "serve.admission.shed", "serve.deadline.", "serve.journal.",
+    "hbm.leaked", "hbm.double_copy",
 )
 
 
@@ -659,6 +715,27 @@ def run_manifest(
     for k, why in _FALLBACK_REASONS.items():
         if counters.get(k):
             reasons.append(f"{why} ({k}={counters[k]})")
+    leaked = counters.get("hbm.leaked_bytes", 0)
+    if leaked:
+        # The residency ledger's leak check fired: a device allocation
+        # was never explicitly released by its holder (the PR 5 bug
+        # class).  Named and degraded, never fatal.
+        holders = {
+            k[len("hbm.leaked."):]: v
+            for k, v in counters.items()
+            if k.startswith("hbm.leaked.")
+        }
+        top = max(holders, key=holders.get) if holders else "unknown"
+        reasons.append(
+            f"HBM residency leaked: {leaked} bytes never released "
+            f"by their holder (top holder {top}; hbm.leaked_bytes)"
+        )
+    if counters.get("hbm.double_copy"):
+        reasons.append(
+            "HBM double-copy: the same logical payload was resident "
+            f"under two holders (hbm.double_copy="
+            f"{counters['hbm.double_copy']})"
+        )
     if counters.get("salvage.members_quarantined") or counters.get(
         "salvage.records_dropped"
     ):
@@ -708,10 +785,17 @@ def prometheus_text(
     ``<prefix>_<name>_seconds_total`` (+ ``_count``), histograms the
     standard cumulative ``_bucket{le="…"}`` / ``_sum`` / ``_count``
     triplet, and ``gauges`` plain ``<prefix>_<name>`` samples.  Dots in
-    metric names map to underscores.
+    metric names map to underscores.  The report's own first-class
+    ``gauges`` section (``MetricsRegistry.set_gauge`` — arena occupancy,
+    HBM ledger levels) is merged under the explicit ``gauges`` argument,
+    so registered gauges export without each caller re-collecting them.
     """
     if report is None:
         report = METRICS.report()
+    merged_gauges = dict(report.get("gauges", {}))
+    if gauges:
+        merged_gauges.update(gauges)
+    gauges = merged_gauges
     lines: List[str] = []
     for k in sorted(report.get("counters", {})):
         n = f"{prefix}_{_prom_name(k)}_total"

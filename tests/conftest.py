@@ -72,6 +72,12 @@ def pytest_configure(config):
         "sort, fixmate, markdup-on-unsorted, collision rescue (run "
         "everywhere; the grouping pass is lax.sort, no Pallas kernels)",
     )
+    config.addinivalue_line(
+        "markers",
+        "hbm: HBM residency ledger + memory timeline + flight recorder "
+        "tests (leak/double-copy drills; run everywhere — the ledger is "
+        "object-agnostic)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

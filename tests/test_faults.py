@@ -395,7 +395,11 @@ def test_disarmed_strict_clean_run_is_zero_overhead(bam_corpus, tmp_path):
                          "serve.admission.", "serve.deadline.",
                          "serve.oom.", "serve.journal.",
                          "executor.deadline_exceeded",
-                         "flate.oom_tierdown", "bam.oom_tierdown"))
+                         "flate.oom_tierdown", "bam.oom_tierdown",
+                         # PR 11: a clean host run has no device
+                         # residency to ledger — and certainly no
+                         # leaked or double-resident bytes.
+                         "hbm."))
     ]
     assert leaked == []
 
@@ -877,6 +881,7 @@ def test_chaos_drill_overload_oom_die_and_byte_identical_resume(tmp_path):
 
     sock = str(tmp_path / "chaos.sock")
     jpath = str(tmp_path / "chaos.jsonl")
+    fpath = str(tmp_path / "flight")
     out = str(tmp_path / "resumed.bam")
     pdir = str(tmp_path / "parts")
     proc, client = _spawn_daemon_subprocess(
@@ -888,7 +893,12 @@ def test_chaos_drill_overload_oom_die_and_byte_identical_resume(tmp_path):
             # trusts).
             "HBAM_FAULTS": "arena.oom:n=4;exec.die:items=1,attempts=*,n=1",
         },
-        extra_args=["--admission-tokens", "2", "--max-queue", "1"],
+        extra_args=[
+            "--admission-tokens", "2", "--max-queue", "1",
+            # Flight recorder at a tight cadence: after the rc-137 death
+            # the ring must replay the daemon's final seconds.
+            "--flightrec", fpath, "--flightrec-cadence-ms", "100",
+        ],
     )
 
     # Concurrent mixed load: every request must terminate with either a
@@ -942,6 +952,28 @@ def test_chaos_drill_overload_oom_die_and_byte_identical_resume(tmp_path):
     jobs = journal_mod.replay(jpath)
     assert jobs[jid]["status"] == "running"  # journaled, not terminal
     assert journal_mod.recovery_plan(jobs) == {jid: "resume"}
+
+    # The flight recorder explains the death the journal only resumes:
+    # a readable ring with NO final snapshot (rc-137, not a drain) whose
+    # tail carries a sane pre-death state — the OOM storm's counters and
+    # the live gauges of a daemon that was mid-sort when it died.
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "flightrec_report",
+        os.path.join(REPO, "tools", "flightrec_report.py"),
+    )
+    _fr = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_fr)
+    frep = _fr.reduce_ring(*_fr.load_ring(fpath))
+    assert frep["snapshots"] >= 2, frep
+    assert frep["clean_drain"] is False  # no final record = unclean death
+    final = frep["final"]
+    assert "gauges" in final and "counters" in final
+    assert "serve.jobs.running" in final["gauges"]
+    assert "serve.admission.tokens_in_use" in final["gauges"]
+    assert final["counters"].get("serve.oom.tierdowns", 0) >= 1
+    assert _fr.main([fpath, "--json"]) == 0  # the CLI replays it too
 
     # Restart on the same journal, faults disarmed: the daemon resumes
     # the interrupted job and reproduces the uninterrupted bytes.

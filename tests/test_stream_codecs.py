@@ -336,6 +336,11 @@ class TestDeviceResidency:
         assert out.tobytes() == data
         assert dev is not None
         assert np.asarray(dev).tobytes() == data
+        # The handoff is ledgered (PR 11): give it back explicitly so
+        # the abandoned buffer doesn't count as a leak.
+        from hadoop_bam_tpu.utils.hbm import LEDGER
+
+        assert LEDGER.release(dev) is True
 
     def test_read_split_attaches_device_data(self, tmp_path):
         from hadoop_bam_tpu.io.bam import BamInputFormat
@@ -347,6 +352,11 @@ class TestDeviceResidency:
         b = fmt.read_split(split, device_inflate=True)
         assert b.device_data is not None
         assert np.asarray(b.device_data).tobytes() == b.data.tobytes()
+        # Attached residency is ledgered under the reader's holder.
+        from hadoop_bam_tpu.utils.hbm import LEDGER
+
+        assert LEDGER.live_by_holder().get("bam.split_window", 0) > 0
+        assert LEDGER.release(b.device_data) is True
 
     def test_device_parse_consumes_residency(self, tmp_path):
         from hadoop_bam_tpu.io.bam import BamInputFormat
@@ -375,6 +385,9 @@ class TestDeviceResidency:
             "sort_bam.device_parse_residency", 0
         )
         assert after == before + 1
+        from hadoop_bam_tpu.pipeline import _release_split_residency
+
+        _release_split_residency(b)
 
 
 @pytest.mark.slow

@@ -284,7 +284,12 @@ def test_sort_trace_e2e_stage_events(tmp_path):
     evs = doc["traceEvents"]
     assert evs, "traced sort produced no events"
     for e in evs:  # schema holds for every event
-        for k in ("ts", "dur", "ph", "name", "tid"):
+        keys = (
+            ("ts", "ph", "name", "tid")  # counter samples have no dur
+            if e.get("ph") == "C"
+            else ("ts", "dur", "ph", "name", "tid")
+        )
+        for k in keys:
             assert k in e
     stage_evs = [e for e in evs if e.get("cat") == "stage"]
     splits = sorted(
@@ -507,9 +512,49 @@ def test_prometheus_text_format():
     assert les == sorted(les)
 
 
+def test_tracer_counter_events_export_as_ph_c():
+    """Counter-track samples (the HBM ledger's hbm.live_bytes) export as
+    Chrome ``ph: "C"`` events with pure series args — ambient trace_ctx
+    must NOT merge in (it would become a phantom series)."""
+    t = Tracer()
+    t.start(capacity=32)
+    try:
+        with trace_ctx(split=3):
+            t.counter("hbm.live_bytes", {"total": 100, "split_window": 100})
+        evs = t.chrome_events()
+    finally:
+        t.stop()
+    assert len(evs) == 1
+    e = evs[0]
+    assert e["ph"] == "C" and e["name"] == "hbm.live_bytes"
+    assert e["args"] == {"total": 100, "split_window": 100}
+    assert "dur" not in e
+
+
+def test_registry_gauges_in_report_delta_and_prometheus():
+    reg = MetricsRegistry()
+    reg.set_gauge("serve.arena.used_bytes", 4096)
+    reg.set_gauge("hbm.live_bytes", 123)
+    rep = reg.report()
+    assert rep["gauges"]["serve.arena.used_bytes"] == 4096.0
+    # delta carries the current levels (a difference of levels is
+    # meaningless), and prometheus_text exports them with no explicit
+    # gauges argument.
+    from hadoop_bam_tpu.utils.tracing import delta as _delta
+    from hadoop_bam_tpu.utils.tracing import snapshot as _snapshot
+
+    d = _delta(_snapshot(reg), registry=reg)
+    assert d["gauges"]["hbm.live_bytes"] == 123.0
+    txt = prometheus_text(rep)
+    assert "# TYPE hbam_hbm_live_bytes gauge" in txt
+    assert "hbam_hbm_live_bytes 123" in txt
+    reg.reset()
+    assert reg.gauges() == {}
+
+
 _NAME_CALL = re.compile(
-    r'(?:METRICS\.count|METRICS\.observe|[^.\w]span|_trace_stage'
-    r'|count_h2d|count_d2h)\(\s*\n?\s*(f?)"([^"]+)'
+    r'(?:METRICS\.count|METRICS\.observe|METRICS\.set_gauge|[^.\w]span'
+    r'|_trace_stage|count_h2d|count_d2h)\(\s*\n?\s*(f?)"([^"]+)'
 )
 
 

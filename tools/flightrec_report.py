@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""Replay a daemon flight-recorder ring: the postmortem after a kill -9.
+
+The serve daemon (with ``--flightrec BASE``) snapshots its gauges (queue
+depth, admission tokens, arena/cache/HBM occupancy) and degradation
+counters (sheds, OOM tierdowns, journal events, HBM leaks) to a bounded
+two-segment JSONL ring (``BASE.0`` / ``BASE.1``), flushed per line and
+finalized with a ``"final": true`` record on a graceful drain.  After an
+unclean death the ring's tail IS the daemon's final seconds; this tool
+reads it back — stdlib-only, torn-tail tolerant — and prints:
+
+- a header: snapshot count, covered wall span, clean-drain verdict (a
+  ring without a final record means the daemon was killed, not drained);
+- a trend table of the last N snapshots (queue, tokens in use, arena and
+  HBM occupancy, cumulative sheds / OOM tierdowns);
+- the complete final snapshot.
+
+Usage:  python tools/flightrec_report.py BASE [--json] [--last N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def load_ring(base: str) -> Tuple[List[dict], int]:
+    """``(snapshots ordered by seq, torn_line_count)`` from a ring base
+    path (or either segment path).  Torn/corrupt lines — the kill -9
+    signature — are counted, never fatal."""
+    if base.endswith((".0", ".1")) and os.path.exists(base):
+        # A segment path was given directly; derive the family base so
+        # both halves of the ring are read.
+        if os.path.exists(base[:-2] + ".0") or os.path.exists(
+            base[:-2] + ".1"
+        ):
+            base = base[:-2]
+    snaps: Dict[int, dict] = {}
+    torn = 0
+    paths = [base + ".0", base + ".1"]
+    if os.path.isfile(base):
+        paths.append(base)
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        snaps[int(rec["seq"])] = rec
+                    except (ValueError, TypeError, KeyError):
+                        torn += 1
+        except OSError:
+            continue
+    return [snaps[k] for k in sorted(snaps)], torn
+
+
+def _g(rec: dict, key: str, default=0):
+    return (rec.get("gauges") or {}).get(key, default)
+
+
+def _c(rec: dict, key: str, default=0):
+    return (rec.get("counters") or {}).get(key, default)
+
+
+def reduce_ring(snaps: List[dict], torn: int) -> dict:
+    """The machine-readable postmortem: span, verdict, final snapshot,
+    and the key series (for CI/bench assertions)."""
+    if not snaps:
+        return {
+            "snapshots": 0,
+            "torn_lines": torn,
+            "clean_drain": False,
+            "final": None,
+        }
+    last = snaps[-1]
+    first = snaps[0]
+    series = [
+        {
+            "seq": r.get("seq"),
+            "t_wall": r.get("t_wall"),
+            "queued": _g(r, "serve.jobs.queued"),
+            "running": _g(r, "serve.jobs.running"),
+            "queue_depth": _g(r, "serve.admission.queue_depth"),
+            "tokens_in_use": _g(r, "serve.admission.tokens_in_use"),
+            "arena_used_bytes": _g(r, "serve.arena.used_bytes"),
+            "hbm_live_bytes": _g(r, "hbm.live_bytes"),
+            "shed": _c(r, "serve.admission.shed"),
+            "oom_tierdowns": _c(r, "serve.oom.tierdowns"),
+            "oom_evictions": _c(r, "serve.oom.evictions"),
+        }
+        for r in snaps
+    ]
+    return {
+        "snapshots": len(snaps),
+        "torn_lines": torn,
+        "span_seconds": (last.get("t_wall", 0) or 0)
+        - (first.get("t_wall", 0) or 0),
+        "last_wall_time": last.get("t_wall"),
+        "clean_drain": bool(last.get("final")),
+        "final": last,
+        "series": series,
+    }
+
+
+def format_report(rep: dict, last_n: int = 10) -> str:
+    if not rep["snapshots"]:
+        return "empty flight ring (no parseable snapshots)"
+    verdict = (
+        "clean drain (final snapshot present)"
+        if rep["clean_drain"]
+        else "UNCLEAN DEATH — no final snapshot; the tail below is the "
+        "daemon's last recorded seconds"
+    )
+    lines = [
+        f"flight ring: {rep['snapshots']} snapshots over "
+        f"{rep['span_seconds']:.1f} s"
+        + (f", {rep['torn_lines']} torn line(s)" if rep["torn_lines"] else ""),
+        f"verdict: {verdict}",
+    ]
+    if rep.get("last_wall_time"):
+        age = time.time() - rep["last_wall_time"]
+        lines.append(f"last snapshot: {age:.1f} s ago")
+    lines.append("")
+    lines.append(
+        f"{'seq':>6} {'t+s':>7} {'queue':>5} {'run':>4} {'tok':>4} "
+        f"{'arena B':>10} {'hbm B':>10} {'sheds':>6} {'oom':>5}"
+    )
+    series = rep["series"][-last_n:]
+    t0 = rep["series"][0].get("t_wall") or 0
+    for r in series:
+        lines.append(
+            f"{r['seq']:>6} {(r['t_wall'] or 0) - t0:>7.1f} "
+            f"{int(r['queue_depth'] or r['queued']):>5} "
+            f"{int(r['running']):>4} {int(r['tokens_in_use']):>4} "
+            f"{int(r['arena_used_bytes']):>10} "
+            f"{int(r['hbm_live_bytes']):>10} {int(r['shed']):>6} "
+            f"{int(r['oom_tierdowns']):>5}"
+        )
+    lines.append("")
+    lines.append("final snapshot:")
+    lines.append(json.dumps(rep["final"], indent=2, sort_keys=True))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay a serve-daemon flight-recorder ring "
+        "(the postmortem companion to the job journal)"
+    )
+    ap.add_argument("ring", help="ring base path (serve --flightrec BASE)")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the reduced postmortem as JSON",
+    )
+    ap.add_argument(
+        "--last", type=int, default=10,
+        help="trend-table rows from the tail (default 10)",
+    )
+    args = ap.parse_args(argv)
+    snaps, torn = load_ring(args.ring)
+    rep = reduce_ring(snaps, torn)
+    if not rep["snapshots"]:
+        print(
+            f"no parseable snapshots under {args.ring!r} "
+            "(was the daemon run with --flightrec?)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print(format_report(rep, last_n=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head closed us; not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -33,18 +33,29 @@ from typing import Dict, List, Optional, Tuple
 Interval = Tuple[float, float]
 
 
-def load_events(path_or_stream) -> List[dict]:
-    """Chrome trace-event JSON → the list of complete ('X') events.
+def load_trace(path_or_stream) -> Tuple[List[dict], dict]:
+    """Chrome trace-event JSON → ``(all events, metadata)``.
 
     Accepts both the object form (``{"traceEvents": [...]}``) the tracer
-    writes and the bare-array form some tools emit.
+    writes and the bare-array form some tools emit.  Metadata carries the
+    exporter's ``otherData`` (notably ``dropped_events`` — a nonzero
+    count means the ring overflowed and the oldest timeline is gone).
     """
     if hasattr(path_or_stream, "read"):
         doc = json.load(path_or_stream)
     else:
         with open(path_or_stream) as f:
             doc = json.load(f)
-    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if isinstance(doc, dict):
+        return list(doc.get("traceEvents", [])), dict(
+            doc.get("otherData", {})
+        )
+    return list(doc), {}
+
+
+def load_events(path_or_stream) -> List[dict]:
+    """The complete ('X') events only — the stall reducer's input."""
+    events, _ = load_trace(path_or_stream)
     return [e for e in events if e.get("ph") == "X"]
 
 
@@ -175,6 +186,172 @@ def stage_report(
     }
 
 
+def memory_report(
+    events: List[dict], category: str = "hbm"
+) -> Optional[dict]:
+    """Reduce the HBM residency ledger's trace events to the memory
+    section: peak occupancy with its holder breakdown, residency over
+    time, double-copy windows, and the leak verdict.
+
+    The ledger emits zero-duration instants (``hbm.alloc`` /
+    ``hbm.free`` / ``hbm.transfer`` / ``hbm.leak`` / ``hbm.double_copy``
+    in ``cat: "hbm"``, args carrying ``id/bytes/kind/holder/logical``)
+    plus ``ph: "C"`` counter samples of ``hbm.live_bytes``.  This
+    replays the instants into a live set, so the report works from the
+    trace alone — no process state needed.  Returns None when the trace
+    has no ledger events (a host-only run).
+    """
+    evs = sorted(
+        (
+            e
+            for e in events
+            if e.get("ph") == "X" and e.get("cat") == category
+        ),
+        key=lambda e: float(e.get("ts", 0.0)),
+    )
+    if not evs:
+        return None
+    live: Dict[int, dict] = {}  # id -> {bytes, holder, kind, logical}
+    live_bytes = 0
+    peak = 0
+    peak_holders: Dict[str, float] = {}
+    peak_ts = 0.0
+    leaked_bytes = 0
+    leaked_holders: Dict[str, float] = {}
+    freed_bytes = 0
+    double_windows: List[dict] = []
+    open_windows: Dict[str, dict] = {}  # logical -> window under build
+    counts = {"alloc": 0, "free": 0, "transfer": 0, "leak": 0}
+
+    def _holders() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for v in live.values():
+            out[v["holder"]] = out.get(v["holder"], 0) + v["bytes"]
+        return out
+
+    def _logical_holders(logical: str) -> List[str]:
+        return sorted(
+            {v["holder"] for v in live.values() if v["logical"] == logical}
+        )
+
+    for e in evs:
+        a = e.get("args") or {}
+        name = e.get("name", "")
+        ts = float(e.get("ts", 0.0))
+        eid = a.get("id")
+        if name == "hbm.alloc":
+            counts["alloc"] += 1
+            live[eid] = {
+                "bytes": float(a.get("bytes", 0)),
+                "holder": a.get("holder", "unknown"),
+                "kind": a.get("kind", "unknown"),
+                "logical": a.get("logical", ""),
+            }
+            live_bytes += live[eid]["bytes"]
+            if live_bytes > peak:
+                peak = live_bytes
+                peak_holders = _holders()
+                peak_ts = ts
+            lg = live[eid]["logical"]
+            if (
+                lg
+                and len(_logical_holders(lg)) > 1
+                and lg not in open_windows
+            ):
+                open_windows[lg] = {
+                    "logical": lg,
+                    "holders": _logical_holders(lg),
+                    "t0_ms": ts / 1e3,
+                }
+        elif name in ("hbm.free", "hbm.leak"):
+            key = "leak" if name == "hbm.leak" else "free"
+            counts[key] += 1
+            v = live.pop(eid, None)
+            nb = float(a.get("bytes", v["bytes"] if v else 0))
+            live_bytes -= nb
+            if name == "hbm.leak":
+                leaked_bytes += nb
+                h = a.get("holder", v["holder"] if v else "unknown")
+                leaked_holders[h] = leaked_holders.get(h, 0) + nb
+            else:
+                freed_bytes += nb
+            lg = v["logical"] if v else a.get("logical", "")
+            if lg in open_windows and len(_logical_holders(lg)) <= 1:
+                w = open_windows.pop(lg)
+                w["t1_ms"] = ts / 1e3
+                double_windows.append(w)
+        elif name == "hbm.transfer":
+            counts["transfer"] += 1
+            if eid in live:
+                live[eid]["holder"] = a.get(
+                    "holder", live[eid]["holder"]
+                )
+                if "kind" in a:
+                    live[eid]["kind"] = a["kind"]
+    # Windows still open at end-of-trace close there.
+    end_ts = float(evs[-1].get("ts", 0.0))
+    for w in open_windows.values():
+        w["t1_ms"] = end_ts / 1e3
+        double_windows.append(w)
+    live_at_end = sum(v["bytes"] for v in live.values())
+    top_holder = (
+        max(peak_holders, key=peak_holders.get) if peak_holders else None
+    )
+    verdict = "clean"
+    if double_windows:
+        verdict = "double-copy"
+    if leaked_bytes:
+        verdict = "leaked"
+    return {
+        "peak_bytes": peak,
+        "peak_ts_ms": peak_ts / 1e3,
+        "top_holder": top_holder,
+        "peak_holders": peak_holders,
+        "events": counts,
+        "freed_bytes": freed_bytes,
+        "leaked_bytes": leaked_bytes,
+        "leaked_holders": leaked_holders,
+        "live_at_end_bytes": live_at_end,
+        "double_copy_windows": double_windows,
+        "verdict": verdict,
+    }
+
+
+def format_memory_report(mem: dict) -> str:
+    lines = [
+        "",
+        f"HBM residency: peak {mem['peak_bytes']:.0f} B"
+        + (
+            f" (top holder {mem['top_holder']})"
+            if mem["top_holder"]
+            else ""
+        )
+        + f", verdict: {mem['verdict']}",
+    ]
+    if mem["peak_holders"]:
+        lines.append("  at peak:")
+        for h, b in sorted(
+            mem["peak_holders"].items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(f"    {h:<32} {b:>12.0f} B")
+    c = mem["events"]
+    lines.append(
+        f"  events: {c['alloc']} alloc, {c['free']} free, "
+        f"{c['transfer']} transfer, {c['leak']} leak; "
+        f"leaked {mem['leaked_bytes']:.0f} B, "
+        f"live at trace end {mem['live_at_end_bytes']:.0f} B"
+    )
+    for h, b in sorted(mem["leaked_holders"].items(), key=lambda kv: -kv[1]):
+        lines.append(f"  LEAKED by {h}: {b:.0f} B")
+    for w in mem["double_copy_windows"]:
+        lines.append(
+            f"  DOUBLE COPY: logical {w['logical']!r} resident under "
+            f"{' + '.join(w['holders'])} for "
+            f"{w['t1_ms'] - w['t0_ms']:.3f} ms"
+        )
+    return "\n".join(lines)
+
+
 def format_report(rep: dict) -> str:
     lines = [
         f"trace wall: {rep['wall_ms']:.3f} ms  "
@@ -223,20 +400,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="event category to attribute (default: stage)",
     )
     args = ap.parse_args(argv)
-    events = load_events(args.trace)
+    all_events, meta = load_trace(args.trace)
+    events = [e for e in all_events if e.get("ph") == "X"]
     rep = stage_report(events, category=args.category)
-    if rep is None:
+    mem = memory_report(all_events)
+    if rep is None and mem is None:
         print(
             f"no {args.category!r} events in {args.trace} "
             "(was the run traced with --trace?)",
             file=sys.stderr,
         )
         return 1
+    dropped = int(meta.get("dropped_events", 0) or 0)
     if args.json:
-        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        out = dict(rep or {})
+        out["memory"] = mem
+        out["dropped_events"] = dropped
+        json.dump(out, sys.stdout, indent=2, sort_keys=True)
         print()
     else:
-        print(format_report(rep))
+        if dropped:
+            print(
+                f"warning: {dropped} oldest events dropped from the "
+                "trace ring — totals below cover a truncated timeline "
+                "(raise hadoopbam.trace.events)",
+                file=sys.stderr,
+            )
+        if rep is not None:
+            print(format_report(rep))
+        if mem is not None:
+            print(format_memory_report(mem))
     return 0
 
 
