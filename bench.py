@@ -391,12 +391,94 @@ def _serve_bench(tmp: str) -> dict:
     finally:
         client.shutdown()
         t.join(timeout=30)
-    return {
+    out = {
         "serve_view_qps": round(qps, 1),
         "serve_view_cold_ms": round(cold_s * 1e3, 2),
         "serve_view_warm_ms": round(warm_s * 1e3, 2),
         "serve_warm_vs_cold_latency": round(cold_s / max(warm_s, 1e-9), 2),
     }
+    # Request-tracing overhead: the daemon above ran with the tracing
+    # plane ON (the default — trace ids, hop summaries, tail sampler);
+    # measure the warm loop traced-vs-untraced and report the QPS cost
+    # as a percentage.  The always-on summary path's contract is <2%.
+    try:
+        out["serve_traced_overhead_pct"] = _traced_overhead(
+            tmp, srt, region
+        )
+    except Exception as e:  # diagnostic only
+        out["serve_traced_overhead_error"] = str(e)[:120]
+    return out
+
+
+def _traced_overhead(tmp: str, srt: str, region: str) -> float:
+    """Warm-view cost of the request-tracing plane, as
+    ``(qps_off - qps_on) / qps_off * 100`` (negative = noise).
+
+    Two daemons (tracing on / off) run simultaneously and the warm loop
+    *interleaves* between them in rounds, comparing per-round median
+    latencies — back-to-back whole-daemon runs drift (allocator, cache
+    and frequency state) by more than the plane costs, so a sequential
+    A-then-B comparison measures the machine's mood, not the feature.
+    In a single-client closed loop the QPS ratio is the inverse latency
+    ratio.  The tracer ring is process-global and armed by the traced
+    daemon, so both daemons share its (one-span) cost: what this number
+    isolates is exactly the per-request summary path — id propagation,
+    hop annotations, the sampler's completion check — which is the
+    path the <2% contract covers."""
+    import threading
+
+    from hadoop_bam_tpu.conf import SERVE_REQUEST_TRACING, Configuration
+    from hadoop_bam_tpu.serve import BamDaemon, ServeClient
+
+    daemons = []
+    clients = []
+    try:
+        for label, tracing in (("on", True), ("off", False)):
+            conf = Configuration()
+            conf.set_boolean(SERVE_REQUEST_TRACING, tracing)
+            sock = os.path.join(tmp, f"serve_traced_{label}.sock")
+            d = BamDaemon(conf=conf, socket_path=sock, warmup=False)
+            ready = threading.Event()
+            t = threading.Thread(
+                target=d.serve_forever, args=(ready,), daemon=True
+            )
+            t.start()
+            if not ready.wait(120):
+                raise RuntimeError("overhead bench daemon did not come up")
+            daemons.append((d, t))
+            clients.append(ServeClient(socket_path=sock))
+        for c in clients:
+            for _ in range(30):  # warm caches + allocator on both
+                c.view(srt, region, level=1)
+        # Per-round MIN latency (the estimator serve_view_warm_ms
+        # already uses): scheduler/GC noise is strictly additive, so
+        # the min isolates the deterministic per-request cost — which
+        # is what the plane actually adds.  Rounds alternate A/B order
+        # (slow drift cancels), and the first two rounds are discarded
+        # (allocator/jit settling lands there).
+        mins = {0: [], 1: []}
+        n_rounds, discard = 8, 2
+        for r in range(n_rounds):
+            order = (0, 1) if r % 2 == 0 else (1, 0)
+            for i in order:
+                best = float("inf")
+                for _ in range(40):
+                    t1 = time.perf_counter()
+                    clients[i].view(srt, region, level=1)
+                    best = min(best, time.perf_counter() - t1)
+                if r >= discard:
+                    mins[i].append(best)
+        med_on = sorted(mins[0])[len(mins[0]) // 2]
+        med_off = sorted(mins[1])[len(mins[1]) // 2]
+    finally:
+        for c in clients:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+        for _, t in daemons:
+            t.join(timeout=30)
+    return round((med_on - med_off) / max(med_on, 1e-9) * 100, 2)
 
 
 def _overload_bench(tmp: str) -> dict:

@@ -419,10 +419,15 @@ def _cmd_serve(args) -> int:
     """Run the resident daemon until a ``shutdown`` request (or SIGINT)."""
     from .conf import (
         Configuration,
+        SERVE_ACCESS_LOG,
+        SERVE_ACCESS_LOG_BYTES,
         SERVE_ADMISSION_TOKENS,
         SERVE_ARENA_BYTES,
         SERVE_BATCH_WINDOW_MS,
         SERVE_CACHE_BYTES,
+        SERVE_EXEMPLAR_DIR,
+        SERVE_EXEMPLAR_THRESHOLD_MS,
+        SERVE_EXEMPLARS_MAX,
         SERVE_FLIGHTREC,
         SERVE_FLIGHTREC_BYTES,
         SERVE_FLIGHTREC_CADENCE_MS,
@@ -430,6 +435,9 @@ def _cmd_serve(args) -> int:
         SERVE_MAX_INFLIGHT,
         SERVE_MAX_QUEUE,
         SERVE_MAX_QUEUE_MS,
+        SERVE_REQUEST_TRACING,
+        SERVE_SLO,
+        SERVE_SLO_WINDOWS,
     )
     from .serve.server import BamDaemon
 
@@ -457,6 +465,22 @@ def _cmd_serve(args) -> int:
         conf.set_int(SERVE_FLIGHTREC_CADENCE_MS, args.flightrec_cadence_ms)
     if args.flightrec_bytes is not None:
         conf.set_int(SERVE_FLIGHTREC_BYTES, args.flightrec_bytes)
+    if args.no_request_tracing:
+        conf.set_boolean(SERVE_REQUEST_TRACING, False)
+    if args.exemplar_threshold_ms is not None:
+        conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, args.exemplar_threshold_ms)
+    if args.exemplars_max is not None:
+        conf.set_int(SERVE_EXEMPLARS_MAX, args.exemplars_max)
+    if args.exemplar_dir is not None:
+        conf.set(SERVE_EXEMPLAR_DIR, args.exemplar_dir)
+    if args.access_log is not None:
+        conf.set(SERVE_ACCESS_LOG, args.access_log)
+    if args.access_log_bytes is not None:
+        conf.set_int(SERVE_ACCESS_LOG_BYTES, args.access_log_bytes)
+    if args.slo is not None:
+        conf.set(SERVE_SLO, args.slo)
+    if args.slo_windows is not None:
+        conf.set(SERVE_SLO_WINDOWS, args.slo_windows)
     daemon = BamDaemon(
         conf=conf,
         socket_path=args.socket,
@@ -479,6 +503,53 @@ def _cmd_serve(args) -> int:
         daemon.serve_forever()
     except KeyboardInterrupt:
         daemon.stop()
+    return 0
+
+
+def _cmd_stats(args) -> int:
+    """One stats snapshot from a running daemon, with the SLO block
+    pretty-printed (burn rates, window compliance, worst op) — the
+    operator's "is the service meeting its objectives" one-liner."""
+    import json
+
+    from .serve.client import ServeClient
+    from .serve.slo import format_slo_block
+
+    client = ServeClient(socket_path=args.socket, port=args.port)
+    st = client.stats()
+    if args.json:
+        print(json.dumps(st, indent=2, sort_keys=True, default=str))
+        return 0
+    print(format_slo_block(st.get("slo") or {}))
+    hists = (st.get("metrics") or {}).get("histograms") or {}
+    lat = {
+        k: v for k, v in hists.items()
+        if k.startswith("serve.op.") and k.endswith(".ms")
+    }
+    if lat:
+        print("\nper-op latency (log2-bucket percentiles, ms):")
+        for k in sorted(lat):
+            h = lat[k]
+            print(
+                f"  {k:<28} n={h.get('count', 0):<8.0f} "
+                f"p50≤{h.get('p50', 0):g} p95≤{h.get('p95', 0):g} "
+                f"p99≤{h.get('p99', 0):g}"
+            )
+    jobs = st.get("jobs") or {}
+    if jobs:
+        by_status: dict = {}
+        for j in jobs.values():
+            by_status[j["status"]] = by_status.get(j["status"], 0) + 1
+        print("\njobs: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_status.items())
+        ))
+    ex_count = (st.get("gauges") or {}).get("serve.trace.exemplar_count")
+    if ex_count:
+        print(
+            f"\nexemplars held: {ex_count:.0f} "
+            "(list with the `exemplars` op; render one with "
+            "tools/request_report.py)"
+        )
     return 0
 
 
@@ -758,8 +829,68 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="BYTES",
         help="flight-recorder ring byte budget across both segments "
              "(hadoopbam.serve.flightrec-bytes; default 1m)")
+    s.add_argument(
+        "--no-request-tracing", action="store_true",
+        help="turn the per-request tracing plane off "
+             "(hadoopbam.serve.request-tracing; on by default — "
+             "trace-id propagation, hop summaries, tail exemplars)")
+    s.add_argument(
+        "--exemplar-threshold-ms", type=int, default=None,
+        help="latency threshold for the tail sampler: a request slower "
+             "than this gets its full event set copied into the "
+             "exemplar store (hadoopbam.serve.exemplar-threshold-ms, "
+             "default 1000; 0 disables the latency trigger — "
+             "shed/deadline/error/tier-down outcomes always sample)")
+    s.add_argument(
+        "--exemplars-max", type=int, default=None,
+        help="exemplar store bound, oldest evicted "
+             "(hadoopbam.serve.exemplars-max, default 64)")
+    s.add_argument(
+        "--exemplar-dir", default=None, metavar="DIR",
+        help="also spill each exemplar as DIR/<trace_id>.json "
+             "(hadoopbam.serve.exemplar-dir) — survives the daemon; "
+             "render with tools/request_report.py")
+    s.add_argument(
+        "--access-log", default=None, metavar="BASE",
+        help="JSONL access log base path (hadoopbam.serve.access-log): "
+             "one structured line per completed request (trace id, op, "
+             "outcome, duration, queue/batch waits, tier decisions), "
+             "rotated with the flight recorder's two-segment scheme; "
+             "joins with exemplars on trace id")
+    s.add_argument(
+        "--access-log-bytes", type=_parse_size, default=None,
+        metavar="BYTES",
+        help="access-log ring byte budget across both segments "
+             "(hadoopbam.serve.access-log-bytes; default 4m)")
+    s.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="declared SLO objectives (hadoopbam.serve.slo), e.g. "
+             "'view:latency=100@0.999;sort:availability=0.99' — "
+             "evaluated over sliding windows from the per-op "
+             "histograms; burn-rate alerts surface in stats, the "
+             "flight recorder and Prometheus text")
+    s.add_argument(
+        "--slo-windows", default=None, metavar="FAST,SLOW",
+        help="SLO sliding windows in seconds "
+             "(hadoopbam.serve.slo-windows; default '60,600')")
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_serve)
+
+    s = sub.add_parser(
+        "stats",
+        help="one stats snapshot from a running daemon with the SLO "
+             "block pretty-printed (burn rates, compliance, worst op)",
+    )
+    s.add_argument(
+        "--socket", default=None,
+        help="daemon UDS socket path (default: the per-user default)")
+    s.add_argument(
+        "--port", type=int, default=None,
+        help="daemon 127.0.0.1 TCP port instead of a UDS socket")
+    s.add_argument(
+        "--json", action="store_true",
+        help="emit the raw stats reply as JSON instead of the summary")
+    s.set_defaults(func=_cmd_stats)
 
     return p
 

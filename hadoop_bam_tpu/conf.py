@@ -119,6 +119,41 @@ SERVE_JOURNAL = "hadoopbam.serve.journal"
 SERVE_FLIGHTREC = "hadoopbam.serve.flightrec"
 SERVE_FLIGHTREC_CADENCE_MS = "hadoopbam.serve.flightrec-cadence-ms"
 SERVE_FLIGHTREC_BYTES = "hadoopbam.serve.flightrec-bytes"
+# Request-scoped tracing plane (PR 12).  REQUEST_TRACING ("true" by
+# default) arms the daemon's timeline tracer and gives every request a
+# Dapper-style RequestContext — a 128-bit trace id originated by the
+# client (ServeClient) or at dispatch, carried through admission, the
+# lane batcher, endpoints, the executor and the OOM/journal seams, and
+# annotated onto every tracer event so one request's causal tree is
+# reassemblable from the ring.  "false" turns the whole plane off
+# (requests still work; they just leave no per-request trail).
+SERVE_REQUEST_TRACING = "hadoopbam.serve.request-tracing"
+# Tail sampler: a request slower than EXEMPLAR_THRESHOLD_MS (or ending
+# in SHED/DEADLINE_EXCEEDED/error, or that OOM-tiered-down) gets its
+# full event set copied out of the tracer ring into a bounded per-daemon
+# exemplar store (EXEMPLARS_MAX entries, oldest evicted), exportable via
+# the `exemplars` serve op; with EXEMPLAR_DIR set each exemplar is also
+# spilled as <dir>/<trace_id>.json so it survives the daemon.
+# Threshold 0 disables the latency trigger (outcome triggers stay).
+SERVE_EXEMPLAR_THRESHOLD_MS = "hadoopbam.serve.exemplar-threshold-ms"
+SERVE_EXEMPLARS_MAX = "hadoopbam.serve.exemplars-max"
+SERVE_EXEMPLAR_DIR = "hadoopbam.serve.exemplar-dir"
+# JSONL access log: one structured line per completed request (trace id,
+# op, outcome, duration, queue/batch waits, tier decisions, shed/OOM
+# flags) at the given base path, rotated with the flight recorder's
+# two-segment scheme under ACCESS_LOG_BYTES total; joins with exemplars
+# on trace id.  Unset = no access log.
+SERVE_ACCESS_LOG = "hadoopbam.serve.access-log"
+SERVE_ACCESS_LOG_BYTES = "hadoopbam.serve.access-log-bytes"
+# SLO monitor (serve/slo.py): declared objectives per op, e.g.
+# "view:latency=100@0.999;sort:availability=0.99" (latency thresholds in
+# ms; targets as fractions), evaluated over two sliding windows
+# ("fast_s,slow_s" seconds, default "60,600") from the existing per-op
+# histograms.  Multi-window burn-rate alerts surface in the stats op,
+# the flight recorder, and the Prometheus text.  Unset = the default
+# objective set (serve/slo.py DEFAULT_OBJECTIVES).
+SERVE_SLO = "hadoopbam.serve.slo"
+SERVE_SLO_WINDOWS = "hadoopbam.serve.slo-windows"
 # Pre-compile the pow2 geometry buckets of the device kernels at daemon
 # startup (serve/warmup.py) so first-request latency is warm; "false"
 # skips the warm-up (first requests then pay the compiles).

@@ -1064,12 +1064,27 @@ class CodecTierStats:
         return {k: getattr(self, k) for k in self.__slots__}
 
     def publish(self, prefix: str) -> None:
-        from ..utils.tracing import METRICS
+        from ..utils.tracing import METRICS, current_request
 
         for k in self.__slots__:
             v = getattr(self, k)
             if v:
                 METRICS.count(f"{prefix}.{k}", v)
+        rctx = current_request()
+        if rctx is not None and self.total:
+            # The per-call tier verdict as a request hop: which codec
+            # tier actually served this request's members (the serve
+            # waterfall's "which kernel ran" answer).  Named ``codec.*``
+            # — NOT ``tier.*``, which the tail sampler treats as a
+            # degradation trigger; a clean all-lanes call is not one.
+            rctx.annotate(
+                f"codec.{prefix.rsplit('.', 1)[-1]}",
+                **{
+                    k: getattr(self, k)
+                    for k in self.__slots__
+                    if getattr(self, k)
+                },
+            )
 
 
 #: Tier accounting of the most recent wrapper call (read by bench.py).
@@ -1236,6 +1251,18 @@ def _lanes_decode_members(
             # OOM degradation (and the run manifest) can tell "HBM was
             # full" from "the kernel rejected the member".
             METRICS.count("flate.oom_tierdown", 1)
+        from ..utils.tracing import current_request
+
+        rctx = current_request()
+        if rctx is not None:
+            # A codec tier decision is a request hop: a served request
+            # whose members tiered down names the seam in its waterfall
+            # instead of just paying an unexplained slower decode.
+            rctx.annotate(
+                "tier.inflate_lanes_down",
+                members=len(idx),
+                oom=is_resource_exhausted(e),
+            )
         if stats is not None:
             stats.tierdown_ok0 += len(idx)
         return {}, len(idx), None

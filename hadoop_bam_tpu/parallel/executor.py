@@ -47,7 +47,12 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .. import faults
 from ..utils import nio
 from ..utils.deadline import Deadline, DeadlineExceeded
-from ..utils.tracing import METRICS
+from ..utils.tracing import (
+    METRICS,
+    RequestContext,
+    current_request,
+    request_scope,
+)
 
 
 class PartFailedError(RuntimeError):
@@ -103,6 +108,7 @@ class ElasticExecutor:
         quarantine: bool = False,
         validate_part: Optional[Callable[[str], bool]] = None,
         deadline: Optional[Deadline] = None,
+        request_ctx: Optional[RequestContext] = None,
     ) -> None:
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
@@ -121,6 +127,14 @@ class ElasticExecutor:
         # (the per-attempt watchdog never outlives the overall budget).
         # None — the batch CLI's case — is one branch per attempt.
         self.deadline = deadline
+        # Request attribution: attempts run on pool threads, where the
+        # caller's ambient RequestContext does not follow — so it is
+        # captured here (explicitly, or from the constructing thread's
+        # scope) and re-entered around every attempt.  None in batch
+        # mode: the disarmed contract holds.
+        self.request_ctx = (
+            request_ctx if request_ctx is not None else current_request()
+        )
 
     def _backoff(self, item: int, attempt: int) -> None:
         """Exponential backoff before retry ``attempt`` (≥1) of ``item``,
@@ -238,11 +252,27 @@ class ElasticExecutor:
                         self.fault_hook(i, attempt)
                     if faults.ACTIVE is not None:
                         faults.ACTIVE.exec_attempt(i, attempt, tmp)
+                    t_att = time.perf_counter()
                     self._run_attempt(work_fn, items[i], tmp)
                     os.replace(tmp, final)
+                    if self.request_ctx is not None:
+                        # One waterfall hop per written part: the serve
+                        # sort job's trace shows where its wall went,
+                        # retries included (attempt > 0 names them).
+                        self.request_ctx.annotate(
+                            "executor.part",
+                            ms=(time.perf_counter() - t_att) * 1e3,
+                            part=i, attempt=attempt,
+                        )
                     return
                 except Exception as e:  # noqa: BLE001 - retry boundary
                     errs.append(f"attempt {attempt}: {type(e).__name__}: {e}")
+                    if self.request_ctx is not None:
+                        self.request_ctx.annotate(
+                            "executor.attempt_failed",
+                            part=i, attempt=attempt,
+                            error=type(e).__name__,
+                        )
                     # Sweep the tmp file AND any side files the work_fn
                     # derived from it (e.g. tmp+'.sb' index temps).
                     base = os.path.basename(tmp)
@@ -257,8 +287,15 @@ class ElasticExecutor:
             with lock:
                 failures[i] = errs
 
+        def run_one_scoped(i: int) -> None:
+            # Pool threads re-enter the request scope explicitly: every
+            # stage event the work_fn emits (gather/deflate/write) then
+            # carries the originating request's trace id.
+            with request_scope(self.request_ctx):
+                run_one(i)
+
         with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
-            list(pool.map(run_one, range(n)))
+            list(pool.map(run_one_scoped, range(n)))
 
         METRICS.count("executor.attempts", attempts)
         METRICS.count("executor.retried", retried)

@@ -35,7 +35,28 @@ from .conf import (
     Configuration,
 )
 from .utils.hbm import LEDGER
-from .utils.tracing import METRICS, span, trace_ctx
+from .utils.tracing import METRICS, current_request, span, trace_ctx
+
+
+@contextlib.contextmanager
+def _request_hop(name: str, **extras):
+    """Annotate the enclosed phase as one hop on the ambient request
+    context (a serve sort job's waterfall shows read/sort/write
+    durations without the ring).  Batch mode — no ambient context — is
+    one ``is None`` branch, the disarmed contract."""
+    rctx = current_request()
+    if rctx is None:
+        yield
+        return
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        rctx.annotate(
+            name, ms=(_time.perf_counter() - t0) * 1e3, **extras
+        )
 from .io.bam import (
     SORT_FIELDS,
     BamInputFormat,
@@ -417,7 +438,7 @@ def sort_bam(
                 read_fields + SORT_FIELDS + DEDUP_EXTRA_FIELDS
             )
         )
-    with span("sort_bam.read"):
+    with span("sort_bam.read"), _request_hop("pipeline.read"):
         for si, b in enumerate(
             _read_splits_pipelined(
                 fmt,
@@ -598,7 +619,7 @@ def sort_bam(
         # concat failed) the release here is the real one.
         for b in batches:
             _release_split_residency(b)
-    with span("sort_bam.write_merge"), contextlib.ExitStack() as stack:
+    with span("sort_bam.write_merge"), _request_hop("pipeline.write_merge"), contextlib.ExitStack() as stack:
         if part_dir is not None:
             # Persistent part dir: the parts are crash-restart units — a
             # rerun with the same part_dir redoes only missing parts (the
@@ -1397,7 +1418,7 @@ def _sort_bam_external(
                 acc = []
                 acc_bytes = 0
 
-            with span("sort_bam.spill"):
+            with span("sort_bam.spill"), _request_hop("pipeline.spill"):
                 for b in _read_splits_pipelined(
                     fmt,
                     splits,
@@ -1585,7 +1606,7 @@ def _sort_bam_external(
                     os.path.join(td, f"part-r-{pi:05d}.splitting-bai"),
                 )
 
-        with span("sort_bam.range_merge"):
+        with span("sort_bam.range_merge"), _request_hop("pipeline.range_merge"):
             executor.run(list(range(max(1, len(ranges)))), write_one
                          if ranges else _write_empty_part)
             merge_bam_parts(

@@ -41,13 +41,22 @@ from .client import (
     ServeShedError,
 )
 from .endpoints import ServeContext, flagstat, view_blob, view_records
+from .exemplars import ExemplarStore, TailSampler
+from .flightrec import AccessLog
 from .journal import JobJournal
 from .server import BamDaemon, default_socket_path
+from .slo import SloMonitor, SloObjective, parse_objectives
 from .warmup import compile_count, ensure_compile_watcher, warm_kernels
 
 __all__ = [
+    "AccessLog",
     "AdmissionController",
     "BamDaemon",
+    "ExemplarStore",
+    "SloMonitor",
+    "SloObjective",
+    "TailSampler",
+    "parse_objectives",
     "DeadlineExceededError",
     "ERROR_CODES",
     "HbmArena",

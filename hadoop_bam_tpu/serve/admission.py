@@ -39,7 +39,7 @@ import time
 from typing import Deque, Dict, Optional
 
 from ..utils.deadline import Deadline, DeadlineExceeded
-from ..utils.tracing import METRICS, TRACER
+from ..utils.tracing import METRICS, TRACER, current_request
 
 # -- the serve protocol's typed error codes ---------------------------------
 #: Admission refused the request outright: the queue is full.  Retryable
@@ -211,6 +211,11 @@ class AdmissionController:
                     hint = self._hint_ms()
                     METRICS.count(f"{self.name}.shed", 1)
                     METRICS.count(f"{self.name}.shed.queue_full", 1)
+                    rctx = current_request()
+                    if rctx is not None:
+                        rctx.annotate(
+                            "queue.shed", reason="queue_full", op=op
+                        )
                     raise ShedError(
                         SHED, hint,
                         f"admission queue full ({self._queued} >= "
@@ -223,6 +228,11 @@ class AdmissionController:
                     hint = self._hint_ms()
                     METRICS.count(f"{self.name}.shed", 1)
                     METRICS.count(f"{self.name}.shed.slow_queue", 1)
+                    rctx = current_request()
+                    if rctx is not None:
+                        rctx.annotate(
+                            "queue.shed", reason="slow_queue", op=op
+                        )
                     raise ShedError(
                         RETRY_AFTER, hint,
                         f"queue-wait p95 {self._recent_p95_ms():.0f} ms "
@@ -245,6 +255,11 @@ class AdmissionController:
         self._recent_wait_ms.append(wait_ms)
         METRICS.count(f"{self.name}.admitted", 1)
         METRICS.observe(f"{self.name}.queue_wait.ms", wait_ms)
+        rctx = current_request()
+        if rctx is not None:
+            # The waterfall's "queue wait" hop — always on (the tracer
+            # ring may be cold; the summary path never is).
+            rctx.annotate("queue.wait", ms=wait_ms, op=op, cost=cost)
         if TRACER.armed:
             t1 = time.perf_counter()
             TRACER.emit(

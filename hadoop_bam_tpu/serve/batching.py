@@ -28,7 +28,7 @@ import numpy as np
 
 from .. import faults
 from ..utils.deadline import Deadline, DeadlineExceeded
-from ..utils.tracing import METRICS
+from ..utils.tracing import METRICS, TRACER, current_request
 
 #: Lane capacity of one lockstep codec launch (ops/pallas/inflate_lanes.py).
 MAX_LANES = 128
@@ -58,6 +58,7 @@ def default_decode_fn(conf=None) -> Callable:
 class _Pending:
     __slots__ = (
         "raw", "co", "cs", "us", "out", "offs", "err", "done", "deadline",
+        "rctx", "t_submit", "t_launch", "coalesced",
     )
 
     def __init__(self, raw, co, cs, us, deadline=None):
@@ -70,6 +71,13 @@ class _Pending:
         self.err: Optional[BaseException] = None
         self.done = threading.Event()
         self.deadline: Optional[Deadline] = deadline
+        # Request attribution: captured at submit (the worker thread has
+        # no ambient scope), so the wait/decode hops land on the right
+        # request even though the launch is shared.
+        self.rctx = current_request()
+        self.t_submit = time.perf_counter()
+        self.t_launch: Optional[float] = None
+        self.coalesced = 1
 
     @property
     def n_members(self) -> int:
@@ -145,6 +153,24 @@ class LaneBatcher:
             self._queue.append(p)
         self._wake.set()
         p.done.wait()
+        if p.rctx is not None:
+            # Two hops, split at the launch instant: "batch.wait" is
+            # time lost to the coalescing window and lane contention,
+            # "batch.decode" the shared kernel itself — the waterfall's
+            # batch-wait vs kernel attribution.  An expired-in-queue
+            # request (t_launch None) spent its whole stay waiting.
+            t_end = time.perf_counter()
+            t_launch = p.t_launch if p.t_launch is not None else t_end
+            p.rctx.annotate(
+                "batch.wait",
+                ms=(t_launch - p.t_submit) * 1e3,
+                members=p.n_members,
+                coalesced=p.coalesced,
+            )
+            if p.t_launch is not None:
+                p.rctx.annotate(
+                    "batch.decode", ms=(t_end - t_launch) * 1e3
+                )
         if p.err is not None:
             raise p.err
         return p.out, p.offs
@@ -205,6 +231,10 @@ class LaneBatcher:
                 self._launch(batch)
 
     def _launch(self, batch: List[_Pending]) -> None:
+        t0 = time.perf_counter()
+        for p in batch:
+            p.t_launch = t0
+            p.coalesced = len(batch)
         try:
             if faults.ACTIVE is not None and faults.ACTIVE.arena_oom(
                 "lane_batcher"
@@ -247,6 +277,24 @@ class LaneBatcher:
             if len(batch) > 1:
                 METRICS.count(
                     "serve.batch.coalesced_requests", len(batch)
+                )
+            if TRACER.armed:
+                # One stage event per shared launch, carrying EVERY
+                # rider's trace id: a request's causal tree includes the
+                # launch it shared even though the worker thread has no
+                # single ambient context.
+                traces = sorted(
+                    {p.rctx.trace_id for p in batch if p.rctx is not None}
+                )
+                TRACER.emit(
+                    "serve.batch.launch", "stage", t0,
+                    time.perf_counter(),
+                    {
+                        "members": len(co_l),
+                        "requests": len(batch),
+                        "traces": traces,
+                    },
+                    merge_ctx=False,
                 )
             # Scatter each request's contiguous member run back out.
             m0 = 0

@@ -26,6 +26,7 @@ import time
 from typing import Optional
 
 from ..utils.deadline import Deadline
+from ..utils.tracing import RequestContext, current_request
 from .admission import DEADLINE_EXCEEDED, JOB_LOST, RETRY_AFTER, SHED
 from .server import recv_msg, send_msg
 
@@ -123,6 +124,10 @@ class ServeClient:
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff
+        #: Trace id of the most recent request this client originated —
+        #: the handle a caller joins against the daemon's exemplar
+        #: store, access log, and ``tools/request_report.py``.
+        self.last_trace_id: Optional[str] = None
 
     def _request_once(self, obj: dict) -> dict:
         if self.socket_path is not None:
@@ -162,7 +167,22 @@ class ServeClient:
         loop itself stops — with :class:`DeadlineExceededError` — once
         the budget is spent, so a client deadline bounds the whole
         exchange, retries included.
+
+        Every request carries a ``trace`` field: the client *originates*
+        the 128-bit trace id (continuing any ambient
+        :func:`~hadoop_bam_tpu.utils.tracing.request_scope` as a child
+        span), the daemon continues it, and retries reuse it — one
+        logical request is one trace whatever the transport did.  The
+        id is kept in :attr:`last_trace_id`.
         """
+        ambient = current_request()
+        rctx = (
+            ambient.child(op=obj.get("op", ""))
+            if ambient is not None
+            else RequestContext.new(op=obj.get("op", ""))
+        )
+        obj["trace"] = rctx.to_wire()  # callers pass fresh dicts
+        self.last_trace_id = rctx.trace_id
         attempts = (self.retries + 1) if idempotent else 1
         last: Optional[Exception] = None
         for attempt in range(attempts):
@@ -323,6 +343,18 @@ class ServeClient:
 
     def stats(self) -> dict:
         return self._request({"op": "stats"}, idempotent=True)
+
+    def exemplars(self, trace_id: Optional[str] = None):
+        """The daemon's tail-latency exemplars: without ``trace_id``,
+        the compact listing (newest last); with one, the full exemplar —
+        summary + the request's trace events + the completeness verdict
+        (``incomplete: true`` when ring overflow ate part of the tree).
+        """
+        req = {"op": "exemplars"}
+        if trace_id is not None:
+            req["trace_id"] = trace_id
+            return self._request(req, idempotent=True)["exemplar"]
+        return self._request(req, idempotent=True)["exemplars"]
 
     def metrics(self) -> str:
         """The daemon's metrics in Prometheus text exposition format

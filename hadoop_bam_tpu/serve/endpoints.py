@@ -17,6 +17,7 @@ is bit-identical when no device program is viable.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -32,7 +33,7 @@ from ..spec import bam, bgzf
 from ..utils.backend import is_resource_exhausted
 from ..utils.deadline import Deadline, current_deadline
 from ..utils.intervals import MAX_END, FormatError, parse_interval
-from ..utils.tracing import METRICS, span
+from ..utils.tracing import METRICS, TRACER, current_request, span
 from .arena import HbmArena
 from .batching import LaneBatcher
 from .cache import ResourceCache
@@ -112,11 +113,17 @@ class ServeContext:
 
         def inflate(raw, co, cs, us):
             d = current_deadline()
+            rctx = current_request()
             try:
                 return b.submit(raw, co, cs, us, deadline=d)
             except Exception as e:
                 if not is_resource_exhausted(e):
                     raise
+            # Each rung of the degradation ladder is a named hop: the
+            # waterfall of an OOM-afflicted request shows evict → retry
+            # → tier-down instead of an unexplained slow "decode".
+            if rctx is not None:
+                rctx.annotate("oom.evict")
             arena.evict_lru()
             try:
                 return b.submit(raw, co, cs, us, deadline=d)
@@ -124,15 +131,28 @@ class ServeContext:
                 if not is_resource_exhausted(e):
                     raise
             METRICS.count("serve.oom.tierdowns", 1)
+            if rctx is not None:
+                rctx.annotate("oom.tierdown", tier="host")
+            if TRACER.armed:
+                TRACER.instant(
+                    "serve.oom.tierdown", "tier", {"tier": "host"}
+                )
             from .. import native
 
-            return native.inflate_blocks(
+            t_host = time.perf_counter()
+            out = native.inflate_blocks(
                 raw if isinstance(raw, np.ndarray)
                 else np.frombuffer(raw, dtype=np.uint8),
                 np.asarray(co, dtype=np.int64),
                 np.asarray(cs, dtype=np.int32),
                 np.asarray(us, dtype=np.int32),
             )
+            if rctx is not None:
+                rctx.annotate(
+                    "oom.host_decode",
+                    ms=(time.perf_counter() - t_host) * 1e3,
+                )
+            return out
 
         return inflate
 
@@ -205,6 +225,8 @@ def view_records(
     nobody will read.
     """
     iv = parse_interval(region)
+    rctx = current_request()
+    t_idx = time.perf_counter()
     hdr, _ = ctx.cache.header(path)
     try:
         rid = hdr.ref_index(iv.contig)
@@ -216,18 +238,27 @@ def view_records(
     end0 = min(iv.end, MAX_END)
     bai = ctx.cache.bai(path)
     chunks = bai.query(rid, beg0, end0)
+    if rctx is not None:
+        # Header + .bai resolution: ~0 on a cache hit, the dominant
+        # cold-request hop on a miss — attributed so a cold p99 never
+        # reads as an unexplained gap.
+        rctx.annotate(
+            "view.index", ms=(time.perf_counter() - t_idx) * 1e3
+        )
     ident = ctx.cache.identity(path)
     picks: List[Tuple[object, np.ndarray]] = []
     from ..io.bam import BamInputFormat
     from ..io.splits import FileVirtualSplit
 
     fmt = BamInputFormat(ctx.conf)
+    t_overlap = 0.0
     for c in chunks:
         if deadline is not None:
             deadline.check("endpoint")
         key = ("view", ident, c.beg, c.end)
         batch = ctx.arena.get(key)
         if batch is None:
+            t_read = time.perf_counter()
             with span("serve.view.read"):
                 batch = fmt.read_split(
                     FileVirtualSplit(path, c.beg, c.end),
@@ -236,9 +267,28 @@ def view_records(
                     inflate_fn=ctx._inflate_fn(),
                 )
             ctx.arena.hold(key, batch)
+            if rctx is not None:
+                # An arena miss is a real hop (read + inflate + parse);
+                # a hit costs nothing and leaves no hop — warm requests'
+                # waterfalls stay as short as their latency.
+                rctx.annotate(
+                    "window.read",
+                    ms=(time.perf_counter() - t_read) * 1e3,
+                )
+        t_ov = time.perf_counter()
         rows = _overlap_rows(batch, rid, beg0, end0)
+        t_overlap += time.perf_counter() - t_ov
         if len(rows):
             picks.append((batch, rows))
+    if rctx is not None and chunks:
+        # The kernel hop: the overlap cut (device kernel or its NumPy
+        # fallback), accumulated across chunk windows into one hop —
+        # separately attributed so "slow because kernel" and "slow
+        # because read" never blur, one annotation per request so the
+        # always-on path stays O(1) in window count.
+        rctx.annotate(
+            "view.overlap", ms=t_overlap * 1e3, windows=len(chunks)
+        )
     return hdr, picks
 
 
@@ -258,6 +308,7 @@ def view_blob(
     t0 = _time.perf_counter()
     with span("serve.view"):
         hdr, picks = view_records(ctx, path, region, deadline=deadline)
+        t_enc = _time.perf_counter()
         payloads = [
             gather_record_array(batch, rows) for batch, rows in picks
         ]
@@ -277,6 +328,14 @@ def view_blob(
             + body
             + bgzf.TERMINATOR
         )
+        rctx = current_request()
+        if rctx is not None:
+            # The reply-assembly hop (record gather + BGZF deflate).
+            rctx.annotate(
+                "view.encode",
+                ms=(_time.perf_counter() - t_enc) * 1e3,
+                records=n_records,
+            )
     METRICS.count("serve.view.requests", 1)
     METRICS.count("serve.view.records", n_records)
     # Endpoint-level latency histogram: the daemon times whole requests
@@ -313,12 +372,14 @@ def flagstat(
 
         fmt = BamInputFormat(ctx.conf)
         counts = {k: 0 for k in FLAGSTAT_KEYS}
+        rctx = current_request()
         for s in fmt.get_splits([path]):
             if deadline is not None:
                 deadline.check("endpoint")
             key = ("flagstat", ident, s.vstart, s.vend)
             batch = ctx.arena.get(key)
             if batch is None:
+                t_read = time.perf_counter()
                 batch = fmt.read_split(
                     s,
                     with_keys=False,
@@ -326,6 +387,11 @@ def flagstat(
                     inflate_fn=ctx._inflate_fn(),
                 )
                 ctx.arena.hold(key, batch)
+                if rctx is not None:
+                    rctx.annotate(
+                        "window.read",
+                        ms=(time.perf_counter() - t_read) * 1e3,
+                    )
             flag = np.asarray(batch.soa["flag"], dtype=np.int64)
             mapped = (flag & bam.FLAG_UNMAPPED) == 0
             paired = (flag & bam.FLAG_PAIRED) != 0

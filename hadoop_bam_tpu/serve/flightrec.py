@@ -34,6 +34,7 @@ from ..utils.tracing import METRICS
 
 DEFAULT_CADENCE_MS = 500
 DEFAULT_RING_BYTES = 1 << 20
+DEFAULT_ACCESS_LOG_BYTES = 4 << 20
 
 #: Counter prefixes worth replaying after a crash: the degradation story.
 SNAPSHOT_COUNTER_PREFIXES = (
@@ -44,6 +45,8 @@ SNAPSHOT_COUNTER_PREFIXES = (
     "serve.journal.",
     "serve.jobs_",
     "serve.request_errors",
+    "serve.slo.",
+    "serve.trace.",
     "hbm.leaked",
     "hbm.double_copy",
 )
@@ -67,6 +70,54 @@ def segment_paths(base: str) -> Tuple[str, str]:
     return base + ".0", base + ".1"
 
 
+class JsonlRing:
+    """The two-segment JSONL ring writer, factored out so the flight
+    recorder and the per-request access log share one rotation scheme:
+    append to the active segment (flushed per line), and when it crosses
+    half the byte budget, truncate the other segment and switch —
+    bounded disk, at least half the budget of survivable history.
+
+    Not itself thread-safe: callers serialize appends (both owners
+    already hold their own locks)."""
+
+    def __init__(
+        self, base: str, max_bytes: int, rotate_metric: str
+    ) -> None:
+        self.base = base
+        self.max_bytes = max(8 << 10, int(max_bytes))
+        self._rotate_metric = rotate_metric
+        self._f = None
+        self._active = 0
+
+    def prepare(self, active: int = 0) -> None:
+        d = os.path.dirname(os.path.abspath(self.base))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._active = active
+
+    def append(self, rec: dict) -> None:
+        """One record as a flushed JSONL line (a SIGKILL after return
+        loses at most a torn tail on a *later* line)."""
+        if self._f is None:
+            self._f = open(segment_paths(self.base)[self._active], "ab")
+        self._f.write(json.dumps(rec, sort_keys=True).encode() + b"\n")
+        self._f.flush()
+        if self._f.tell() > self.max_bytes // 2:
+            self._f.close()
+            self._active ^= 1
+            # Truncate the segment we are rotating onto: the ring
+            # reclaims the oldest half.
+            self._f = open(segment_paths(self.base)[self._active], "wb")
+            METRICS.count(self._rotate_metric, 1)
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
 class FlightRecorder:
     """Bounded JSONL ring writer with a periodic snapshot thread."""
 
@@ -79,13 +130,13 @@ class FlightRecorder:
     ) -> None:
         self.base = base_path
         self.cadence = max(0.02, float(cadence_s))
-        self.max_bytes = max(8 << 10, int(max_bytes))
+        self._ring = JsonlRing(
+            base_path, max_bytes, "serve.flightrec.rotations"
+        )
         self._source = source or default_source
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._f = None
-        self._active = 0
         self._seq = 0
         self._finalized = False
 
@@ -110,31 +161,13 @@ class FlightRecorder:
             except OSError:
                 continue
         self._seq = best_seq + 1
-        self._active = best_idx
-
-    def _ensure_open(self):
-        if self._f is None:
-            path = segment_paths(self.base)[self._active]
-            self._f = open(path, "ab")
-        return self._f
-
-    def _rotate_if_needed(self) -> None:
-        if self._f is not None and self._f.tell() > self.max_bytes // 2:
-            self._f.close()
-            self._active ^= 1
-            # Truncate the segment we are rotating onto: the ring
-            # reclaims the oldest half.
-            self._f = open(segment_paths(self.base)[self._active], "wb")
-            METRICS.count("serve.flightrec.rotations", 1)
+        self._ring.prepare(active=best_idx)
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
         if self._thread is not None:
             return
-        d = os.path.dirname(os.path.abspath(self.base))
-        if d:
-            os.makedirs(d, exist_ok=True)
         self._scan_existing()
         self.snapshot()  # an immediate baseline record
         self._thread = threading.Thread(
@@ -167,10 +200,7 @@ class FlightRecorder:
                 return rec
             rec["seq"] = self._seq
             self._seq += 1
-            f = self._ensure_open()
-            f.write(json.dumps(rec, sort_keys=True).encode() + b"\n")
-            f.flush()
-            self._rotate_if_needed()
+            self._ring.append(rec)
             if final:
                 self._finalized = True
         METRICS.count("serve.flightrec.snapshots", 1)
@@ -190,20 +220,49 @@ class FlightRecorder:
             except Exception:  # noqa: BLE001
                 METRICS.count("serve.flightrec.errors", 1)
         with self._lock:
-            if self._f is not None:
-                try:
-                    self._f.close()
-                finally:
-                    self._f = None
+            self._ring.close()
 
 
-def load_ring(base: str) -> Tuple[List[dict], int]:
-    """Read a ring back: ``(snapshots ordered by seq, torn_line_count)``.
-    Accepts the base path or either segment path; tolerant of torn final
-    lines (the kill -9 case) and missing segments."""
+class AccessLog:
+    """One structured JSONL line per completed request (trace id, op,
+    outcome, duration, queue/batch waits, tier decisions, shed/OOM
+    flags), rotated with the same two-segment scheme as the flight
+    recorder, so the per-request history is bounded on disk and joins
+    with the exemplar store on ``trace_id``."""
+
+    def __init__(
+        self, base_path: str, max_bytes: int = DEFAULT_ACCESS_LOG_BYTES
+    ) -> None:
+        self.base = base_path
+        self._ring = JsonlRing(
+            base_path, max_bytes, "serve.accesslog.rotations"
+        )
+        self._lock = threading.Lock()
+        self._ring.prepare()
+
+    def log(self, record: dict) -> None:
+        try:
+            with self._lock:
+                self._ring.append(record)
+            METRICS.count("serve.accesslog.lines", 1)
+        except OSError:
+            # Logging must never fail a request; the error is counted.
+            METRICS.count("serve.accesslog.errors", 1)
+
+    def close(self) -> None:
+        with self._lock:
+            self._ring.close()
+
+
+def load_jsonl_segments(base: str) -> Tuple[List[dict], int]:
+    """Read both segments of a two-segment ring back, in file order:
+    ``(records, torn_line_count)``.  Accepts the base path or either
+    segment path; tolerant of torn final lines and missing segments.
+    Ordering across segments is the caller's (flight-recorder rings
+    sort by ``seq``; access logs by ``t_wall``)."""
     if base.endswith((".0", ".1")) and not os.path.exists(base + ".0"):
         base = base[:-2]
-    snaps: Dict[int, dict] = {}
+    recs: List[dict] = []
     torn = 0
     for p in segment_paths(base):
         try:
@@ -212,10 +271,30 @@ def load_ring(base: str) -> Tuple[List[dict], int]:
                     if not line.strip():
                         continue
                     try:
-                        rec = json.loads(line)
-                        snaps[int(rec["seq"])] = rec
-                    except (ValueError, TypeError, KeyError):
+                        recs.append(json.loads(line))
+                    except (ValueError, TypeError):
                         torn += 1
         except OSError:
             continue
+    return recs, torn
+
+
+def load_access_log(base: str) -> Tuple[List[dict], int]:
+    """An access log's records ordered by wall time, plus torn count."""
+    recs, torn = load_jsonl_segments(base)
+    recs.sort(key=lambda r: r.get("t_wall", 0.0))
+    return recs, torn
+
+
+def load_ring(base: str) -> Tuple[List[dict], int]:
+    """Read a ring back: ``(snapshots ordered by seq, torn_line_count)``.
+    Accepts the base path or either segment path; tolerant of torn final
+    lines (the kill -9 case) and missing segments."""
+    recs, torn = load_jsonl_segments(base)
+    snaps: Dict[int, dict] = {}
+    for rec in recs:
+        try:
+            snaps[int(rec["seq"])] = rec
+        except (KeyError, ValueError, TypeError):
+            torn += 1
     return [snaps[k] for k in sorted(snaps)], torn

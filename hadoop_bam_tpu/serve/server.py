@@ -58,7 +58,12 @@ from typing import Dict, List, Optional, Tuple
 from .. import faults
 from ..conf import (
     Configuration,
+    SERVE_ACCESS_LOG,
+    SERVE_ACCESS_LOG_BYTES,
     SERVE_ADMISSION_TOKENS,
+    SERVE_EXEMPLAR_DIR,
+    SERVE_EXEMPLAR_THRESHOLD_MS,
+    SERVE_EXEMPLARS_MAX,
     SERVE_FLIGHTREC,
     SERVE_FLIGHTREC_BYTES,
     SERVE_FLIGHTREC_CADENCE_MS,
@@ -67,19 +72,29 @@ from ..conf import (
     SERVE_MAX_QUEUE,
     SERVE_MAX_QUEUE_MS,
     SERVE_PORT,
+    SERVE_REQUEST_TRACING,
+    SERVE_SLO,
+    SERVE_SLO_WINDOWS,
     SERVE_SOCKET,
     SERVE_WARMUP,
+    TRACE_EVENTS,
 )
 from ..utils.deadline import Deadline, DeadlineExceeded, deadline_scope
 from ..utils.tracing import (
+    DEFAULT_TRACE_EVENTS,
     METRICS,
+    TRACER,
+    RequestContext,
     delta,
     prometheus_text,
+    request_scope,
     snapshot,
     transfers_report,
 )
+from . import exemplars as exemplars_mod
 from . import flightrec as flightrec_mod
 from . import journal as journal_mod
+from . import slo as slo_mod
 from .admission import (
     DEADLINE_EXCEEDED,
     DEFAULT_MAX_QUEUE,
@@ -94,6 +109,22 @@ from .endpoints import ServeContext, flagstat, view_blob
 _LEN = struct.Struct(">I")
 MAX_MESSAGE = 1 << 30
 DEFAULT_MAX_INFLIGHT = 2
+
+#: Every op the dispatcher understands.  New dispatch arms must land
+#: here — the request-tracing lint (tests/test_request_tracing.py)
+#: cross-checks this tuple against the ``if op == "…"`` literals in
+#: ``_dispatch``, so an op cannot be added without being registered
+#: (and thereby running under the dispatch RequestContext).
+KNOWN_OPS = (
+    "ping", "view", "flagstat", "sort", "job", "stats", "metrics",
+    "exemplars", "shutdown",
+)
+
+#: Data-plane ops whose completions feed the tail sampler and the access
+#: log.  Control-plane ops (ping/stats/…) run under a RequestContext too
+#: but record no summaries — a stats scrape per second must not flood
+#: the per-request artifacts.
+TRACED_OPS = ("view", "flagstat", "sort")
 
 
 def default_socket_path() -> str:
@@ -217,6 +248,48 @@ class BamDaemon:
             if self.flightrec_path
             else None
         )
+        # Request-scoped tracing plane (PR 12): every request runs under
+        # a RequestContext (client-originated trace id, or minted at
+        # dispatch); the tail sampler copies breaching requests' full
+        # event sets out of the tracer ring into the bounded exemplar
+        # store; the SLO monitor judges the op histograms against the
+        # declared objectives; the access log writes one line per
+        # completed data-plane request.
+        self.request_tracing = self.conf.get_boolean(
+            SERVE_REQUEST_TRACING, True
+        )
+        self._owns_tracer = False
+        self.exemplars = exemplars_mod.ExemplarStore(
+            max_exemplars=self.conf.get_int(
+                SERVE_EXEMPLARS_MAX, exemplars_mod.DEFAULT_MAX_EXEMPLARS
+            ),
+            spill_dir=self.conf.get(SERVE_EXEMPLAR_DIR),
+        )
+        self.sampler = exemplars_mod.TailSampler(
+            self.exemplars,
+            threshold_ms=float(
+                self.conf.get_int(
+                    SERVE_EXEMPLAR_THRESHOLD_MS,
+                    int(exemplars_mod.DEFAULT_THRESHOLD_MS),
+                )
+            ),
+            # Sort jobs are minutes-long by design: only their failures
+            # are exemplar-worthy, never their (expected) duration.
+            per_op_threshold_ms={"sort.job": 0.0},
+        )
+        self.slo = slo_mod.SloMonitor.from_conf(self.conf)
+        access_log_path = self.conf.get(SERVE_ACCESS_LOG)
+        self._access_log = (
+            flightrec_mod.AccessLog(
+                access_log_path,
+                max_bytes=self.conf.get_int(
+                    SERVE_ACCESS_LOG_BYTES,
+                    flightrec_mod.DEFAULT_ACCESS_LOG_BYTES,
+                ),
+            )
+            if access_log_path
+            else None
+        )
         self._drain_requested = threading.Event()
         self._started_snapshot = snapshot()
 
@@ -234,6 +307,17 @@ class BamDaemon:
         answerable from the first accepted connection."""
         if self._listener is not None:
             return
+        if self.request_tracing and not TRACER.armed:
+            # The tracing plane needs the ring live so exemplars have
+            # events to copy out; the daemon owns (and disarms on
+            # shutdown) what it armed — a CLI --trace in the same
+            # process keeps its own ring.
+            TRACER.start(
+                capacity=self.conf.get_int(
+                    TRACE_EVENTS, DEFAULT_TRACE_EVENTS
+                )
+            )
+            self._owns_tracer = True
         if self._journal is not None:
             self._recover_journal()
         if self.warmup and self.warmup_report is None:
@@ -382,6 +466,11 @@ class BamDaemon:
             self._flightrec.stop(final=True)
         if self._journal is not None:
             self._journal.close()
+        if self._access_log is not None:
+            self._access_log.close()
+        if self._owns_tracer:
+            TRACER.stop()
+            self._owns_tracer = False
         self.ctx.close()
 
     # -- request handling ---------------------------------------------------
@@ -395,57 +484,125 @@ class BamDaemon:
                 req = recv_msg(conn)
                 if req is None:
                     return
+                op = req.get("op")
+                # The request's identity: continue the client's trace
+                # (Dapper propagation — the wire carries trace_id/
+                # span_id/baggage) or originate one at dispatch.  None
+                # when the plane is off: every seam below is then one
+                # is-None branch, the fault-seam disarmed contract.
+                rctx = None
+                if self.request_tracing:
+                    rctx = RequestContext.from_wire(
+                        req.get("trace"), op=op
+                    ) or RequestContext.new(op=op)
                 t0 = _time.perf_counter()
-                try:
-                    reply, stop_after = self._dispatch(req)
-                except ShedError as e:
-                    # Typed load shedding: the client gets the code AND
-                    # the server-computed backoff hint — overload is an
-                    # answer, not a timeout.
-                    reply = {
-                        "ok": False,
-                        "code": e.code,
-                        "error": str(e),
-                        "retry_after_ms": e.retry_after_ms,
-                    }
-                except DeadlineExceeded as e:
-                    reply = {
-                        "ok": False,
-                        "code": DEADLINE_EXCEEDED,
-                        "error": str(e),
-                        "seam": e.seam,
-                    }
-                except Exception as e:  # noqa: BLE001 - reply, don't die
-                    METRICS.count("serve.request_errors", 1)
-                    reply = {
-                        "ok": False,
-                        "error": f"{type(e).__name__}: {e}",
-                    }
-                # Per-op latency histogram (log2 ms buckets → p50/p95/p99
-                # in the stats/metrics ops without unbounded memory).
-                METRICS.observe(
-                    f"serve.op.{req.get('op')}.ms",
-                    (_time.perf_counter() - t0) * 1e3,
-                )
-                if faults.ACTIVE is not None:
-                    # The serve-socket fault seam: dropped connections and
-                    # stalled replies, injected between dispatch and send
-                    # so the client's retry/backoff path is what's proven
-                    # (the request itself already executed — exactly the
-                    # ambiguity a real connection drop leaves behind).
-                    act = faults.ACTIVE.serve_action(req.get("op"))
-                    if act is not None and act["action"] == "drop":
+                with request_scope(rctx):
+                    try:
+                        reply, stop_after = self._dispatch(req)
+                    except ShedError as e:
+                        # Typed load shedding: the client gets the code
+                        # AND the server-computed backoff hint —
+                        # overload is an answer, not a timeout.
+                        reply = {
+                            "ok": False,
+                            "code": e.code,
+                            "error": str(e),
+                            "retry_after_ms": e.retry_after_ms,
+                        }
+                    except DeadlineExceeded as e:
+                        reply = {
+                            "ok": False,
+                            "code": DEADLINE_EXCEEDED,
+                            "error": str(e),
+                            "seam": e.seam,
+                        }
+                    except Exception as e:  # noqa: BLE001 - reply, don't die
+                        METRICS.count("serve.request_errors", 1)
+                        reply = {
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                    # Per-op latency histogram (log2 ms buckets →
+                    # p50/p95/p99 in the stats/metrics ops without
+                    # unbounded memory) + per-op error counter (the SLO
+                    # monitor's availability numerator rides on these).
+                    METRICS.observe(
+                        f"serve.op.{op}.ms",
+                        (_time.perf_counter() - t0) * 1e3,
+                    )
+                    if not reply.get("ok"):
+                        METRICS.count(f"serve.op.{op}.errors", 1)
+                    dropped_reply = False
+                    if faults.ACTIVE is not None:
+                        # The serve-socket fault seam: dropped
+                        # connections and stalled replies, injected
+                        # between dispatch and send so the client's
+                        # retry/backoff path is what's proven (the
+                        # request itself already executed — exactly the
+                        # ambiguity a real connection drop leaves
+                        # behind).
+                        act = faults.ACTIVE.serve_action(op)
+                        if act is not None and act["action"] == "drop":
+                            dropped_reply = True
+                        elif act is not None and act["action"] == "stall":
+                            ts = _time.perf_counter()
+                            _time.sleep(act["ms"] / 1e3)
+                            if rctx is not None:
+                                # The injected stall is a hop like any
+                                # other: the waterfall must name it as
+                                # the blocking reason, not leave a gap.
+                                rctx.annotate(
+                                    "reply.stall",
+                                    ms=(
+                                        _time.perf_counter() - ts
+                                    ) * 1e3,
+                                    injected=True,
+                                )
+                    if rctx is not None:
+                        reply.setdefault("trace_id", rctx.trace_id)
+                        self._finish_request(
+                            rctx, op, reply, dropped_reply
+                        )
+                    if dropped_reply:
                         return  # close without replying
-                    if act is not None and act["action"] == "stall":
-                        import time as _time
-
-                        _time.sleep(act["ms"] / 1e3)
                 send_msg(conn, reply)
         except Exception:
             METRICS.count("serve.connection_errors", 1)
         finally:
             if stop_after:
                 self._stop.set()
+
+    def _finish_request(
+        self,
+        rctx: RequestContext,
+        op: Optional[str],
+        reply: dict,
+        dropped_reply: bool = False,
+    ) -> None:
+        """The always-on completion path for data-plane requests: fold
+        the hop annotations into a compact summary, feed the tail
+        sampler (exemplar copy-out happens here, before the ring can
+        evict the events), and write the access-log line."""
+        if op not in TRACED_OPS:
+            return
+        outcome = (
+            "OK" if reply.get("ok") else (reply.get("code") or "ERROR")
+        )
+        duration_ms = rctx.elapsed_ms()
+        if self._access_log is None and not self.sampler.would_sample(
+            op, outcome, duration_ms, rctx.hops
+        ):
+            # Fast path for the healthy majority: count the request,
+            # build nothing (no access log to feed, nothing to sample).
+            METRICS.count("serve.trace.requests", 1)
+            return
+        extra = {"dropped_reply": True} if dropped_reply else None
+        summary = exemplars_mod.request_summary(
+            rctx, outcome, duration_ms, op=op, extra=extra
+        )
+        self.sampler.observe(summary)
+        if self._access_log is not None:
+            self._access_log.log(exemplars_mod.access_record(summary))
 
     def _dispatch(self, req: dict) -> Tuple[dict, bool]:
         op = req.get("op")
@@ -519,6 +676,29 @@ class BamDaemon:
             return ({"ok": True, **job}, False)
         if op == "stats":
             return ({"ok": True, **self._stats()}, False)
+        if op == "exemplars":
+            # The tail sampler's export surface: the listing (compact
+            # summaries, newest last), or one full exemplar — summary +
+            # the request's ring events + the completeness verdict — by
+            # trace id.  Control plane: never gated, so post-mortems
+            # work under overload.
+            tid = req.get("trace_id")
+            if tid:
+                ex = self.exemplars.get(tid)
+                if ex is None:
+                    return (
+                        {
+                            "ok": False,
+                            "error": f"no exemplar for trace {tid!r} "
+                            "(not sampled, or evicted from the store)",
+                        },
+                        False,
+                    )
+                return ({"ok": True, "exemplar": ex}, False)
+            return (
+                {"ok": True, "exemplars": self.exemplars.summaries()},
+                False,
+            )
         if op == "metrics":
             # Prometheus text exposition: cumulative process counters +
             # full histogram buckets (Prometheus counters are cumulative
@@ -542,6 +722,14 @@ class BamDaemon:
     def _submit_sort(
         self, req: dict, ticket=None, deadline: Optional[Deadline] = None
     ) -> str:
+        # The job continues the submission's trace on the pool thread as
+        # a child span (thread-locals do not follow a submit): every
+        # pipeline/executor event the job emits carries the same trace
+        # id the client originated.
+        from ..utils.tracing import current_request
+
+        rctx = current_request()
+        job_ctx = rctx.child(op="sort.job") if rctx is not None else None
         with self._jobs_lock:
             self._job_seq += 1
             jid = f"job-{self._job_seq:04d}"
@@ -549,6 +737,8 @@ class BamDaemon:
                 "status": "queued",
                 "output": req.get("output"),
             }
+            if job_ctx is not None:
+                self._jobs[jid]["trace_id"] = job_ctx.trace_id
         if self._journal is not None:
             # Durable before the pool sees it: a crash between this
             # append and the submit leaves a journaled job the restart
@@ -561,7 +751,9 @@ class BamDaemon:
                 {k: v for k, v in req.items() if k != "op"},
                 journal_mod.input_identity(list(paths or [])),
             )
-        self._job_pool.submit(self._run_sort, jid, dict(req), ticket, deadline)
+        self._job_pool.submit(
+            self._run_sort, jid, dict(req), ticket, deadline, job_ctx
+        )
         METRICS.count("serve.jobs_submitted", 1)
         return jid
 
@@ -571,6 +763,13 @@ class BamDaemon:
                 self._journal.state(jid, status, **extra)
             except OSError:
                 METRICS.count("serve.journal.append_errors", 1)
+        from ..utils.tracing import current_request
+
+        rctx = current_request()
+        if rctx is not None:
+            # Journal transitions are request hops: the waterfall of a
+            # crashed-then-resumed job shows its state machine inline.
+            rctx.annotate("journal.state", job=jid, status=status)
 
     def _run_sort(
         self,
@@ -578,54 +777,74 @@ class BamDaemon:
         req: dict,
         ticket=None,
         deadline: Optional[Deadline] = None,
+        rctx: Optional[RequestContext] = None,
     ) -> None:
         with self._jobs_lock:
             self._jobs[jid]["status"] = "running"
-        self._journal_state(jid, "running")
-        try:
-            from ..pipeline import sort_bam
+        outcome = "OK"
+        with request_scope(rctx):
+            self._journal_state(jid, "running")
+            try:
+                from ..pipeline import sort_bam
 
-            paths = req["bam"]
-            if isinstance(paths, str):
-                paths = [paths]
-            stats = sort_bam(
-                paths,
-                req["output"],
-                conf=self.conf,
-                level=int(req.get("level", 6)),
-                memory_budget=req.get("memory_budget"),
-                part_dir=req.get("part_dir"),
-                write_splitting_bai=bool(req.get("write_splitting_bai")),
-                mark_duplicates=bool(req.get("mark_duplicates")),
-                sort_order=req.get("sort_order"),
-                resource_cache=self.ctx.cache,
-                deadline=deadline,
-            )
-            stats_d = {
-                "n_records": stats.n_records,
-                "n_splits": stats.n_splits,
-                "backend": stats.backend,
-                "n_duplicates": stats.n_duplicates,
-            }
-            with self._jobs_lock:
-                self._jobs[jid].update(status="done", stats=stats_d)
-            self._journal_state(jid, "done", stats=stats_d)
-        except DeadlineExceeded as e:
-            METRICS.count("serve.jobs_failed", 1)
-            with self._jobs_lock:
-                self._jobs[jid].update(
-                    status="failed", code=DEADLINE_EXCEEDED, error=str(e)
+                paths = req["bam"]
+                if isinstance(paths, str):
+                    paths = [paths]
+                stats = sort_bam(
+                    paths,
+                    req["output"],
+                    conf=self.conf,
+                    level=int(req.get("level", 6)),
+                    memory_budget=req.get("memory_budget"),
+                    part_dir=req.get("part_dir"),
+                    write_splitting_bai=bool(req.get("write_splitting_bai")),
+                    mark_duplicates=bool(req.get("mark_duplicates")),
+                    sort_order=req.get("sort_order"),
+                    resource_cache=self.ctx.cache,
+                    deadline=deadline,
                 )
-            self._journal_state(jid, "failed", error=str(e))
-        except Exception as e:  # noqa: BLE001 - job status carries it
-            METRICS.count("serve.jobs_failed", 1)
-            err = f"{type(e).__name__}: {e}"
-            with self._jobs_lock:
-                self._jobs[jid].update(status="failed", error=err)
-            self._journal_state(jid, "failed", error=err)
-        finally:
-            if ticket is not None:
-                ticket.release()
+                stats_d = {
+                    "n_records": stats.n_records,
+                    "n_splits": stats.n_splits,
+                    "backend": stats.backend,
+                    "n_duplicates": stats.n_duplicates,
+                }
+                with self._jobs_lock:
+                    self._jobs[jid].update(status="done", stats=stats_d)
+                self._journal_state(jid, "done", stats=stats_d)
+            except DeadlineExceeded as e:
+                outcome = DEADLINE_EXCEEDED
+                METRICS.count("serve.jobs_failed", 1)
+                with self._jobs_lock:
+                    self._jobs[jid].update(
+                        status="failed", code=DEADLINE_EXCEEDED,
+                        error=str(e),
+                    )
+                self._journal_state(jid, "failed", error=str(e))
+            except Exception as e:  # noqa: BLE001 - job status carries it
+                outcome = "ERROR"
+                METRICS.count("serve.jobs_failed", 1)
+                err = f"{type(e).__name__}: {e}"
+                with self._jobs_lock:
+                    self._jobs[jid].update(status="failed", error=err)
+                self._journal_state(jid, "failed", error=err)
+            finally:
+                if ticket is not None:
+                    ticket.release()
+                if rctx is not None:
+                    # The job's own completion record: same trace id as
+                    # the submission, op "sort.job", so a failed or slow
+                    # job earns its exemplar even though the submission
+                    # request replied fast.
+                    summary = exemplars_mod.request_summary(
+                        rctx, outcome, rctx.elapsed_ms(),
+                        op="sort.job", extra={"job": jid},
+                    )
+                    self.sampler.observe(summary)
+                    if self._access_log is not None:
+                        self._access_log.log(
+                            exemplars_mod.access_record(summary)
+                        )
 
     # -- stats / drain ------------------------------------------------------
 
@@ -661,13 +880,14 @@ class BamDaemon:
         g.update(self.admission.gauges())
         if self.ctx.batcher is not None:
             g["serve.batch.queue_depth"] = self.ctx.batcher.queue_depth()
+        g["serve.trace.exemplar_count"] = len(self.exemplars)
         return g
 
     def _flight_snapshot(self) -> dict:
         """The flight recorder's per-tick source: live gauges + the
         degradation-class counters (sheds, OOM, journal, HBM leaks)."""
         counters = METRICS.report()["counters"]
-        return {
+        rec = {
             "gauges": self._gauges(),
             "counters": {
                 k: v
@@ -675,6 +895,13 @@ class BamDaemon:
                 if k.startswith(flightrec_mod.SNAPSHOT_COUNTER_PREFIXES)
             },
         }
+        try:
+            # SLO state rides every snapshot: a post-mortem replay shows
+            # which objectives were burning in the final seconds.
+            rec["slo"] = self.slo.brief()
+        except Exception:  # noqa: BLE001 - the recorder never kills
+            METRICS.count("serve.flightrec.source_errors", 1)
+        return rec
 
     def _stats(self) -> dict:
         # Snapshot/delta exclusively — never reset(): the daemon-lifetime
@@ -697,6 +924,11 @@ class BamDaemon:
             "jobs": jobs,
             "warmup": self.warmup_report,
             "draining": self._draining.is_set(),
+            # The SLO judgment: current burn rates per objective over
+            # the fast/slow windows, window compliance, the worst op,
+            # and the alert set — evaluated here, so every stats scrape
+            # is also an SLO sample point.
+            "slo": self.slo.evaluate(),
         }
 
     def _drain(self) -> dict:

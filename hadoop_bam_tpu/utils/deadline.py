@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
-from .tracing import METRICS
+from .tracing import METRICS, current_request
 
 
 class DeadlineExceeded(RuntimeError):
@@ -85,11 +85,16 @@ class Deadline:
         return time.monotonic() >= self.expires_at
 
     def check(self, seam: str) -> None:
-        """Raise (and count) if expired; free otherwise."""
+        """Raise (and count) if expired; free otherwise.  With a request
+        context ambient, the expiry is also annotated as a hop so the
+        waterfall names the seam where the budget died."""
         rem = self.remaining_ms()
         if rem <= 0.0:
             METRICS.count("serve.deadline.exceeded", 1)
             METRICS.count(f"serve.deadline.exceeded.{seam}", 1)
+            rctx = current_request()
+            if rctx is not None:
+                rctx.annotate(f"deadline.{seam}", over_ms=abs(rem))
             raise DeadlineExceeded(seam, rem)
 
 

@@ -37,7 +37,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 #: Every span/counter/histogram/gauge name must match: dotted lowercase,
 #: at least two components (``subsystem.metric``), so the metrics
@@ -343,6 +343,11 @@ class Tracer:
         self._epoch = 0.0
         self.armed = False
         self.dropped_events = 0
+        # Per-category drop ledger: a request tree reassembled from the
+        # ring can only be trusted complete when none of its categories
+        # lost events to overflow — request_report stamps trees
+        # ``incomplete`` from exactly this dict.
+        self.dropped_by_category: Dict[str, int] = {}
 
     def start(self, capacity: int = DEFAULT_TRACE_EVENTS) -> None:
         """Arm the tracer with a fresh ring of ``capacity`` event slots."""
@@ -352,6 +357,7 @@ class Tracer:
             self._head = 0
             self._count = 0
             self.dropped_events = 0
+            self.dropped_by_category = {}
             self._epoch = time.perf_counter()
             self.armed = True
 
@@ -376,10 +382,21 @@ class Tracer:
         """Append one complete event (perf_counter endpoints).  Ambient
         :func:`trace_ctx` key/values merge under explicit ``args``
         (``merge_ctx=False`` keeps ``args`` pure — counter events, whose
-        args are the series values)."""
+        args are the series values).  With an ambient
+        :class:`RequestContext` in scope, the request's trace id rides
+        along as ``args["trace"]`` — the key the per-request causal tree
+        is reassembled on."""
         ctx = getattr(_TLS, "ctx", None) if merge_ctx else None
         if ctx:
             args = {**ctx, **args} if args else dict(ctx)
+        if merge_ctx:
+            rctx = getattr(_TLS, "request", None)
+            if rctx is not None:
+                args = (
+                    {**args, "trace": rctx.trace_id}
+                    if args
+                    else {"trace": rctx.trace_id}
+                )
         ev = (
             name,
             category,
@@ -391,12 +408,20 @@ class Tracer:
         with self._lock:
             if self._ring is None:
                 return  # disarmed between the caller's check and now
+            old = self._ring[self._head]
             self._ring[self._head] = ev
             self._head = (self._head + 1) % self._cap
             if self._count < self._cap:
                 self._count += 1
             else:
                 self.dropped_events += 1
+                # The evicted slot's category: drops are accounted per
+                # category so a reassembled request tree knows whether
+                # *its* event classes are still all present.
+                cat = old[1] if old else ""
+                self.dropped_by_category[cat] = (
+                    self.dropped_by_category.get(cat, 0) + 1
+                )
 
     def instant(
         self, name: str, category: str, args: Optional[dict] = None
@@ -467,13 +492,37 @@ class Tracer:
             out.append(ev)
         return out
 
+    def chrome_events_for_trace(self, trace_id: str) -> List[dict]:
+        """The live events annotated with ``trace_id`` (``args["trace"]``,
+        or membership in a shared event's ``args["traces"]`` — the lane
+        batcher's coalesced launches carry every rider), as Chrome dicts
+        — the tail sampler's copy-out when a request earns an exemplar
+        (rare, so the O(ring) scan is off the hot path)."""
+        out = []
+        for e in self.chrome_events():
+            a = e.get("args") or {}
+            if a.get("trace") == trace_id or (
+                trace_id in a.get("traces", ())
+            ):
+                out.append(e)
+        return out
+
+    def drops_snapshot(self) -> Tuple[int, Dict[str, int]]:
+        """``(total dropped, per-category dropped)`` — taken together so
+        exemplar completeness verdicts see one consistent view."""
+        with self._lock:
+            return self.dropped_events, dict(self.dropped_by_category)
+
     def export_chrome(self, path_or_stream) -> int:
         """Write the Chrome trace-event JSON; returns the event count."""
         evs = self.chrome_events()
         doc = {
             "traceEvents": evs,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": self.dropped_events},
+            "otherData": {
+                "dropped_events": self.dropped_events,
+                "dropped_by_category": dict(self.dropped_by_category),
+            },
         }
         if hasattr(path_or_stream, "write"):
             json.dump(doc, path_or_stream)
@@ -504,6 +553,182 @@ def trace_ctx(**kw) -> Iterator[None]:
         yield
     finally:
         _TLS.ctx = old
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing: Dapper-style ids + hop annotations per request.
+# ---------------------------------------------------------------------------
+
+#: Hop-annotation cap per request: a runaway seam (thousands of parts)
+#: must not turn the always-on summary path into unbounded memory.
+MAX_REQUEST_HOPS = 256
+
+
+def _rand_hex(n_bytes: int) -> str:
+    """``n_bytes`` of entropy as lowercase hex, from a per-thread buffer
+    refilled by one ``os.urandom(1024)`` syscall per ~20 requests — id
+    generation is on the always-on per-request path, and a syscall per
+    id is the kind of fixed cost the <2% tracing-overhead contract is
+    measured against."""
+    n = n_bytes * 2
+    buf = getattr(_TLS, "idbuf", "")
+    if len(buf) < n:
+        buf = os.urandom(1024).hex()
+    out = buf[:n]
+    _TLS.idbuf = buf[n:]
+    return out
+
+
+class RequestContext:
+    """One served request's identity and its always-on hop summary.
+
+    A 128-bit ``trace_id`` names the request end to end (the client
+    originates it; the daemon continues it — the Dapper propagation
+    stance), a 64-bit ``span_id`` names this process's segment of it,
+    and ``baggage`` carries opaque key/values across the wire.  Both ids
+    are lowercase hex strings so they serialize into the serve protocol
+    and the JSONL artifacts without encoding ceremony.
+
+    Beyond identity, the context accumulates a bounded list of **hop
+    annotations** — ``(hop name, start offset, duration, extras)``
+    appended by every seam the request crosses (admission queue wait,
+    lane-batcher wait/decode, endpoint window reads, executor attempts,
+    OOM evict/tier-down, deadline expiry).  This is the always-on tail
+    of the tracing plane: O(1) per seam, no ring buffer needed, and it
+    is what ``tools/request_report.py`` renders as the waterfall.  The
+    ring's full event set (annotated with ``args["trace"]``) is only
+    copied out for exemplar-worthy requests.
+
+    Thread-ambient via :func:`request_scope` / :func:`current_request`
+    (the :func:`deadline_scope` pattern): the serve handler thread sets
+    it once; work handed to *other* threads (the job pool, the executor
+    pool) re-enters the scope explicitly — thread-locals do not follow a
+    ThreadPoolExecutor submit.
+    """
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "op", "baggage",
+        "t0", "t0_wall", "hops", "hops_dropped",
+    )
+
+    def __init__(
+        self,
+        trace_id: str,
+        span_id: str,
+        parent_id: Optional[str] = None,
+        op: str = "",
+        baggage: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.op = op
+        self.baggage = baggage or {}
+        self.t0 = time.perf_counter()
+        self.t0_wall = time.time()
+        self.hops: List[dict] = []
+        self.hops_dropped = 0
+
+    @classmethod
+    def new(
+        cls, op: str = "", baggage: Optional[Dict[str, str]] = None
+    ) -> "RequestContext":
+        """Originate a fresh trace (client side, or daemon side for a
+        request that arrived without one)."""
+        return cls(_rand_hex(16), _rand_hex(8), op=op, baggage=baggage)
+
+    def child(self, op: str = "") -> "RequestContext":
+        """A new span of the *same* trace (the sort job continuing its
+        submission request on the job-pool thread)."""
+        return RequestContext(
+            self.trace_id,
+            _rand_hex(8),
+            parent_id=self.span_id,
+            op=op or self.op,
+            baggage=dict(self.baggage),
+        )
+
+    # -- wire format --------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        """The serve protocol's ``trace`` field."""
+        d: dict = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.baggage:
+            d["baggage"] = dict(self.baggage)
+        return d
+
+    @classmethod
+    def from_wire(cls, d, op: str = "") -> Optional["RequestContext"]:
+        """Continue a trace from a request's ``trace`` field; a garbled
+        field is treated as absent (a broken client must not break the
+        daemon *or* silently drop its own attribution — the daemon
+        originates a fresh id instead)."""
+        if not isinstance(d, dict):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not (
+            isinstance(tid, str) and isinstance(sid, str)
+            and 8 <= len(tid) <= 64 and 4 <= len(sid) <= 32
+        ):
+            return None
+        try:
+            int(tid, 16), int(sid, 16)
+        except ValueError:
+            return None
+        bg = d.get("baggage")
+        return cls(
+            tid, _rand_hex(8), parent_id=sid, op=op,
+            baggage=dict(bg) if isinstance(bg, dict) else None,
+        )
+
+    # -- hop annotations ----------------------------------------------------
+
+    def annotate(
+        self, hop: str, ms: Optional[float] = None, **extras
+    ) -> None:
+        """Record one hop on the always-on summary path (appends are
+        GIL-atomic, so executor pool threads sharing a job's context
+        need no lock).  ``ms`` is the hop's duration; omitted for
+        point events (a deadline expiry, a tier decision)."""
+        if len(self.hops) >= MAX_REQUEST_HOPS:
+            self.hops_dropped += 1
+            METRICS.count("serve.trace.hops_dropped", 1)
+            return
+        h = {
+            "hop": hop,
+            "t_ms": (time.perf_counter() - self.t0) * 1e3,
+        }
+        if ms is not None:
+            h["ms"] = float(ms)
+        if extras:
+            h.update(extras)
+        self.hops.append(h)
+
+    def elapsed_ms(self) -> float:
+        return (time.perf_counter() - self.t0) * 1e3
+
+
+def current_request() -> Optional[RequestContext]:
+    """The ambient request context of this thread (None in batch mode —
+    the disarmed contract: a batch pipeline run records zero
+    request-context events)."""
+    return getattr(_TLS, "request", None)
+
+
+@contextlib.contextmanager
+def request_scope(ctx: Optional[RequestContext]) -> Iterator[None]:
+    """Ambient request context for the current thread (None = leave
+    unset).  Every tracer event emitted in scope carries the trace id;
+    every seam's :meth:`RequestContext.annotate` lands on ``ctx``."""
+    if ctx is None:
+        yield
+        return
+    old = getattr(_TLS, "request", None)
+    _TLS.request = ctx
+    try:
+        yield
+    finally:
+        _TLS.request = old
 
 
 @contextlib.contextmanager

@@ -1,0 +1,875 @@
+"""Per-request distributed tracing, tail-latency exemplars, the SLO
+monitor, and the access log (PR 12).
+
+Everything runs under the CPU pin.  The e2e drills go through a live
+in-process daemon with the ``serve.stall`` / ``arena.oom`` fault
+directives armed — the acceptance stance: a slowed/failed request must
+yield an exemplar whose waterfall names the injected seam as the
+dominant hop, and a clean run must yield zero exemplars (the same
+disarmed contract the fault seams carry).
+"""
+
+import importlib.util
+import io
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import faults
+from hadoop_bam_tpu.conf import (
+    Configuration,
+    SERVE_ACCESS_LOG,
+    SERVE_EXEMPLAR_DIR,
+    SERVE_EXEMPLAR_THRESHOLD_MS,
+    SERVE_FLIGHTREC,
+    SERVE_FLIGHTREC_CADENCE_MS,
+    SERVE_SLO,
+    SERVE_SLO_WINDOWS,
+)
+from hadoop_bam_tpu.pipeline import sort_bam
+from hadoop_bam_tpu.serve import (
+    BamDaemon,
+    ExemplarStore,
+    ServeClient,
+    SloMonitor,
+    TailSampler,
+    parse_objectives,
+)
+from hadoop_bam_tpu.serve import exemplars as exemplars_mod
+from hadoop_bam_tpu.serve import flightrec as flightrec_mod
+from hadoop_bam_tpu.serve import slo as slo_mod
+from hadoop_bam_tpu.spec import bam, bgzf, indices
+from hadoop_bam_tpu.utils.tracing import (
+    METRICS,
+    TRACER,
+    MetricsRegistry,
+    RequestContext,
+    Tracer,
+    current_request,
+    delta,
+    request_scope,
+    snapshot,
+    span,
+)
+
+pytestmark = pytest.mark.serve
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_module(path: pathlib.Path, name: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def request_report_mod():
+    return _load_module(
+        REPO / "tools" / "request_report.py", "request_report"
+    )
+
+
+# ---------------------------------------------------------------------------
+# RequestContext: ids, wire round trip, ambient scope, hop annotations
+# ---------------------------------------------------------------------------
+
+
+def test_request_context_ids_and_child():
+    a = RequestContext.new(op="view")
+    b = RequestContext.new(op="view")
+    assert a.trace_id != b.trace_id  # 128-bit ids do not collide
+    assert len(a.trace_id) == 32 and len(a.span_id) == 16
+    int(a.trace_id, 16)  # lowercase hex
+    c = a.child(op="sort.job")
+    assert c.trace_id == a.trace_id  # same trace...
+    assert c.span_id != a.span_id  # ...new span
+    assert c.parent_id == a.span_id
+
+
+def test_request_context_wire_round_trip():
+    a = RequestContext.new(op="view", baggage={"tenant": "t1"})
+    w = a.to_wire()
+    b = RequestContext.from_wire(w, op="view")
+    assert b is not None
+    assert b.trace_id == a.trace_id  # the trace continues...
+    assert b.span_id != a.span_id  # ...as a new span
+    assert b.parent_id == a.span_id
+    assert b.baggage == {"tenant": "t1"}
+    # Garbled wire fields degrade to None, never raise (the daemon mints
+    # a fresh id instead).
+    for bad in (None, "x", 7, {}, {"trace_id": 3, "span_id": "ab" * 4},
+                {"trace_id": "zz" * 16, "span_id": "ab" * 4},
+                {"trace_id": "a" * 100, "span_id": "ab" * 4}):
+        assert RequestContext.from_wire(bad) is None
+
+
+def test_request_scope_is_ambient_and_restores():
+    assert current_request() is None
+    ctx = RequestContext.new(op="view")
+    with request_scope(ctx):
+        assert current_request() is ctx
+        inner = RequestContext.new(op="flagstat")
+        with request_scope(inner):
+            assert current_request() is inner
+        assert current_request() is ctx
+    assert current_request() is None
+    with request_scope(None):  # None = leave unset (one branch)
+        assert current_request() is None
+
+
+def test_armed_tracer_merges_trace_id_into_events():
+    ctx = RequestContext.new(op="view")
+    TRACER.start(capacity=64)
+    try:
+        with request_scope(ctx):
+            with span("reqtrace.stage_a", category="stage"):
+                pass
+        with span("reqtrace.stage_b", category="stage"):
+            pass  # outside the scope: no trace arg
+        evs = TRACER.chrome_events()
+        mine = TRACER.chrome_events_for_trace(ctx.trace_id)
+    finally:
+        TRACER.stop()
+    a = next(e for e in evs if e["name"] == "reqtrace.stage_a")
+    b = next(e for e in evs if e["name"] == "reqtrace.stage_b")
+    assert a["args"]["trace"] == ctx.trace_id
+    assert "trace" not in (b.get("args") or {})
+    assert [e["name"] for e in mine] == ["reqtrace.stage_a"]
+
+
+def test_hop_annotations_bounded():
+    ctx = RequestContext.new(op="view")
+    ctx.annotate("queue.wait", ms=2.0, op="view")
+    ctx.annotate("batch.wait", ms=5.0)
+    ctx.annotate("deadline.endpoint")  # point event, no ms
+    assert [h["hop"] for h in ctx.hops] == [
+        "queue.wait", "batch.wait", "deadline.endpoint"
+    ]
+    assert ctx.hops[0]["ms"] == 2.0 and "ms" not in ctx.hops[2]
+    from hadoop_bam_tpu.utils.tracing import MAX_REQUEST_HOPS
+
+    for i in range(MAX_REQUEST_HOPS + 10):
+        ctx.annotate("executor.part", ms=1.0, part=i)
+    assert len(ctx.hops) == MAX_REQUEST_HOPS
+    assert ctx.hops_dropped > 0
+
+
+# ---------------------------------------------------------------------------
+# Per-category drop accounting + incomplete stamping (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_counts_drops_per_category():
+    t = Tracer()
+    t.start(capacity=16)
+    try:
+        for i in range(16):
+            t.emit(f"cat_a.ev_{i}", "aaa", 0.0, 1.0)
+        for i in range(10):
+            t.emit(f"cat_b.ev_{i}", "bbb", 0.0, 1.0)
+        # The 10 cat_b emits evicted the 10 oldest cat_a events.
+        assert t.dropped_events == 10
+        total, by_cat = t.drops_snapshot()
+        assert total == 10 and by_cat == {"aaa": 10}
+        buf = io.StringIO()
+        t.export_chrome(buf)
+    finally:
+        t.stop()
+    doc = json.loads(buf.getvalue())
+    assert doc["otherData"]["dropped_events"] == 10
+    assert doc["otherData"]["dropped_by_category"] == {"aaa": 10}
+
+
+def test_exemplar_incomplete_stamp_from_category_drops():
+    summary = {"trace_id": "ab" * 16, "op": "view", "outcome": "OK",
+               "duration_ms": 1.0, "tier_decisions": [], "hops": []}
+    evs = [{"name": "x", "cat": "stage", "ph": "X", "ts": 0.0}]
+    ex = exemplars_mod.build_exemplar(summary, evs, {"queue": 3})
+    assert ex["incomplete"] is False  # drops in a category it lacks
+    ex2 = exemplars_mod.build_exemplar(summary, evs, {"stage": 1})
+    assert ex2["incomplete"] is True  # its own category lost events
+    # Zero surviving events + any drops at all: unknowable ⇒ incomplete.
+    ex3 = exemplars_mod.build_exemplar(summary, [], {"queue": 1})
+    assert ex3["incomplete"] is True
+    ex4 = exemplars_mod.build_exemplar(summary, [], {})
+    assert ex4["incomplete"] is False
+
+
+# ---------------------------------------------------------------------------
+# Tail sampler + exemplar store units
+# ---------------------------------------------------------------------------
+
+
+def _summary(op="view", outcome="OK", ms=1.0, tiers=()):
+    ctx = RequestContext.new(op=op)
+    s = exemplars_mod.request_summary(ctx, outcome, ms, op=op)
+    s["tier_decisions"] = list(tiers)
+    return s
+
+
+def test_tail_sampler_triggers():
+    store = ExemplarStore(max_exemplars=8)
+    sampler = TailSampler(store, threshold_ms=50.0)
+    assert sampler.observe(_summary(ms=10.0)) is None  # fast + clean
+    assert sampler.observe(_summary(ms=80.0)) is not None  # breach
+    assert sampler.observe(_summary(outcome="SHED", ms=1.0)) is not None
+    assert sampler.observe(
+        _summary(outcome="DEADLINE_EXCEEDED", ms=1.0)
+    ) is not None
+    assert sampler.observe(
+        _summary(ms=1.0, tiers=["oom.tierdown"])
+    ) is not None
+    assert len(store) == 4
+    # Threshold 0 disables the latency trigger; outcomes still fire.
+    s0 = TailSampler(store, threshold_ms=0.0)
+    assert s0.observe(_summary(ms=10_000.0)) is None
+    assert s0.observe(_summary(outcome="ERROR")) is not None
+    # Per-op override: sort.job never latency-samples.
+    s1 = TailSampler(
+        store, threshold_ms=50.0, per_op_threshold_ms={"sort.job": 0.0}
+    )
+    assert s1.observe(_summary(op="sort.job", ms=10_000.0)) is None
+
+
+def test_would_sample_equivalent_to_should_sample():
+    """The server's fast path (`would_sample`, no summary built) must
+    agree with the full decision (`should_sample`) on every trigger
+    class — a drift here silently drops exemplars."""
+    sampler = TailSampler(
+        ExemplarStore(), threshold_ms=50.0,
+        per_op_threshold_ms={"sort.job": 0.0},
+    )
+    cases = [
+        _summary(ms=10.0),
+        _summary(ms=80.0),
+        _summary(outcome="SHED", ms=1.0),
+        _summary(outcome="RETRY_AFTER", ms=1.0),
+        _summary(outcome="DEADLINE_EXCEEDED", ms=1.0),
+        _summary(outcome="ERROR", ms=1.0),
+        _summary(ms=1.0, tiers=["oom.tierdown"]),
+        _summary(op="sort.job", ms=10_000.0),
+        _summary(op="sort.job", outcome="ERROR", ms=1.0),
+    ]
+    for s in cases:
+        # would_sample reads raw hops; tier_decisions in these fixtures
+        # are injected post-hoc, so mirror them as hops.
+        hops = list(s["hops"]) + [
+            {"hop": t, "t_ms": 0.0} for t in s["tier_decisions"]
+        ]
+        assert sampler.would_sample(
+            s["op"], s["outcome"], s["duration_ms"], hops
+        ) == (sampler.should_sample(s) is not None), s
+
+
+def test_exemplar_store_bound_and_spill(tmp_path):
+    spill = str(tmp_path / "ex")
+    store = ExemplarStore(max_exemplars=2, spill_dir=spill)
+    ids = []
+    for i in range(3):
+        s = _summary(ms=float(i))
+        ids.append(s["trace_id"])
+        store.add(exemplars_mod.build_exemplar(s, [], {}))
+    assert len(store) == 2
+    assert store.get(ids[0]) is None  # oldest evicted from memory...
+    assert store.get(ids[2]) is not None
+    # ...but every exemplar was spilled and survives the bound.
+    assert sorted(os.listdir(spill)) == sorted(
+        f"{t}.json" for t in ids
+    )
+    on_disk = json.load(open(os.path.join(spill, f"{ids[0]}.json")))
+    assert on_disk["summary"]["trace_id"] == ids[0]
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor: grammar, burn-rate math on synthetic windows, alerts
+# ---------------------------------------------------------------------------
+
+
+def test_slo_objective_grammar():
+    objs = parse_objectives(
+        "view:latency=100;view:availability=0.999;"
+        "sort:latency=2000@0.95"
+    )
+    assert [o.name for o in objs] == [
+        "view:latency<100ms", "view:availability", "sort:latency<2000ms"
+    ]
+    assert objs[0].target == slo_mod.DEFAULT_TARGET
+    assert objs[2].target == 0.95 and objs[2].threshold_ms == 2000
+    for bad in ("view", "view:latency", "view:p99=10", "view:latency=x",
+                "view:availability=1.5"):
+        with pytest.raises(ValueError):
+            parse_objectives(bad)
+
+
+def _mon(spec, reg, fast=10.0, slow=100.0, **kw):
+    return SloMonitor(
+        parse_objectives(spec), fast_s=fast, slow_s=slow,
+        registry=reg, **kw
+    )
+
+
+def test_slo_burn_rate_math_on_synthetic_windows():
+    reg = MetricsRegistry()
+    mon = _mon("view:latency=100@0.9", reg)
+    # t=0: 10 requests, all fast.
+    for _ in range(10):
+        reg.observe("serve.op.view.ms", 10.0)
+    ev = mon.evaluate(now=1000.0)
+    o = ev["objectives"][0]
+    assert o["windows"]["fast"]["burn"] == 0.0
+    assert ev["compliant"] is True
+    # t=+5s (inside the fast window): 10 more, half over threshold.
+    for i in range(10):
+        reg.observe("serve.op.view.ms", 500.0 if i % 2 else 10.0)
+    ev = mon.evaluate(now=1005.0)
+    o = ev["objectives"][0]
+    w = o["windows"]["fast"]
+    # Window delta: 10 new requests, 5 bad → bad_frac 0.5; budget
+    # (1 - 0.9) = 0.1 → burn 5.0.
+    assert w["total"] == 10 and w["bad"] == 5
+    assert w["burn"] == pytest.approx(5.0)
+    assert w["compliant"] is False
+    # Zero-traffic window: burn 0, compliant (a clean soak reports
+    # full compliance, not NaN).
+    ev = mon.evaluate(now=1200.0)
+    o = ev["objectives"][0]
+    assert o["windows"]["fast"]["burn"] == 0.0
+    assert o["windows"]["fast"]["compliant"] is True
+
+
+def test_slo_availability_and_alert_transitions():
+    reg = MetricsRegistry()
+    mon = _mon(
+        "view:availability=0.9", reg, fast=10.0, slow=40.0,
+        fast_burn=2.0, slow_burn=1.0,
+    )
+    s0 = snapshot()
+    mon.evaluate(now=0.0)
+    # A sustained 50% error rate: burn 5.0 in both windows → alert.
+    for t in (5.0, 10.0, 15.0, 20.0):
+        for i in range(10):
+            reg.observe("serve.op.view.ms", 1.0)
+            if i % 2:
+                reg.count("serve.op.view.errors", 1)
+        ev = mon.evaluate(now=t)
+    o = ev["objectives"][0]
+    assert o["alerting"] is True
+    assert ev["alerting"] == ["view:availability"]
+    assert ev["compliant"] is False
+    # The alert counted once per transition, not once per evaluate.
+    d = delta(s0)
+    assert d["counters"]["serve.slo.alerts"] == 1
+    # Burn gauges are published first-class (ride Prometheus export).
+    from hadoop_bam_tpu.utils.tracing import prometheus_text
+
+    txt = prometheus_text()
+    assert "hbam_slo_view_availability_burn_fast" in txt
+    # Recovery: clean traffic long enough to flush both windows.
+    for t in (60.0, 70.0, 80.0, 90.0, 100.0, 110.0):
+        for _ in range(10):
+            reg.observe("serve.op.view.ms", 1.0)
+        ev = mon.evaluate(now=t)
+    assert ev["objectives"][0]["alerting"] is False
+    assert ev["compliant"] is True
+    # Re-breach counts a second transition.
+    for t in (115.0, 120.0, 125.0, 130.0, 140.0, 150.0):
+        for _ in range(10):
+            reg.observe("serve.op.view.ms", 1.0)
+            reg.count("serve.op.view.errors", 1)
+        ev = mon.evaluate(now=t)
+    assert ev["objectives"][0]["alerting"] is True
+    assert delta(s0)["counters"]["serve.slo.alerts"] == 2
+
+
+def test_slo_format_block_renders():
+    reg = MetricsRegistry()
+    mon = _mon("view:latency=100", reg)
+    txt = slo_mod.format_slo_block(mon.evaluate(now=0.0))
+    assert "COMPLIANT" in txt and "view:latency<100ms" in txt
+    assert "no monitor" in slo_mod.format_slo_block({})
+
+
+# ---------------------------------------------------------------------------
+# Access log: per-request lines, rotation, join key
+# ---------------------------------------------------------------------------
+
+
+def test_access_log_lines_and_rotation(tmp_path):
+    base = str(tmp_path / "access.jsonl")
+    log = flightrec_mod.AccessLog(base, max_bytes=16 << 10)
+    n = 200  # enough to cross the half-budget rotate at least once
+    for i in range(n):
+        log.log(exemplars_mod.access_record(_summary(ms=float(i))))
+    log.close()
+    recs, torn = flightrec_mod.load_access_log(base)
+    assert torn == 0
+    assert 0 < len(recs) < n  # rotation reclaimed the oldest half
+    for r in recs:
+        assert set(r) >= {
+            "trace_id", "op", "outcome", "duration_ms",
+            "queue_wait_ms", "batch_wait_ms", "tier_decisions", "shed",
+            "oom",
+        }
+        assert "hops" not in r  # the log is the compact record
+    # Both segments exist and the total stays bounded.
+    s0, s1 = flightrec_mod.segment_paths(base)
+    assert os.path.exists(s0) and os.path.exists(s1)
+    assert os.path.getsize(s0) + os.path.getsize(s1) <= 20 << 10
+
+
+# ---------------------------------------------------------------------------
+# Live daemon: propagation, drills, stats/prometheus/flightrec surfaces
+# ---------------------------------------------------------------------------
+
+
+def _write_sorted_bam(tmp, n=200) -> str:
+    refs = [("chr1", 1_000_000)]
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n@SQ\tSN:chr1\tLN:1000000", refs
+    )
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    w = bgzf.BgzfWriter(buf, level=1, append_terminator=True)
+    w.write(hdr.encode())
+    for i in range(n):
+        rec = bam.build_record(
+            name=f"r{i:05d}", refid=0, pos=int(rng.integers(0, 900_000)),
+            mapq=60, flag=0, cigar=[(50, "M")], seq="A" * 50,
+            qual=bytes([30] * 50),
+        )
+        w.write(rec.encode())
+    w.close()
+    src = str(tmp / "unsorted.bam")
+    with open(src, "wb") as f:
+        f.write(buf.getvalue())
+    out = str(tmp / "sorted.bam")
+    sort_bam([src], out, backend="host")
+    with open(out + ".bai", "wb") as f:
+        indices.build_bai(out).save(f)
+    return out
+
+
+@pytest.fixture()
+def sorted_bam(tmp_path):
+    return _write_sorted_bam(tmp_path)
+
+
+def _start_daemon(tmp_path, conf=None, name="d.sock"):
+    sock = str(tmp_path / name)
+    d = BamDaemon(conf=conf, socket_path=sock, warmup=False)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=d.serve_forever, args=(ready,), daemon=True
+    )
+    t.start()
+    assert ready.wait(30), "daemon did not come up"
+    return d, t, ServeClient(socket_path=sock)
+
+
+def test_trace_id_propagates_client_to_daemon(sorted_bam, tmp_path):
+    conf = Configuration()
+    conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, 0)  # outcome-only triggers
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    try:
+        client.view(sorted_bam, "chr1:1-100000")
+        tid = client.last_trace_id
+        assert tid and len(tid) == 32
+        # A failing request (unknown contig) ends in ERROR → exemplar,
+        # keyed by the id the CLIENT originated: the propagation proof.
+        with pytest.raises(Exception):
+            client.view(sorted_bam, "nope:1-10")
+        bad_tid = client.last_trace_id
+        assert bad_tid != tid
+        ex = client.exemplars(bad_tid)
+        assert ex["summary"]["outcome"] == "ERROR"
+        assert ex["summary"]["trace_id"] == bad_tid
+        # The clean request earned no exemplar.
+        listing = client.exemplars()
+        assert [e["trace_id"] for e in listing] == [bad_tid]
+    finally:
+        client.shutdown()
+        t.join(timeout=10)
+
+
+def test_stall_drill_waterfall_names_injected_seam_and_sums(
+    sorted_bam, tmp_path
+):
+    """The acceptance drill: a request slowed by an injected
+    ``serve.stall`` is reconstructable end-to-end — the waterfall's
+    dominant hop is the injected seam and the attributed hops sum
+    (within tolerance) to the client-observed latency."""
+    conf = Configuration()
+    conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, 60)
+    exdir = str(tmp_path / "ex")
+    conf.set(SERVE_EXEMPLAR_DIR, exdir)
+    try:
+        d, t, client = _start_daemon(tmp_path, conf=conf)
+        try:
+            # Warm request first (pre-arming): caches/jit hot, so the
+            # stalled request's time is fully seam-attributable.
+            client.view(sorted_bam, "chr1:1-100000")
+            faults.arm("seed=1;serve.stall:op=view,ms=150,n=1")
+            t0 = time.perf_counter()
+            client.view(sorted_bam, "chr1:1-100000")
+            client_ms = (time.perf_counter() - t0) * 1e3
+            tid = client.last_trace_id
+            ex = client.exemplars(tid)
+        finally:
+            client.shutdown()
+            t.join(timeout=10)
+    finally:
+        faults.disarm()
+    s = ex["summary"]
+    assert s["trigger"].startswith("latency:")
+    rr = request_report_mod()
+    rep = rr.waterfall(ex)
+    assert rep["dominant"]["hop"] == "reply.stall"
+    assert rep["incomplete"] is False
+    # The stall is ~150 of ~155 ms: dominant by a wide margin.
+    assert rep["dominant"]["ms"] >= 140.0
+    # Queue/batch/kernel attribution is separate, and the hop sum plus
+    # the honest unattributed remainder equals the server duration by
+    # construction; against the CLIENT-observed wall the tolerance
+    # covers socket + framing overhead.
+    hop_names = {h["hop"] for h in rep["hops"]}
+    assert "queue.wait" in hop_names
+    assert rep["attributed_ms"] + rep["unattributed_ms"] == (
+        pytest.approx(rep["duration_ms"], abs=0.01)
+    )
+    assert rep["duration_ms"] <= client_ms + 1.0
+    assert rep["attributed_ms"] >= 0.8 * client_ms
+    # The spill dir carries the same exemplar for post-daemon renders.
+    assert os.path.exists(os.path.join(exdir, f"{tid}.json"))
+    txt = rr.format_waterfall(rep)
+    assert "dominant" in txt and "reply stall" in txt
+
+
+def test_oom_drill_exemplar_names_tierdown(sorted_bam, tmp_path):
+    """An ``arena.oom``-struck request tiers down (PR 10's ladder) and —
+    new here — leaves an exemplar whose hops name evict → tier-down →
+    host decode, even though the request finished fast and fine."""
+    faults.arm("seed=1;arena.oom:n=2")
+    conf = Configuration()
+    conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, 0)
+    try:
+        d, t, client = _start_daemon(tmp_path, conf=conf)
+        try:
+            blob = client.view(sorted_bam, "chr1:1-100000")
+            assert len(blob) > 0  # the request still succeeded
+            ex = client.exemplars(client.last_trace_id)
+        finally:
+            client.shutdown()
+            t.join(timeout=10)
+    finally:
+        faults.disarm()
+    s = ex["summary"]
+    assert s["trigger"].startswith("tierdown:")
+    assert s["oom"] is True
+    hops = [h["hop"] for h in s["hops"]]
+    assert "oom.evict" in hops
+    assert "oom.tierdown" in hops
+    assert "oom.host_decode" in hops
+    assert hops.index("oom.evict") < hops.index("oom.tierdown")
+
+
+def test_clean_run_yields_zero_exemplars(sorted_bam, tmp_path):
+    """The disarmed-contract half of the drill: no faults, lenient
+    threshold → a healthy traffic mix leaves the exemplar store empty
+    and the SLO monitor fully compliant."""
+    conf = Configuration()
+    conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, 60_000)
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    try:
+        for _ in range(5):
+            client.view(sorted_bam, "chr1:1-100000")
+        client.flagstat(sorted_bam)
+        assert client.exemplars() == []
+        st = client.stats()
+        assert st["slo"]["compliant"] is True
+        assert st["slo"]["alerting"] == []
+        assert st["gauges"]["serve.trace.exemplar_count"] == 0
+    finally:
+        client.shutdown()
+        t.join(timeout=10)
+
+
+def test_access_log_joins_exemplars_on_trace_id(sorted_bam, tmp_path):
+    conf = Configuration()
+    base = str(tmp_path / "access.jsonl")
+    conf.set(SERVE_ACCESS_LOG, base)
+    conf.set_int(SERVE_EXEMPLAR_THRESHOLD_MS, 0)
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    try:
+        client.view(sorted_bam, "chr1:1-100000")
+        ok_tid = client.last_trace_id
+        with pytest.raises(Exception):
+            client.view(sorted_bam, "nope:1-10")
+        bad_tid = client.last_trace_id
+    finally:
+        client.shutdown()
+        t.join(timeout=10)
+    recs, torn = flightrec_mod.load_access_log(base)
+    assert torn == 0
+    by_id = {r["trace_id"]: r for r in recs}
+    # EVERY completed data-plane request logged one line...
+    assert by_id[ok_tid]["outcome"] == "OK"
+    assert by_id[bad_tid]["outcome"] == "ERROR"
+    assert by_id[ok_tid]["op"] == "view"
+    assert by_id[ok_tid]["duration_ms"] > 0
+
+
+def test_slo_breach_surfaces_in_stats_prometheus_and_flightrec(
+    sorted_bam, tmp_path
+):
+    """The synthetic breach drill: tight windows + an un-meetable
+    latency objective; the alert must appear in stats, the Prometheus
+    text, and the flight-recorder snapshots."""
+    conf = Configuration()
+    conf.set(SERVE_SLO, "view:latency=0.001@0.99")  # nothing meets 1 µs
+    conf.set(SERVE_SLO_WINDOWS, "5,10")
+    fr = str(tmp_path / "fr.jsonl")
+    conf.set(SERVE_FLIGHTREC, fr)
+    conf.set_int(SERVE_FLIGHTREC_CADENCE_MS, 50)
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    try:
+        # Lower the burn thresholds so one window of bad traffic alerts
+        # deterministically (the multiwindow rule still applies).
+        d.slo.fast_burn = 1.0
+        d.slo.slow_burn = 1.0
+        for _ in range(10):
+            client.view(sorted_bam, "chr1:1-100000")
+        st = client.stats()
+        slo = st["slo"]
+        assert slo["compliant"] is False
+        assert slo["alerting"] == ["view:latency<0.001ms"]
+        worst = slo["worst"]
+        assert worst["op"] == "view" and worst["burn_fast"] > 1.0
+        txt = client.metrics()
+        assert "hbam_slo_view_latency_burn_fast" in txt
+        assert "hbam_slo_view_latency_alerting 1.0" in txt
+        assert "hbam_serve_slo_alerts_total" in txt
+        time.sleep(0.15)  # at least one recorder tick past the breach
+    finally:
+        client.shutdown()
+        t.join(timeout=10)
+    snaps, _ = flightrec_mod.load_ring(fr)
+    assert snaps[-1]["final"] is True
+    with_slo = [s for s in snaps if "slo" in s]
+    assert with_slo, "flight recorder snapshots carry no slo block"
+    assert any(
+        s["slo"]["alerting"] == ["view:latency<0.001ms"]
+        for s in with_slo
+    )
+
+
+def test_request_tracing_off_leaves_no_trail(sorted_bam, tmp_path):
+    from hadoop_bam_tpu.conf import SERVE_REQUEST_TRACING
+
+    conf = Configuration()
+    conf.set_boolean(SERVE_REQUEST_TRACING, False)
+    d, t, client = _start_daemon(tmp_path, conf=conf)
+    try:
+        s0 = snapshot()
+        client.view(sorted_bam, "chr1:1-100000")
+        with pytest.raises(Exception):
+            client.view(sorted_bam, "nope:1-10")
+        assert client.exemplars() == []
+        de = delta(s0)
+        assert not any(
+            k.startswith("serve.trace.") for k in de["counters"]
+        )
+        assert not TRACER.armed  # the daemon did not arm the ring
+    finally:
+        client.shutdown()
+        t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Batch disarmed contract: no ambient context ⇒ zero request events
+# ---------------------------------------------------------------------------
+
+
+def test_batch_pipeline_records_zero_request_context_events(tmp_path):
+    """A plain (non-serve) sort under an armed tracer: no event carries
+    a trace id and no serve.trace.* counter moves — the batch pipeline
+    pays the same zero-cost disarmed contract as the fault seams."""
+    src = _write_sorted_bam(tmp_path, n=150)
+    out = str(tmp_path / "resorted.bam")
+    s0 = snapshot()
+    TRACER.start(capacity=4096)
+    try:
+        sort_bam([src], out, backend="host")
+        evs = TRACER.chrome_events()
+    finally:
+        TRACER.stop()
+    assert evs, "traced sort produced no events at all"
+    traced = [e for e in evs if "trace" in (e.get("args") or {})]
+    assert traced == [], f"batch events carried trace ids: {traced[:3]}"
+    de = delta(s0)
+    assert not any(
+        k.startswith("serve.trace.") for k in de["counters"]
+    ), de["counters"]
+    assert current_request() is None
+
+
+# ---------------------------------------------------------------------------
+# tools/request_report.py: reduction + CLI
+# ---------------------------------------------------------------------------
+
+
+def _fixture_exemplar():
+    ctx = RequestContext.new(op="view")
+    ctx.annotate("queue.wait", ms=2.0, op="view")
+    ctx.annotate("batch.wait", ms=10.0, members=3, coalesced=2)
+    ctx.annotate("batch.decode", ms=4.0)
+    ctx.annotate("view.overlap", ms=1.0)
+    ctx.annotate("reply.stall", ms=80.0, injected=True)
+    s = exemplars_mod.request_summary(ctx, "OK", 100.0, op="view")
+    s["trigger"] = "latency:100.0ms>50ms"
+    return exemplars_mod.build_exemplar(
+        s, [{"name": "serve.view", "cat": "stage", "ph": "X",
+             "ts": 0.0, "dur": 1000.0,
+             "args": {"trace": ctx.trace_id}}],
+        {},
+    )
+
+
+def test_request_report_waterfall_reduction():
+    rr = request_report_mod()
+    ex = _fixture_exemplar()
+    rep = rr.waterfall(ex)
+    assert rep["dominant"]["hop"] == "reply.stall"
+    assert rep["attributed_ms"] == pytest.approx(97.0)
+    assert rep["unattributed_ms"] == pytest.approx(3.0)
+    assert rep["incomplete"] is False
+    # Hops render in start order with shares of the total.
+    assert [h["hop"] for h in rep["hops"]] == [
+        "queue.wait", "batch.wait", "batch.decode", "view.overlap",
+        "reply.stall",
+    ]
+    assert rep["hops"][-1]["share"] == pytest.approx(0.8)
+    txt = rr.format_waterfall(rep)
+    assert "reply stall (injected fault)" in txt
+    assert "<- dominant" in txt
+    assert "INCOMPLETE" not in txt
+    # An incomplete tree renders the banner.
+    ex2 = dict(ex, incomplete=True)
+    assert "INCOMPLETE" in rr.format_waterfall(rr.waterfall(ex2))
+
+
+def test_request_report_cli_runs(tmp_path):
+    ex = _fixture_exemplar()
+    tid = ex["summary"]["trace_id"]
+    exdir = tmp_path / "ex"
+    exdir.mkdir()
+    (exdir / f"{tid}.json").write_text(json.dumps(ex))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "request_report.py"),
+         tid, "--exemplar-dir", str(exdir)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "dominant hop: reply stall" in r.stdout
+    # Prefix lookup + --json.
+    rj = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "request_report.py"),
+         tid[:8], "--exemplar-dir", str(exdir), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert rj.returncode == 0, rj.stderr
+    rep = json.loads(rj.stdout)
+    assert rep["dominant"]["hop"] == "reply.stall"
+    assert rep["trace_id"] == tid
+
+
+# ---------------------------------------------------------------------------
+# Lint: dispatch + seam coverage (satellite 5)
+# ---------------------------------------------------------------------------
+
+#: Files that emit category="stage"/"queue" events WITHOUT touching the
+#: request-context API directly: their events are attributed through the
+#: *ambient* scope their callers establish (the dispatch wrapper, the
+#: executor pool re-entry), and in batch mode they run with no context
+#: by design.  Shrinking this list is progress; growing it needs the
+#: same justification as the HBM lint's exemptions.
+_AMBIENT_EXEMPT = (
+    "io/bam.py",
+    "collate/fixmate.py",
+    "collate/host.py",
+    "utils/tracing.py",  # the emitter itself
+)
+
+
+def test_lint_every_dispatch_op_is_registered_and_scoped():
+    """Structural lint over serve/server.py: (1) every ``if op == …``
+    dispatch arm handles an op registered in KNOWN_OPS (and vice
+    versa), so a new op cannot be added without being registered; (2)
+    ``_dispatch`` is invoked under the ``request_scope`` wrapper, so
+    every registered op runs under a RequestContext."""
+    from hadoop_bam_tpu.serve.server import KNOWN_OPS
+
+    src = (REPO / "hadoop_bam_tpu" / "serve" / "server.py").read_text()
+    dispatch_src = src.split("def _dispatch", 1)[1].split("\n    def ")[0]
+    handled = set(re.findall(r'if op == "(\w+)"', dispatch_src))
+    assert handled == set(KNOWN_OPS), (
+        f"dispatch arms {handled} != registered KNOWN_OPS "
+        f"{set(KNOWN_OPS)}"
+    )
+    handle_src = src.split("def _handle(", 1)[1].split("\n    def ")[0]
+    scope_at = handle_src.find("with request_scope(rctx):")
+    call_at = handle_src.find("self._dispatch(req)")
+    assert 0 <= scope_at < call_at, (
+        "_dispatch is not invoked under the request_scope wrapper"
+    )
+
+
+def test_lint_stage_queue_seams_run_under_request_context():
+    """Every file emitting category="stage"/"queue" events (or using the
+    stage decorator) must either touch the request-context API
+    (current_request/request_scope — it annotates or re-enters scopes
+    itself) or be on the documented ambient-exemption list — so a new
+    seam cannot silently produce unattributed events."""
+    pkg = REPO / "hadoop_bam_tpu"
+    emit = re.compile(r'category="(?:stage|queue)"|_trace_stage\(')
+    uses = re.compile(r"current_request\(|request_scope\(")
+    bad = []
+    n_emitters = 0
+    for f in sorted(pkg.rglob("*.py")):
+        rel = str(f.relative_to(pkg)).replace("\\", "/")
+        src = f.read_text()
+        if not emit.search(src):
+            continue
+        n_emitters += 1
+        if rel in _AMBIENT_EXEMPT:
+            continue
+        if not uses.search(src):
+            bad.append(rel)
+    assert n_emitters >= 5, f"lint found too few emitters ({n_emitters})"
+    assert not bad, (
+        "stage/queue-emitting files neither using the request-context "
+        "API nor on the documented exemption list:\n" + "\n".join(bad)
+    )
+    # The exemption list stays honest: every entry still exists and
+    # still emits (a stale exemption hides nothing but confuses).
+    for rel in _AMBIENT_EXEMPT:
+        p = pkg / rel
+        assert p.exists() and emit.search(p.read_text()), rel
+
+
+def test_lint_client_code_mapping_covers_exemplars_op():
+    """The client must know every op the server registers (a typo in a
+    client method falls out here)."""
+    from hadoop_bam_tpu.serve.server import KNOWN_OPS
+
+    src = (REPO / "hadoop_bam_tpu" / "serve" / "client.py").read_text()
+    for op in KNOWN_OPS:
+        assert f'"op": "{op}"' in src, f"client never issues op {op!r}"

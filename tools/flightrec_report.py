@@ -103,6 +103,10 @@ def reduce_ring(snaps: List[dict], torn: int) -> dict:
         - (first.get("t_wall", 0) or 0),
         "last_wall_time": last.get("t_wall"),
         "clean_drain": bool(last.get("final")),
+        # SLO state at death (PR 12): the last snapshot's slo block —
+        # which objectives were burning when the daemon stopped
+        # recording.  None for pre-SLO rings.
+        "slo_at_death": last.get("slo"),
         "final": last,
         "series": series,
     }
@@ -126,6 +130,18 @@ def format_report(rep: dict, last_n: int = 10) -> str:
     if rep.get("last_wall_time"):
         age = time.time() - rep["last_wall_time"]
         lines.append(f"last snapshot: {age:.1f} s ago")
+    slo = rep.get("slo_at_death")
+    if slo is not None:
+        burns = slo.get("burns") or {}
+        worst = max(burns.values()) if burns else 0.0
+        lines.append(
+            "slo at death: "
+            + (
+                "ALERTING: " + ", ".join(slo["alerting"])
+                if slo.get("alerting")
+                else f"compliant (worst fast burn {worst:.2f})"
+            )
+        )
     lines.append("")
     lines.append(
         f"{'seq':>6} {'t+s':>7} {'queue':>5} {'run':>4} {'tok':>4} "

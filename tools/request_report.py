@@ -1,0 +1,302 @@
+#!/usr/bin/env python3
+"""Render one request's waterfall from its trace id.
+
+The serve daemon's tail sampler stores exemplars — a breaching request's
+hop summary plus its full event set copied out of the tracer ring — in a
+bounded in-daemon store (the ``exemplars`` serve op) and, with
+``--exemplar-dir``, as one ``<trace_id>.json`` file each.  This tool
+turns an exemplar back into the question it answers: *why was this one
+request slow?*
+
+::
+
+    queue wait  ->  batch wait  ->  inflate  ->  kernel  ->  reply
+
+Each hop row shows its duration, its share of the request, and a bar;
+the dominant hop is flagged, the unattributed remainder is reported
+honestly (never folded into a hop), and a tree whose event categories
+lost ring events renders with an INCOMPLETE banner — a partial waterfall
+must never pass as a complete one.
+
+Stdlib-only: reads a spill dir or file directly, or asks a live daemon
+over its length-prefixed JSON socket (the framing is 4 bytes big-endian
+length + UTF-8 JSON, reimplemented here so no package import is needed).
+
+Usage::
+
+    python tools/request_report.py TRACE_ID --exemplar-dir DIR [--json]
+    python tools/request_report.py TRACE_ID --file exemplar.json
+    python tools/request_report.py TRACE_ID --socket /path/daemon.sock
+    python tools/request_report.py TRACE_ID --port 7777
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import struct
+import sys
+from typing import List, Optional
+
+_LEN = struct.Struct(">I")
+
+#: Human labels for the seam hop names (unknown hops render verbatim).
+HOP_LABELS = {
+    "queue.wait": "admission queue wait",
+    "queue.shed": "admission shed",
+    "batch.wait": "lane-batcher wait",
+    "batch.decode": "member inflate (shared launch)",
+    "window.read": "split window read+inflate+parse",
+    "view.index": "header/.bai resolution",
+    "view.overlap": "overlap kernel",
+    "view.encode": "reply gather+deflate",
+    "reply.stall": "reply stall (injected fault)",
+    "oom.evict": "arena LRU evict (device OOM)",
+    "oom.tierdown": "host tier-down (device OOM)",
+    "oom.host_decode": "host-codec decode (post tier-down)",
+    "pipeline.read": "pipeline read phase",
+    "pipeline.spill": "pipeline spill phase",
+    "pipeline.write_merge": "pipeline write+merge phase",
+    "pipeline.range_merge": "pipeline range merge phase",
+    "executor.part": "part write attempt",
+}
+
+
+def _fetch_daemon(
+    trace_id: str, socket_path: Optional[str], port: Optional[int]
+) -> dict:
+    """One ``exemplars`` request over the daemon's framing."""
+    if socket_path is not None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        addr = socket_path
+    else:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        addr = ("127.0.0.1", port)
+    s.settimeout(30.0)
+    try:
+        s.connect(addr)
+        body = json.dumps(
+            {"op": "exemplars", "trace_id": trace_id}
+        ).encode()
+        s.sendall(_LEN.pack(len(body)) + body)
+        head = b""
+        while len(head) < _LEN.size:
+            chunk = s.recv(_LEN.size - len(head))
+            if not chunk:
+                raise ConnectionError("daemon closed without a reply")
+            head += chunk
+        (n,) = _LEN.unpack(head)
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("truncated reply")
+            buf += chunk
+    finally:
+        s.close()
+    reply = json.loads(buf.decode())
+    if not reply.get("ok"):
+        raise SystemExit(
+            f"daemon: {reply.get('error', 'unknown error')}"
+        )
+    return reply["exemplar"]
+
+
+def load_exemplar(
+    trace_id: str,
+    exemplar_dir: Optional[str] = None,
+    file: Optional[str] = None,
+    socket_path: Optional[str] = None,
+    port: Optional[int] = None,
+) -> dict:
+    if file is not None:
+        with open(file) as f:
+            doc = json.load(f)
+        if "summary" in doc:
+            return doc
+        for ex in doc.get("exemplars", []):
+            if ex.get("summary", ex).get("trace_id") == trace_id:
+                return ex if "summary" in ex else {"summary": ex,
+                                                  "events": [],
+                                                  "incomplete": False}
+        raise SystemExit(f"no exemplar for {trace_id!r} in {file}")
+    if exemplar_dir is not None:
+        path = os.path.join(exemplar_dir, f"{trace_id}.json")
+        if not os.path.exists(path):
+            # Prefix match: operators paste truncated ids.
+            hits = [
+                fn for fn in sorted(os.listdir(exemplar_dir))
+                if fn.startswith(trace_id) and fn.endswith(".json")
+            ]
+            if len(hits) == 1:
+                path = os.path.join(exemplar_dir, hits[0])
+            elif hits:
+                raise SystemExit(
+                    f"ambiguous trace id prefix {trace_id!r}: "
+                    + ", ".join(h[:-5] for h in hits)
+                )
+            else:
+                raise SystemExit(
+                    f"no exemplar {trace_id}.json under {exemplar_dir}"
+                )
+        with open(path) as f:
+            return json.load(f)
+    if socket_path is not None or port is not None:
+        return _fetch_daemon(trace_id, socket_path, port)
+    raise SystemExit(
+        "one of --exemplar-dir / --file / --socket / --port is required"
+    )
+
+
+def waterfall(exemplar: dict) -> dict:
+    """Reduce an exemplar to the rendered report: ordered hops with
+    shares, the dominant hop, the unattributed remainder, and the
+    completeness verdict."""
+    s = exemplar["summary"]
+    total = float(s.get("duration_ms", 0.0))
+    hops: List[dict] = []
+    attributed = 0.0
+    for h in s.get("hops", []):
+        ms = float(h.get("ms", 0.0))
+        attributed += ms
+        extras = {
+            k: v for k, v in h.items()
+            if k not in ("hop", "t_ms", "ms")
+        }
+        hops.append({
+            "hop": h["hop"],
+            "label": HOP_LABELS.get(h["hop"], h["hop"]),
+            "t_ms": round(float(h.get("t_ms", 0.0)), 3),
+            "ms": round(ms, 3),
+            "share": round(ms / total, 4) if total > 0 else 0.0,
+            "extras": extras,
+        })
+    hops.sort(key=lambda h: h["t_ms"])
+    timed = [h for h in hops if h["ms"] > 0]
+    dominant = max(timed, key=lambda h: h["ms"]) if timed else None
+    unattributed = max(0.0, total - attributed)
+    incomplete = bool(exemplar.get("incomplete")) or bool(
+        s.get("hops_dropped")
+    )
+    return {
+        "trace_id": s.get("trace_id"),
+        "op": s.get("op"),
+        "outcome": s.get("outcome"),
+        "trigger": s.get("trigger"),
+        "duration_ms": round(total, 3),
+        "hops": hops,
+        "dominant": (
+            {"hop": dominant["hop"], "label": dominant["label"],
+             "ms": dominant["ms"], "share": dominant["share"]}
+            if dominant else None
+        ),
+        "attributed_ms": round(attributed, 3),
+        "unattributed_ms": round(unattributed, 3),
+        "incomplete": incomplete,
+        "n_events": len(exemplar.get("events", [])),
+        "dropped_by_category": exemplar.get("dropped_by_category", {}),
+        "tier_decisions": s.get("tier_decisions", []),
+    }
+
+
+def format_waterfall(rep: dict, width: int = 40) -> str:
+    lines = []
+    if rep["incomplete"]:
+        lines.append(
+            "*** INCOMPLETE: ring overflow dropped events in this "
+            "request's categories — the waterfall below is partial ***"
+        )
+    head = (
+        f"trace {rep['trace_id']}  op={rep['op']}  "
+        f"outcome={rep['outcome']}  total={rep['duration_ms']:.1f} ms"
+    )
+    if rep.get("trigger"):
+        head += f"  (sampled: {rep['trigger']})"
+    lines.append(head)
+    lines.append("")
+    total = rep["duration_ms"] or 1.0
+    for h in rep["hops"]:
+        bar = "#" * max(
+            1 if h["ms"] > 0 else 0, int(width * h["ms"] / total)
+        )
+        mark = ""
+        if rep["dominant"] and h["hop"] == rep["dominant"]["hop"] and (
+            h["ms"] == rep["dominant"]["ms"]
+        ):
+            mark = "  <- dominant"
+        extras = ""
+        if h["extras"]:
+            extras = "  " + ", ".join(
+                f"{k}={v}" for k, v in sorted(h["extras"].items())
+            )
+        ms = f"{h['ms']:>9.2f} ms" if h["ms"] else "   (event)  "
+        lines.append(
+            f"  +{h['t_ms']:>8.2f}  {h['label']:<36} {ms} "
+            f"{h['share']:>6.1%}  {bar}{mark}{extras}"
+        )
+    lines.append(
+        f"  {'':10}{'unattributed':<36} "
+        f"{rep['unattributed_ms']:>9.2f} ms "
+        f"{(rep['unattributed_ms'] / total):>6.1%}"
+    )
+    if rep["dominant"]:
+        lines.append("")
+        lines.append(
+            f"dominant hop: {rep['dominant']['label']} "
+            f"({rep['dominant']['hop']}) — {rep['dominant']['ms']:.2f} ms, "
+            f"{rep['dominant']['share']:.1%} of the request"
+        )
+    if rep["tier_decisions"]:
+        lines.append(
+            "tier decisions: " + ", ".join(rep["tier_decisions"])
+        )
+    lines.append(
+        f"ring events for this trace: {rep['n_events']}"
+        + (
+            f"; dropped by category: {rep['dropped_by_category']}"
+            if rep["dropped_by_category"]
+            else ""
+        )
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a served request's waterfall from its "
+        "trace id (tail-latency exemplars)"
+    )
+    ap.add_argument("trace_id", help="the request's trace id (or a "
+                    "unique prefix, with --exemplar-dir)")
+    ap.add_argument("--exemplar-dir", default=None,
+                    help="the daemon's --exemplar-dir spill directory")
+    ap.add_argument("--file", default=None,
+                    help="one exemplar JSON file (or an `exemplars` "
+                    "op reply)")
+    ap.add_argument("--socket", default=None,
+                    help="ask a live daemon over its UDS socket")
+    ap.add_argument("--port", type=int, default=None,
+                    help="ask a live daemon on 127.0.0.1:PORT")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the reduced report as JSON")
+    args = ap.parse_args(argv)
+    ex = load_exemplar(
+        args.trace_id,
+        exemplar_dir=args.exemplar_dir,
+        file=args.file,
+        socket_path=args.socket,
+        port=args.port,
+    )
+    rep = waterfall(ex)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print(format_waterfall(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
