@@ -116,7 +116,7 @@ def synth_bam(path: str, n: int, paired: bool = False) -> None:
 
 def run_sort(
     src: str, out: str, backend: str, device_parse=None,
-    mark_duplicates=False,
+    mark_duplicates=False, conf=None,
 ) -> float:
     """Returns wall seconds for a full sort with the given backend (the
     product pipeline end to end: plan → read → sort → parts → merge)."""
@@ -126,6 +126,7 @@ def run_sort(
     sort_bam(
         [src], out, split_size=SPLIT_SIZE, level=1, backend=backend,
         device_parse=device_parse, mark_duplicates=mark_duplicates,
+        conf=conf,
     )
     return time.time() - t0
 
@@ -190,6 +191,68 @@ def _measure(platform: str) -> dict:
         "sort_hbm_peak_bytes": hbm_peak,
         "hbm_bytes_per_read": round(hbm_peak / N_RECORDS, 3),
     }
+    # Pipelined-execution instrument (the DeviceStream claim, measured
+    # not asserted): one traced device-backend sort, reduced by
+    # tools/trace_report.py to the pipeline overlap fraction (how much
+    # of the stage-covered wall had ≥2 stages live — a serialized
+    # pipeline scores ~0, a double-buffered one approaches 1) and the
+    # bytes-weighted fraction of h2d uploads whose dispatch overlapped a
+    # running stage.  Stamped with the same round provenance as the
+    # headline; a degraded round never updates a headline (BENCH_NOTES).
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "hbam_trace_report",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "trace_report.py",
+            ),
+        )
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+        from hadoop_bam_tpu.utils.tracing import TRACER
+
+        # On a TPU platform the traced run arms the pipelined device
+        # path via the auto-rtt relaxation (HBAM_BENCH_AUTO_RTT ms,
+        # default 100 — wide enough for the dev tunnel) and a deeper
+        # read-ahead, so the built device tiers are finally *measured*
+        # end to end instead of auto-declined; the headline timing runs
+        # above are untouched.  CPU rounds trace the plain pipeline.
+        trace_conf = None
+        if platform == "tpu":
+            from hadoop_bam_tpu.conf import (
+                Configuration,
+                DEVICE_AUTO_RTT_MS,
+                READ_DEPTH,
+            )
+
+            trace_conf = Configuration(
+                {
+                    DEVICE_AUTO_RTT_MS: os.environ.get(
+                        "HBAM_BENCH_AUTO_RTT", "100"
+                    ),
+                    READ_DEPTH: "4",
+                }
+            )
+        TRACER.start(capacity=1 << 18)
+        try:
+            run_sort(src, out_d, "device", conf=trace_conf)
+            trace_path = os.path.join(tmp, "pipeline_trace.json")
+            TRACER.export_chrome(trace_path)
+        finally:
+            TRACER.stop()
+        all_events, _meta = tr.load_trace(trace_path)
+        x_events = [e for e in all_events if e.get("ph") == "X"]
+        rep = tr.stage_report(x_events)
+        xfer = tr.transfer_report(all_events)
+        if rep is not None:
+            out["sort_pipeline_overlap"] = round(rep["overlap_frac"], 3)
+            out["sort_top_stall"] = rep["top_stall"]["stage"]
+        if xfer is not None:
+            out["sort_h2d_hidden_pct"] = round(xfer["hidden_pct"], 3)
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["pipeline_trace_error"] = str(e)[:120]
     # Run provenance for the headline number: backend/platform actually
     # used, every device-tier decision counter with its reason, and the
     # fault/salvage mode — so a round JSON can be audited for silent

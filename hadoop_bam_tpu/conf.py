@@ -70,6 +70,26 @@ DEFLATE_LANES = "hadoopbam.deflate.lanes"
 # local-latency auto rule (ops.flate.device_write_enabled); parts whose
 # batch lacks residency tier down to the host gather per part.
 WRITE_DEVICE = "hadoopbam.write.device"
+# Split-read pipelining depth (pipeline._read_splits_pipelined /
+# DeviceStream.read_splits): how many splits are in flight at once in the
+# read-ahead pool — split k+1's file read + inflate (h2d upload + device
+# kernels when the lanes tier is on) overlap split k's downstream
+# processing.  Resolution order: explicit depth argument → this key → the
+# HBAM_READ_DEPTH env var → 2.  The chosen depth is surfaced in the run
+# manifest (modes.read_depth) so a round's overlap numbers carry their
+# pipelining provenance.
+READ_DEPTH = "hadoopbam.read.depth"
+# The local-latency auto rule's RTT gate (milliseconds, default 5.0):
+# every device tier (inflate/deflate lanes, device write, device parse)
+# auto-declines when the host↔device round trip exceeds this.  A ≥2-deep
+# DeviceStream pipeline keeps that many launches in flight, hiding
+# per-launch RTT behind the other splits' compute, so the stream relaxes
+# the effective gate to depth × this value (the pipelined-mode
+# relaxation); setting the key higher lets a tunneled dev topology
+# (~70 ms RTT) measure the built device path end-to-end instead of
+# auto-declining every tier.  The default is unchanged from the
+# pre-DeviceStream rule.
+DEVICE_AUTO_RTT_MS = "hadoopbam.device.auto-rtt-ms"
 # Resident service mode (serve/): a long-lived daemon owning the TPU,
 # reached over a localhost/UDS socket with length-prefixed JSON framing.
 # Either the UDS socket path or a 127.0.0.1 TCP port selects the

@@ -163,8 +163,6 @@ class ChunkedRecords:
             # Built eagerly so concurrent part writers never race a lazy
             # concat.
             try:
-                import jax.numpy as jnp
-
                 parts = [b.device_data for b in batches]
                 if len(parts) == 1:
                     # Ownership handoff, no copy: the split window IS the
@@ -176,8 +174,14 @@ class ChunkedRecords:
                     # Device-to-device concat adopts the donors: their
                     # per-split windows close cleanly in the ledger and
                     # the flat stream carries the residency forward.
+                    # The concat *donates* the windows (the DeviceStream
+                    # windows→write-stream seam), so on donation-capable
+                    # backends HBM holds the windows or the flat stream
+                    # — never both — during the write-phase setup.
+                    from ..device_stream import donating_concat
+
                     device_flat = LEDGER.adopt(
-                        jnp.concatenate(parts),
+                        donating_concat(parts),
                         kind="write_stream",
                         holder="bam.write_flat",
                         donors=parts,
@@ -487,6 +491,7 @@ class BamInputFormat:
         device_inflate: Optional[bool] = None,
         inflate_fn=None,
         errors: Optional[str] = None,
+        stream=None,
     ) -> RecordBatch:
         """Inflate the split's blocks and decode all its records as one batch.
 
@@ -509,9 +514,19 @@ class BamInputFormat:
         the policy on corrupt input: 'strict' raises (pre-PR-7 behavior),
         'salvage' quarantines corrupt BGZF members and unparseable
         records, re-syncs the record chain, and returns what survived
-        (``salvage.*`` counters account for the losses)."""
+        (``salvage.*`` counters account for the losses).
+
+        ``stream`` (a :class:`~hadoop_bam_tpu.device_stream.DeviceStream`)
+        makes this read a stream client: the member inflate rides the
+        stream's resolved tier policy (one gate decision per job, with
+        the pipelined auto-rtt relaxation) and the residency handoff goes
+        through the stream's ledger seam."""
         if device_inflate is None:
-            device_inflate = self._device_inflate()
+            device_inflate = (
+                stream.policy.inflate_lanes
+                if stream is not None
+                else self._device_inflate()
+            )
         if errors is None:
             errors = self.errors_mode()
         n_refs = self._nrefs(split.path) if errors == "salvage" else None
@@ -528,6 +543,7 @@ class BamInputFormat:
                 inflate_fn=inflate_fn,
                 errors=errors,
                 n_refs=n_refs,
+                stream=stream,
             )
         sfs = fs.get_fs(split.path)
         size = sfs.size(split.path)
@@ -562,6 +578,7 @@ class BamInputFormat:
                     errors=errors,
                     n_refs=n_refs,
                     window_at_eof=at_eof,
+                    stream=stream,
                 )
             except (bam.BamError, bgzf.BgzfError):
                 if at_eof:
@@ -620,6 +637,7 @@ def read_virtual_range(
     errors: str = "strict",
     n_refs: Optional[int] = None,
     window_at_eof: bool = True,
+    stream=None,
 ) -> RecordBatch:
     """Decode all records whose start voffset lies in ``[vstart, vend)``.
 
@@ -678,6 +696,7 @@ def read_virtual_range(
                 fields=fields,
                 device_inflate=device_inflate,
                 inflate_fn=inflate_fn,
+                stream=stream,
             )
         except (bgzf.BgzfError, bam.BamError):
             METRICS.count("salvage.strict_fallbacks", 1)
@@ -726,6 +745,21 @@ def read_virtual_range(
                 np.asarray(cs, dtype=np.int32),
                 np.asarray(us, dtype=np.int32),
             )
+        if stream is not None and device_inflate:
+            # Stream client: the decode rides the DeviceStream's tier
+            # seam (policy + OOM accounting + host tier-down in one
+            # place — the same seam the serve lane batcher uses).
+            out, offs, dev = stream.decode_members(
+                data,
+                co,
+                cs,
+                us,
+                return_device=True,
+                threads=threads,
+                on_error="host",
+            )
+            dev_cell[0] = dev
+            return out, offs
         if device_inflate:
             from ..ops import flate
 
@@ -882,7 +916,11 @@ def read_virtual_range(
     device_data = None
     if dev_cell[0] is not None:
         if plen == len(out):
-            device_data = LEDGER.transfer(dev_cell[0], "bam.split_window")
+            device_data = (
+                stream.attach_window(dev_cell[0])
+                if stream is not None
+                else LEDGER.transfer(dev_cell[0], "bam.split_window")
+            )
         else:
             LEDGER.release(dev_cell[0])
     return RecordBatch(
@@ -1311,80 +1349,25 @@ def _write_part_device(
     dup_mask: Optional[np.ndarray],
     level: int,
     conf: Optional[Configuration],
+    stream=None,
 ) -> Optional[bytes]:
-    """The device-resident part assembly: sorted gather + markdup flag
-    patch on chip (``ops.pallas.gather_stream``), per-member CRC32 on
-    chip (``ops.pallas.crc32``), deflate lanes fed device-to-device —
-    the only d2h traffic is the compressed part blob (+ CRC column).
+    """The device-resident part assembly, now owned by the DeviceStream
+    (:meth:`~hadoop_bam_tpu.device_stream.DeviceStream.encode_part`):
+    sorted gather + markdup flag patch + per-member CRC32 on chip,
+    deflate lanes fed device-to-device with the gathered column donated
+    into the CRC launch — the only d2h traffic is the compressed part
+    blob (+ CRC column).  This wrapper keeps the write path's historic
+    seam: callers without a stream get an ephemeral one (the gates
+    resolve from env/conf/cached-RTT, so construction is cheap), and
+    every tier-down reason (``bam.device_write_tierdown.*`` /
+    ``bam.device_write_fallback``) is recorded exactly as before —
+    LEDGER registration of the gather column included."""
+    if stream is None:
+        from ..device_stream import DeviceStream
 
-    Returns the part blob (always lanes-blocked at ``DEV_LZ_PAYLOAD``),
-    or ``None`` to tier down to the host gather path; every tier-down
-    records its reason (``bam.device_write_tierdown.{no_residency,size}``
-    / ``bam.device_write_fallback``) so a silently-dead path shows up in
-    the round artifacts."""
-    from ..ops import flate as _flate
-
-    if isinstance(batch, ChunkedRecords):
-        if batch.device_flat is None:
-            METRICS.count("bam.device_write_tierdown.no_residency", 1)
-            return None
-        stream_dev = batch.device_flat
-        base = batch.chunk_base[
-            np.asarray(batch.chunk_id, dtype=np.int64)
-        ]
-        src = base + np.asarray(batch.soa["rec_off"], np.int64) - 4
-    else:
-        if getattr(batch, "device_data", None) is None:
-            METRICS.count("bam.device_write_tierdown.no_residency", 1)
-            return None
-        stream_dev = batch.device_data
-        src = np.asarray(batch.soa["rec_off"], np.int64) - 4
-    lens = np.asarray(batch.soa["rec_len"], np.int64) + 4
-    if order is not None:
-        src = src[order]
-        lens = lens[order]
-    if len(src) == 0:
-        return None  # empty part: the host path writes its canonical form
-    dm = None
-    if dup_mask is not None:
-        dm = dup_mask[order] if order is not None else dup_mask
-        if not dm.any():
-            dm = None
-    gathered = None
-    try:
-        from ..ops.pallas.gather_stream import gather_stream_device
-
-        gathered, _ = gather_stream_device(
-            stream_dev, src, lens, dup_mask=dm
-        )
-        # The permuted gather column is a second resident stream for the
-        # duration of the deflate — ledgered so the HBM track shows the
-        # write-phase bump and a dropped release would be named.
-        LEDGER.register(
-            gathered, kind="write_gather", holder="bam.device_write"
-        )
-        blob = _flate.deflate_blocks_device(
-            None,
-            level=level,
-            block_payload=_flate.DEV_LZ_PAYLOAD,
-            use_lanes=True,
-            conf=conf,
-            device_input=gathered,
-        )
-    except ValueError:
-        METRICS.count("bam.device_write_tierdown.size", 1)
-        return None
-    except Exception:
-        # Never fatal to a write — the host gather path is bit-correct.
-        METRICS.count("bam.device_write_fallback", 1)
-        return None
-    finally:
-        if gathered is not None:
-            LEDGER.release(gathered)
-    if dm is not None:
-        METRICS.count("bam.duplicate_flags_patched", int(dm.sum()))
-    METRICS.count("bam.device_write_parts", 1)
-    return blob
+        stream = DeviceStream(conf=conf)
+    return stream.encode_part(batch, order=order, dup_mask=dup_mask,
+                              level=level)
 
 
 def write_part_fast(
@@ -1399,6 +1382,7 @@ def write_part_fast(
     conf: Optional[Configuration] = None,
     dup_mask: Optional[np.ndarray] = None,
     device_write: Optional[bool] = None,
+    device_stream=None,
 ) -> int:
     """Write a headerless, terminator-less part from a batch in one shot:
     vectorized record gather + batched deflate.  Per-record virtual
@@ -1433,15 +1417,20 @@ def write_part_fast(
     subsystem's flag-rewrite stage, applied to the gathered stream just
     before deflate."""
     if device_write is None:
-        from ..ops.flate import device_write_enabled
+        if device_stream is not None:
+            device_write = device_stream.policy.device_write
+        else:
+            from ..ops.flate import device_write_enabled
 
-        device_write = device_write_enabled(conf)
+            device_write = device_write_enabled(conf)
     blob = None
     block_payload = bgzf.MAX_PAYLOAD
     if device_write:
         from ..ops import flate as _flate
 
-        blob = _write_part_device(batch, order, dup_mask, level, conf)
+        blob = _write_part_device(
+            batch, order, dup_mask, level, conf, stream=device_stream
+        )
         if blob is not None:
             block_payload = _flate.DEV_LZ_PAYLOAD
     if blob is None:
@@ -1459,9 +1448,12 @@ def write_part_fast(
                     "bam.duplicate_flags_patched", int(dm.sum())
                 )
         if device_deflate is None:
-            from ..ops.flate import deflate_lanes_tier_enabled
+            if device_stream is not None:
+                device_deflate = device_stream.policy.deflate_lanes
+            else:
+                from ..ops.flate import deflate_lanes_tier_enabled
 
-            device_deflate = deflate_lanes_tier_enabled(conf)
+                device_deflate = deflate_lanes_tier_enabled(conf)
         # Explicit block size: the analytic voffset math below depends
         # on it.
         if device_deflate:

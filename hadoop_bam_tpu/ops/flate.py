@@ -1111,16 +1111,41 @@ def deflate_lanes_accepts(max_plen: int) -> Tuple[bool, str]:
     return accepts(max_plen)
 
 
-def lanes_tier_enabled(conf=None) -> bool:
+def device_auto_rtt_ms(conf=None) -> float:
+    """The local-latency auto rule's RTT gate, in milliseconds.
+
+    The ``hadoopbam.device.auto-rtt-ms`` conf key overrides the historic
+    5 ms default — one number for every device tier, so a topology whose
+    RTT is hidden by pipelining (or simply accepted) flips the whole
+    device pipeline with one key instead of four env forces.  A
+    malformed value keeps the default."""
+    from ..conf import DEVICE_AUTO_RTT_MS
+
+    if conf is not None and DEVICE_AUTO_RTT_MS in conf:
+        try:
+            v = float(conf.get(DEVICE_AUTO_RTT_MS))
+            if v > 0:
+                return v
+        except (TypeError, ValueError):
+            pass
+    return 5.0
+
+
+def lanes_tier_enabled(conf=None, max_rtt_ms: Optional[float] = None) -> bool:
     """Should BGZF inflate route through the lockstep-lane Pallas tier?
 
     Resolution order: ``HBAM_INFLATE_LANES`` env var (0/1 force) →
     ``hadoopbam.inflate.lanes`` conf key → the local-latency auto rule
     (same stance as ``pipeline._default_device_parse``): on only for a
-    real TPU whose host↔device round trip is local-class (< 5 ms).  On a
-    CPU backend the kernel runs in (slow) interpret mode, and on a
-    tunneled remote chip the per-batch transfers pay latency the native
-    host codec does not — both lose, so the auto rule declines.
+    real TPU whose host↔device round trip is local-class (under
+    :func:`device_auto_rtt_ms`, historically 5 ms).  On a CPU backend
+    the kernel runs in (slow) interpret mode, and on a tunneled remote
+    chip the per-batch transfers pay latency the native host codec does
+    not — both lose, so the auto rule declines.  ``max_rtt_ms``
+    overrides the gate threshold — the DeviceStream's pipelined-mode
+    relaxation passes ``depth × device_auto_rtt_ms`` here, because a
+    ≥2-deep pipeline hides that much per-launch RTT behind the other
+    splits' compute.
     """
     env = os.environ.get("HBAM_INFLATE_LANES")
     if env is not None:
@@ -1132,20 +1157,25 @@ def lanes_tier_enabled(conf=None) -> bool:
             return conf.get_boolean(INFLATE_LANES)
     from ..utils.backend import local_tpu_ready
 
-    return local_tpu_ready()
+    return local_tpu_ready(
+        max_rtt_ms if max_rtt_ms is not None else device_auto_rtt_ms(conf)
+    )
 
 
-def deflate_lanes_tier_enabled(conf=None) -> bool:
+def deflate_lanes_tier_enabled(
+    conf=None, max_rtt_ms: Optional[float] = None
+) -> bool:
     """Should BGZF deflate route through the lockstep-lane LZ77 encoder?
 
     The write-side mirror of :func:`lanes_tier_enabled`: resolution order
     is the ``HBAM_DEFLATE_LANES`` env var (0/1 force) → the
     ``hadoopbam.deflate.lanes`` conf key → the shared local-latency auto
-    rule (``utils.backend.local_tpu_ready``: a real TPU with a < 5 ms
-    round trip).  On a CPU backend the match kernel runs in (slow)
-    interpret mode and on a tunneled remote chip the per-part transfers
-    pay latency the threaded native zlib does not — both lose, so the
-    auto rule declines.
+    rule (``utils.backend.local_tpu_ready`` under
+    :func:`device_auto_rtt_ms`, with the same pipelined-mode
+    ``max_rtt_ms`` relaxation as :func:`lanes_tier_enabled`).  On a CPU
+    backend the match kernel runs in (slow) interpret mode and on a
+    tunneled remote chip the per-part transfers pay latency the threaded
+    native zlib does not — both lose, so the auto rule declines.
     """
     env = os.environ.get("HBAM_DEFLATE_LANES")
     if env is not None:
@@ -1157,10 +1187,14 @@ def deflate_lanes_tier_enabled(conf=None) -> bool:
             return conf.get_boolean(DEFLATE_LANES)
     from ..utils.backend import local_tpu_ready
 
-    return local_tpu_ready()
+    return local_tpu_ready(
+        max_rtt_ms if max_rtt_ms is not None else device_auto_rtt_ms(conf)
+    )
 
 
-def device_write_enabled(conf=None) -> bool:
+def device_write_enabled(
+    conf=None, max_rtt_ms: Optional[float] = None
+) -> bool:
     """Should part writes assemble on device — the sorted record gather,
     markdup flag patch and per-member CRC32 running over the HBM-resident
     split payloads, feeding the deflate lanes device-to-device so only
@@ -1184,7 +1218,9 @@ def device_write_enabled(conf=None) -> bool:
             return conf.get_boolean(WRITE_DEVICE)
     from ..utils.backend import local_tpu_ready
 
-    return local_tpu_ready()
+    return local_tpu_ready(
+        max_rtt_ms if max_rtt_ms is not None else device_auto_rtt_ms(conf)
+    )
 
 
 def _lanes_decode_members(
@@ -1452,6 +1488,7 @@ def bgzf_compress_device(
     conf=None,
     use_lanes: Optional[bool] = None,
     device_input=None,
+    donate_input: bool = False,
 ) -> bytes:
     """Compress a byte stream into BGZF using the device deflate tiers.
 
@@ -1484,6 +1521,16 @@ def bgzf_compress_device(
     tier-down members' payloads come back d2h (ledgered under
     ``transfers.d2h.*``).  Output is byte-identical to the host-input
     path on the same bytes.
+
+    ``donate_input`` marks the caller done with ``device_input`` after
+    this call: the on-chip CRC launch — the stream's *final* reader in
+    this function's ordering (deflate rows → per-member tier-downs →
+    CRC) — donates the buffer (the CRC kernel's ``donate=True``), so on
+    donation-capable backends the gathered part stream's HBM is
+    reusable the moment the CRC dispatches instead of surviving until
+    the caller's release.  This is the DeviceStream's gather→deflate
+    donation seam; backends without donation run identically minus the
+    aliasing.
 
     Per-call tier accounting lands in :data:`LAST_DEFLATE_STATS` (and the
     ``flate.deflate.*`` METRICS counters): members per tier plus the
@@ -1647,7 +1694,13 @@ def bgzf_compress_device(
             device_input,
             np.arange(nblk, dtype=np.int64) * block_payload,
             lens.astype(np.int64),
+            donate=donate_input,
         )
+        if donate_input:
+            from ..utils.backend import donation_supported
+
+            if donation_supported():
+                METRICS.count("flate.deflate.input_donated", 1)
         # The on-chip CRC column is ledgered for its (short) residency:
         # registered, fetched, released — device bytes accounted even
         # when the lifetime is one statement.
@@ -1700,6 +1753,7 @@ def deflate_blocks_device(
     conf=None,
     use_lanes: Optional[bool] = None,
     device_input=None,
+    donate_input: bool = False,
 ) -> bytes:
     """Device-tier drop-in for :func:`native.deflate_blocks` (no
     terminator appended): the part-write surface of the lockstep-lane
@@ -1719,6 +1773,7 @@ def deflate_blocks_device(
         conf=conf,
         use_lanes=use_lanes,
         device_input=device_input,
+        donate_input=donate_input,
     )
 
 

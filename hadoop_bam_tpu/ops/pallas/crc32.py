@@ -67,8 +67,7 @@ def _pow2_at_least(n: int, lo: int) -> int:
     return v
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _crc32_kernel(
+def _crc32_core(
     stream: jax.Array, offs: jax.Array, lens: jax.Array, max_words: int
 ) -> jax.Array:
     """CRC32 of ``stream[offs[i] : offs[i]+lens[i]]`` for every member i,
@@ -116,7 +115,20 @@ def _crc32_kernel(
     return crc ^ jnp.uint32(0xFFFFFFFF)
 
 
-def crc32_device(stream, offs, lens) -> jax.Array:
+_crc32_kernel = functools.partial(jax.jit, static_argnums=(3,))(_crc32_core)
+#: The donating twin: the stream argument's buffer is donated to the
+#: launch, so the CRC column's allocation may reuse the gathered part
+#: stream's HBM — the CRC is the stream's *last* reader on the
+#: device-resident write path (``ops.flate.bgzf_compress_device`` orders
+#: deflate → tier-downs → CRC), which makes this the gather→deflate
+#: seam's buffer-donation point: after the CRC dispatch the part's
+#: uncompressed bytes hold no HBM the consumer can't reuse.
+_crc32_kernel_donating = functools.partial(
+    jax.jit, static_argnums=(3,), donate_argnums=(0,)
+)(_crc32_core)
+
+
+def crc32_device(stream, offs, lens, donate: bool = False) -> jax.Array:
     """Per-member CRC32 over a device-resident byte stream.
 
     ``stream``: uint8 device array (or anything ``jnp.asarray`` accepts);
@@ -126,7 +138,12 @@ def crc32_device(stream, offs, lens) -> jax.Array:
 
     Launch shapes are pow2-bucketed on both the member count and the word
     loop so distinct jit signatures stay few (the shared-geometry stance
-    of the codec kernels)."""
+    of the codec kernels).
+
+    ``donate=True`` donates the stream buffer to the launch (the caller
+    must be the stream's final reader): requested only when the backend
+    supports donation (``utils.backend.donation_supported``), silently a
+    plain launch otherwise."""
     offs = np.asarray(offs, dtype=np.int64)
     lens = np.asarray(lens, dtype=np.int64)
     n = len(offs)
@@ -144,7 +161,12 @@ def crc32_device(stream, offs, lens) -> jax.Array:
     offs_p[:n] = offs
     lens_p[:n] = lens
     max_words = _pow2_at_least(max(int(lens.max()) >> 2, 1), 64)
-    out = _crc32_kernel(
+    if donate:
+        from ...utils.backend import donation_supported
+
+        donate = donation_supported()
+    kernel = _crc32_kernel_donating if donate else _crc32_kernel
+    out = kernel(
         jnp.asarray(stream), jnp.asarray(offs_p), jnp.asarray(lens_p),
         max_words,
     )

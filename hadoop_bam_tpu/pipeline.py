@@ -90,13 +90,13 @@ def _release_split_residency(b: RecordBatch) -> None:
     ledger and drop the reference.  Every path that is done with a
     split's ``device_data`` — the unused-handoff case, the post-parse
     drop, the post-adopt cleanup, the out-of-core spill loop — comes
-    through here, so a skipped release shows up as a *named*
-    ``hbm.leaked.<holder>`` counter instead of a silent HBM pin (the
-    PR 5 bug class; the leak drill monkeypatches exactly this helper)."""
-    dd = getattr(b, "device_data", None)
-    if dd is not None:
-        LEDGER.release(dd)
-    b.device_data = None
+    through here (delegating to the DeviceStream's shared release seam),
+    so a skipped release shows up as a *named* ``hbm.leaked.<holder>``
+    counter instead of a silent HBM pin (the PR 5 bug class; the leak
+    drill monkeypatches exactly this helper)."""
+    from .device_stream import DeviceStream
+
+    DeviceStream.release_batch(b)
 
 
 def _concat_batches(batches: List[RecordBatch]) -> RecordBatch:
@@ -301,6 +301,12 @@ def sort_bam(
     # The header claims the order actually written (satellite fix: this
     # used to stamp "coordinate" unconditionally on every write path).
     header = header.with_sort_order(sort_order)
+    # The job's DeviceStream: tier policy (with the pipelined auto-rtt
+    # relaxation), residency seam, deadline checks and the double-
+    # buffered split drive, resolved once here instead of per call site.
+    from .device_stream import DeviceStream
+
+    stream = DeviceStream(conf=conf, deadline=deadline)
     if memory_budget is not None:
         if mesh is not None or distributed is not None:
             raise ValueError(
@@ -320,10 +326,6 @@ def sort_bam(
         # split still overshoots).
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
         splits = fmt.get_splits(in_paths, split_size=split_size)
-        from .ops.flate import (
-            deflate_lanes_tier_enabled,
-            device_write_enabled,
-        )
 
         key_column = None
         if queryname:
@@ -333,7 +335,9 @@ def sort_bam(
             # out-of-core markdup), and the resulting read-order rank
             # becomes the external sort's key column — unique int64s,
             # so spill runs and exact range planning work unchanged.
-            key_column = _queryname_rank_column(fmt, splits, errors)
+            key_column = _queryname_rank_column(
+                fmt, splits, errors, stream=stream
+            )
         return _sort_bam_external(
             fmt,
             splits,
@@ -346,15 +350,16 @@ def sort_bam(
             max_attempts=max_attempts,
             part_dir=part_dir,
             write_workers=write_workers,
-            device_deflate=deflate_lanes_tier_enabled(conf),
+            device_deflate=stream.policy.deflate_lanes,
             mark_duplicates=mark_duplicates,
-            device_write=device_write_enabled(conf),
+            device_write=stream.policy.device_write,
             errors=errors,
             attempt_timeout=exec_timeout,
             retry_backoff=exec_backoff,
             sort_order=sort_order,
             key_column=key_column,
             deadline=deadline,
+            stream=stream,
         )
     with span("sort_bam.plan"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
@@ -379,19 +384,17 @@ def sort_bam(
         and (
             device_parse
             if device_parse is not None
-            else _default_device_parse()
+            else stream.default_device_parse()
         )
     )
     # Device-resident part writes: the sorted gather + flag patch + CRC32
     # feed the deflate lanes straight from the HBM-resident split
     # payloads, so the write side d2h's only compressed bytes.  Resolved
-    # once per job (``hadoopbam.write.device`` / HBAM_DEVICE_WRITE / the
-    # local-latency auto rule) independently of the sort backend — it is
-    # a codec-tier concern like the deflate lanes; split residency is
-    # kept through the sort when on.
-    from .ops.flate import device_write_enabled
-
-    use_device_write = device_write_enabled(conf)
+    # once per job on the stream's policy (``hadoopbam.write.device`` /
+    # HBAM_DEVICE_WRITE / the pipelined-relaxed local-latency auto rule)
+    # independently of the sort backend — it is a codec-tier concern like
+    # the deflate lanes; split residency is kept through the sort when on.
+    use_device_write = stream.policy.device_write
     batches: List[RecordBatch] = []
     parsed: List[Optional[tuple]] = []  # per batch: (hi, lo, unm, meta)
     dev_hi: List = []
@@ -440,7 +443,7 @@ def sort_bam(
         )
     with span("sort_bam.read"), _request_hop("pipeline.read"):
         for si, b in enumerate(
-            _read_splits_pipelined(
+            stream.read_splits(
                 fmt,
                 splits,
                 fields=read_fields,
@@ -484,7 +487,11 @@ def sort_bam(
                     with trace_ctx(split=si), span(
                         "pipeline.stage.device_parse", category="stage"
                     ):
-                        parsed.append(_device_parse_split(b))
+                        parsed.append(
+                            stream.parse_split(
+                                b, keep_residency=use_device_write
+                            )
+                        )
                 except Exception:
                     # Device OOM / compile failure / tunnel error: record
                     # the failure and let the sort fall back to host keys.
@@ -600,13 +607,12 @@ def sort_bam(
     # part writes gather straight from the split payloads (no global
     # concatenation; on a 1-core host that copy dominated the pipeline).
     from .io.bam import write_part_fast
-    from .ops.flate import deflate_lanes_tier_enabled
 
-    # Part-write deflate tier, resolved once per job: the lockstep-lane
-    # Pallas encoder (LZ77 on chip, host does framing + CRC32) behind the
-    # ``hadoopbam.deflate.lanes`` conf key / ``HBAM_DEFLATE_LANES`` env /
-    # the same local-latency auto rule as the inflate tier.
-    use_device_deflate = deflate_lanes_tier_enabled(conf)
+    # Part-write deflate tier from the stream's policy, resolved once per
+    # job: the lockstep-lane Pallas encoder (LZ77 on chip, host does
+    # framing + CRC32) behind the ``hadoopbam.deflate.lanes`` conf key /
+    # ``HBAM_DEFLATE_LANES`` env / the pipelined-relaxed auto rule.
+    use_device_deflate = stream.policy.deflate_lanes
     merged = ChunkedRecords.from_batches(
         batches, with_keys=False, keep_device=use_device_write
     )
@@ -668,6 +674,7 @@ def sort_bam(
                         device_deflate=use_device_deflate,
                         dup_mask=dup_mask,
                         device_write=use_device_write,
+                        device_stream=stream,
                     )
             finally:
                 if sb_stream is not None:
@@ -713,7 +720,9 @@ def markdup_bam(
     return sort_bam(in_paths, out_path, **kwargs)
 
 
-def _queryname_rank_column(fmt, splits, errors: str) -> np.ndarray:
+def _queryname_rank_column(
+    fmt, splits, errors: str, stream=None
+) -> np.ndarray:
     """The out-of-core queryname prepass: stream the splits once for
     their collation columns, run the engine, return each record's
     read-order *output rank* as an int64 column.  Ranks are unique, so
@@ -725,7 +734,8 @@ def _queryname_rank_column(fmt, splits, errors: str) -> np.ndarray:
     cols: List[dict] = []
     with span("sort_bam.queryname_rank_prepass", category="stage"):
         for b in _read_splits_pipelined(
-            fmt, splits, fields=fields, with_keys=False, errors=errors
+            fmt, splits, fields=fields, with_keys=False, errors=errors,
+            stream=stream,
         ):
             with span("collate.stage.signature", category="stage"):
                 cols.append(collation_columns(b.data, b.soa))
@@ -819,11 +829,17 @@ def fixmate_bam(
     keep_batches = memory_budget is None
     read_fields = tuple(dict.fromkeys(FIXMATE_FIELDS))
 
+    # Fixmate's DeviceStream: the read drive + deflate tier policy (the
+    # rebuilt streams never carry residency, so device_write stays off
+    # per part by construction).
+    from .device_stream import DeviceStream
+
+    stream = DeviceStream(conf=conf)
     batches: List[Optional[RecordBatch]] = []
     cols_parts: List[dict] = []
     row_bases: List[int] = [0]
     with span("fixmate.read", category="stage"):
-        for b in _read_splits_pipelined(
+        for b in stream.read_splits(
             fmt, splits, fields=read_fields, with_keys=False, errors=errors
         ):
             with span("collate.stage.signature", category="stage"):
@@ -872,18 +888,22 @@ def fixmate_bam(
             1, (os.cpu_count() or 4) // executor.max_workers
         )
         from .io.bam import write_part_fast
-        from .ops.flate import deflate_lanes_tier_enabled
 
-        use_device_deflate = deflate_lanes_tier_enabled(conf)
+        use_device_deflate = stream.policy.deflate_lanes
 
         def write_one(pi: int, tmp: str) -> None:
             b = batches[pi]
             if b is None:
                 b = fmt.read_split(
                     splits[pi], fields=read_fields, with_keys=False,
-                    errors=errors,
+                    errors=errors, stream=stream,
                 )
             patched = apply_fixmate(b, edits, row_bases[pi])
+            # The budget pass's re-read may carry the inflate tier's
+            # residency handoff; the rebuilt stream never consumes it,
+            # so give the window back before dropping the batch (an
+            # unreleased drop is a named ledger leak).
+            _release_split_residency(b)
             if not keep_batches:
                 b = None
             sb_stream = None
@@ -928,19 +948,6 @@ def fixmate_bam(
     )
 
 
-def _device_roundtrip_ms() -> float:
-    """Median small-transfer host↔device round trip (cached per process).
-
-    Local PCIe/ICI chips answer in well under a millisecond; a tunneled
-    remote chip (the dev topology here) costs tens of milliseconds per
-    RPC, which changes which sort_bam mode wins.  Shared with the
-    lockstep-lane inflate tier's auto rule — the probe lives in
-    utils.backend so ops/ and pipeline gate on the same measurement."""
-    from .utils.backend import device_roundtrip_ms
-
-    return device_roundtrip_ms()
-
-
 def _default_device_parse() -> bool:
     """Auto rule for the device-resident parse: on for real, *local*
     accelerators.
@@ -948,76 +955,24 @@ def _default_device_parse() -> bool:
     Under a CPU backend the chain kernel runs in (slow) interpret mode, so
     the host-key path wins there; tests force ``device_parse=True`` to
     exercise the interpret path on small inputs.  On a remote/tunneled
-    chip (device round trip in the tens of milliseconds) the per-split
-    stream uploads pay latency the host-key path does not — measured
-    3x slower end-to-end on the dev tunnel — so the auto rule requires a
-    local-latency chip; ``HBAM_DEVICE_PARSE=1`` forces it on anyway.
-    """
-    import jax
+    chip the per-split stream uploads pay latency the host-key path does
+    not — the gate is the DeviceStream's (RTT under the pipelined-relaxed
+    ``hadoopbam.device.auto-rtt-ms``); ``HBAM_DEVICE_PARSE=1`` forces it
+    on anyway.  ``sort_bam`` consults its own stream directly — this
+    wrapper serves historical callers."""
+    from .device_stream import DeviceStream
 
-    try:
-        if jax.default_backend() != "tpu":
-            return False
-        return _device_roundtrip_ms() < 5.0
-    except Exception:
-        return False
+    return DeviceStream().default_device_parse()
 
 
 def _device_parse_split(b: RecordBatch):
-    """Upload one split's record stream and launch the on-chip parse.
+    """Upload (or donate) one split's record stream and launch the
+    on-chip parse — the DeviceStream's inflate→parse seam
+    (:meth:`~hadoop_bam_tpu.device_stream.DeviceStream.parse_split`);
+    kept as a named pipeline helper for its historical callers."""
+    from .device_stream import DeviceStream
 
-    Returns ``(hi, lo, unmapped, meta)`` device arrays (``meta`` =
-    ``[count, ok, n_unmapped]`` int32), sliced to the host-known record
-    count so the chain kernel's padded buffers free as execution proceeds
-    (the padding is one row per 36 stream bytes — far more than real
-    records).  ``None`` for an empty split; ``False`` when the stream is
-    outside the kernel's int32 domain (caller falls back to host keys).
-    Everything is dispatched asynchronously — the chip walks the chain and
-    builds keys while the host inflates the next split.
-    """
-    from .ops.decode import keys_from_stream_device
-    from .ops.pallas.chain import CHUNK
-
-    n_i = b.n_records
-    if n_i == 0:
-        return None
-    rec_off = b.soa["rec_off"]
-    rec_len = b.soa["rec_len"]
-    # The batch window may hold bytes before the first record (split vstart
-    # inside a block) and after the last (spill margin): slice the exact
-    # back-to-back record stream, pre-padded host-side to the chain
-    # kernel's chunk geometry so only a handful of upload shapes compile.
-    s0 = int(rec_off[0]) - 4
-    s1 = int(rec_off[-1] + rec_len[-1])
-    n_bytes = s1 - s0
-    if n_bytes > 2**31 - CHUNK:
-        # Past the chain kernel's int32 offset domain (only reachable with
-        # a multi-GiB split_size): host keys for the whole job.
-        return False
-    n_chunks = max(1, -(-n_bytes // CHUNK))
-    pad_len = n_chunks * CHUNK + 256 * 4
-    dd = getattr(b, "device_data", None)
-    if dd is not None:
-        # On-chip output residency: the split's inflated bytes are
-        # already in HBM (left there by the lockstep-lane inflate tier),
-        # so slice+pad on device and skip the h2d upload entirely.
-        padded = jnp.pad(dd[s0:s1], (0, pad_len - n_bytes))
-        METRICS.count("sort_bam.device_parse_residency", 1)
-    else:
-        padded = np.zeros(pad_len, dtype=np.uint8)
-        padded[:n_bytes] = b.data[s0:s1]
-        from .utils.tracing import count_h2d
-
-        count_h2d(padded.nbytes, "parse_stream")
-    hi, lo, unm, count, ok = keys_from_stream_device(padded, n_bytes)
-    meta = jnp.stack(
-        [
-            count.astype(jnp.int32),
-            ok.astype(jnp.int32),
-            jnp.sum(unm).astype(jnp.int32),
-        ]
-    )
-    return hi[:n_i], lo[:n_i], unm[:n_i], meta
+    return DeviceStream().parse_split(b)
 
 
 def _finish_device_parse(
@@ -1107,87 +1062,33 @@ def _read_splits_pipelined(
     depth: Optional[int] = None,
     with_keys: bool = True,
     errors: Optional[str] = None,
+    stream=None,
 ):
-    """Yield decoded split batches in order, reading ahead in a small
-    thread pool — split N+1's file read + native inflate (both release the
-    GIL) overlap split N's downstream processing.  Round-1 weak #6: the
-    serial read loop left the host idle during every disk wait.  Depth 2
-    everywhere: measured neutral-to-positive even on the 1-core bench
-    host (BENCH_NOTES.md), a clear win with more cores.
+    """Yield decoded split batches in order, double-buffered — the
+    DeviceStream's split drive
+    (:meth:`~hadoop_bam_tpu.device_stream.DeviceStream.read_splits`),
+    kept as the pipeline's named entry point.  Depth resolves from the
+    explicit argument → the ``hadoopbam.read.depth`` conf key → the
+    ``HBAM_READ_DEPTH`` env var → 2 (measured neutral-to-positive even
+    on the 1-core bench host, BENCH_NOTES.md), and is surfaced in the
+    run manifest via the ``pipeline.read_depth`` gauge.
 
     Under ``errors="salvage"`` a split whose read fails outright (even
     the quarantining reader gave up — e.g. its header window is
     destroyed) degrades to an *empty batch* with a
     ``salvage.splits_failed`` counter instead of killing the job."""
+    from .device_stream import DeviceStream
 
-    def read_one(si, s):
-        # trace_ctx tags every stage event this split's read/inflate/
-        # parse/key chain emits (in whichever pool thread it runs) with
-        # the split index — the stall reducer's per-item attribution.
-        with trace_ctx(split=si), span(
-            "pipeline.stage.read_split", category="item"
-        ):
-            try:
-                return fmt.read_split(
-                    s, fields=fields, with_keys=with_keys, errors=errors
-                )
-            except Exception:
-                if errors != "salvage":
-                    raise
-                METRICS.count("salvage.splits_failed", 1)
-                from .io.bam import _empty_soa
-
-                return RecordBatch(
-                    soa=_empty_soa(fields),
-                    data=np.empty(0, np.uint8),
-                    keys=np.empty(0, np.int64),
-                )
-
-    if depth is None:
-        env = os.environ.get("HBAM_READ_DEPTH")
-        if env:
-            try:
-                depth = max(1, int(env))
-            except ValueError:
-                depth = 2  # malformed override: keep the default
-        else:
-            # Measured on the 1-core bench host (see bench notes in
-            # BENCH_NOTES.md): depth=2 wins there too — the native
-            # inflate/deflate release the GIL, so the reader thread
-            # overlaps the Python-side batch assembly even without a
-            # second core.
-            depth = 2
-    if depth <= 1 or len(splits) <= 1:
-        for si, s in enumerate(splits):
-            yield read_one(si, s)
-        return
-    from concurrent.futures import ThreadPoolExecutor
-
-    pool = ThreadPoolExecutor(max_workers=depth)
-    futs = [
-        pool.submit(read_one, si, s)
-        for si, s in enumerate(splits[: depth + 1])
-    ]
-    nxt = depth + 1
-    try:
-        for i in range(len(splits)):
-            b = futs[i].result()
-            # Drop the Future (and with it the decoded batch it retains) so
-            # only ~depth+1 batches are ever alive: the external-sort path
-            # counts on this generator being O(depth), not O(file).
-            futs[i] = None
-            if nxt < len(splits):
-                futs.append(pool.submit(read_one, nxt, splits[nxt]))
-                nxt += 1
-            yield b
-            del b
-    finally:
-        # On a decode error (or the consumer abandoning the generator),
-        # don't block on — or keep paying for — reads nobody will use.
-        for f in futs:
-            if f is not None:
-                f.cancel()
-        pool.shutdown(wait=False, cancel_futures=True)
+    if stream is None:
+        stream = DeviceStream(conf=getattr(fmt, "conf", None), depth=depth)
+    yield from stream.read_splits(
+        fmt,
+        splits,
+        fields=fields,
+        depth=depth,
+        with_keys=with_keys,
+        errors=errors,
+    )
 
 
 class _LazyPermFetch:
@@ -1275,6 +1176,7 @@ def _sort_bam_external(
     sort_order: str = "coordinate",
     key_column: Optional[np.ndarray] = None,
     deadline=None,
+    stream=None,
 ) -> SortStats:
     """Bounded-memory sort: spill sorted runs, merge by exact key ranges.
 
@@ -1425,6 +1327,7 @@ def _sort_bam_external(
                     fields=read_fields,
                     with_keys=key_column is None,
                     errors=errors,
+                    stream=stream,
                 ):
                     if key_column is not None:
                         # Queryname ranks (or any precomputed key): the
@@ -1596,6 +1499,7 @@ def _sort_bam_external(
                         device_deflate=device_deflate,
                         dup_mask=dup_rows,
                         device_write=device_write,
+                        device_stream=stream,
                     )
             finally:
                 if sb_stream is not None:

@@ -43,11 +43,22 @@ def _batch_nbytes(batch) -> int:
 class HbmArena:
     """LRU residency arena under a byte budget (thread-safe)."""
 
-    def __init__(self, budget_bytes: int = 1 << 30, name: str = "serve.arena"):
+    def __init__(
+        self,
+        budget_bytes: int = 1 << 30,
+        name: str = "serve.arena",
+        stream=None,
+    ):
         if budget_bytes < 1:
             raise ValueError("budget_bytes must be >= 1")
         self.budget = budget_bytes
         self.name = name
+        #: The daemon's DeviceStream, when the arena is a stream client:
+        #: residency handoffs and drops ride the stream's ledger seam —
+        #: one holder story instead of a parallel implementation.  A
+        #: standalone arena (tests, host-only tools) talks to the
+        #: process-global LEDGER directly, which is the same accounting.
+        self.stream = stream
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
         self.used_bytes = 0
@@ -64,14 +75,14 @@ class HbmArena:
         METRICS.set_gauge(f"{self.name}.used_bytes", self.used_bytes)
         METRICS.set_gauge(f"{self.name}.entries", len(self._entries))
 
-    @staticmethod
-    def _ledger_drop(batch) -> None:
-        """Release a dropped window's HBM residency through the ledger
-        (HBM frees when the last reference dies; the ledger release is
-        the audited bookkeeping event)."""
+    def _ledger_drop(self, batch) -> None:
+        """Release a dropped window's HBM residency through the
+        stream's ledger seam (HBM frees when the last reference dies;
+        the ledger release is the audited bookkeeping event)."""
         dd = getattr(batch, "device_data", None)
         if dd is not None:
-            LEDGER.release(dd)
+            (self.stream.release if self.stream is not None
+             else LEDGER.release)(dd)
 
     def get(self, key: Hashable):
         with self._lock:
@@ -100,7 +111,8 @@ class HbmArena:
                 # Ownership handoff: the arena now holds the window's
                 # HBM residency across requests (by design — excluded
                 # from the end-of-run leak check).
-                LEDGER.transfer(batch.device_data, self.name)
+                (self.stream.transfer if self.stream is not None
+                 else LEDGER.transfer)(batch.device_data, self.name)
             while self.used_bytes > self.budget and len(self._entries) > 1:
                 _, (nb_old, b_old) = self._entries.popitem(last=False)
                 self.used_bytes -= nb_old

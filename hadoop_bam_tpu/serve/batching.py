@@ -34,10 +34,24 @@ from ..utils.tracing import METRICS, TRACER, current_request
 MAX_LANES = 128
 
 
-def default_decode_fn(conf=None) -> Callable:
-    """The daemon's decode tier resolution, once per batcher: the device
-    lanes wrapper when the inflate-lanes gate fires (conf key / env /
-    local-latency auto rule), else the native host codec."""
+def default_decode_fn(conf=None, stream=None) -> Callable:
+    """The daemon's decode tier resolution, once per batcher.
+
+    With a ``stream`` (a
+    :class:`~hadoop_bam_tpu.device_stream.DeviceStream`, the daemon's
+    own), the batcher is a stream *client*: every coalesced launch rides
+    :meth:`~hadoop_bam_tpu.device_stream.DeviceStream.decode_members` —
+    the same tier seam the split readers use, with device errors
+    propagated so the serve OOM ladder (evict → retry → tier-down) stays
+    in charge a layer up.  Without one, the legacy resolution: the
+    device lanes wrapper when the inflate-lanes gate fires (conf key /
+    env / local-latency auto rule), else the native host codec."""
+    if stream is not None:
+
+        def decode(raw, co, cs, us):
+            return stream.decode_members(raw, co, cs, us)
+
+        return decode
     from ..ops import flate
 
     if flate.lanes_tier_enabled(conf):
@@ -101,10 +115,11 @@ class LaneBatcher:
         decode_fn: Optional[Callable] = None,
         max_lanes: int = MAX_LANES,
         conf=None,
+        stream=None,
     ):
         self.window_s = max(0.0, float(window_s))
         self.max_lanes = max(1, int(max_lanes))
-        self._decode = decode_fn or default_decode_fn(conf)
+        self._decode = decode_fn or default_decode_fn(conf, stream=stream)
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._wake = threading.Event()

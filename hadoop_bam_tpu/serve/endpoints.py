@@ -53,17 +53,22 @@ DEFAULT_BATCH_WINDOW_MS = 2.0
 
 @dataclass
 class ServeContext:
-    """The daemon's warm state, bundled: conf + cache + arena + batcher.
+    """The daemon's warm state, bundled: conf + cache + arena + batcher
+    + the daemon's DeviceStream.
 
     The one-shot CLI builds a throwaway instance per invocation (same code
     path, cold state, no batcher thread unless asked); the daemon keeps
-    one for its lifetime.
+    one for its lifetime.  The arena and the lane batcher are *clients*
+    of the one DeviceStream — the codec tier policy resolves once for
+    the daemon's lifetime and every residency handoff rides the same
+    ledger seam the batch pipeline uses.
     """
 
     conf: Configuration
     cache: ResourceCache
     arena: HbmArena
     batcher: Optional[LaneBatcher] = None
+    stream: Optional[object] = None  # DeviceStream
 
     @classmethod
     def from_conf(
@@ -75,16 +80,20 @@ class ServeContext:
         window_ms = conf.get_int(
             SERVE_BATCH_WINDOW_MS, int(DEFAULT_BATCH_WINDOW_MS)
         )
+        from ..device_stream import DeviceStream
+
+        stream = DeviceStream(conf=conf, name="serve.stream")
         batcher = (
-            LaneBatcher(window_s=window_ms / 1e3, conf=conf)
+            LaneBatcher(window_s=window_ms / 1e3, conf=conf, stream=stream)
             if with_batcher
             else None
         )
         return cls(
             conf=conf,
             cache=ResourceCache(cache_bytes),
-            arena=HbmArena(arena_bytes),
+            arena=HbmArena(arena_bytes, stream=stream),
             batcher=batcher,
+            stream=stream,
         )
 
     def close(self) -> None:

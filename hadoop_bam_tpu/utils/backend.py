@@ -81,6 +81,28 @@ def local_tpu_ready(max_rtt_ms: float = 5.0) -> bool:
         return False
 
 
+def donation_supported() -> bool:
+    """Does the current backend honor ``jax.jit(..., donate_argnums=…)``?
+
+    Buffer donation is the DeviceStream's single-copy guarantee at the
+    stage seams (inflate→parse slice+pad, split-windows→write-stream
+    concat, gathered-stream→CRC): the donor's HBM is reusable by the
+    consumer's output, so the seam never holds two copies of a split.
+    The CPU backend ignores donation (with a warning per compile), so
+    the seams skip requesting it there — interpret-mode CI exercises the
+    same code path minus the aliasing, and the ledger's adopt/transfer
+    bookkeeping is identical either way.
+    """
+    try:
+        if not backend_initialized():
+            return False
+        import jax
+
+        return jax.default_backend() in ("tpu", "gpu")
+    except Exception:
+        return False
+
+
 def backend_initialized() -> bool:
     """True if this process has already initialized any JAX backend.
 

@@ -929,6 +929,12 @@ def run_manifest(
         modes["faults_armed"] = faults.ACTIVE is not None
     except Exception:  # pragma: no cover
         pass
+    # The split-pipelining depth the read drive actually used (the
+    # ``pipeline.read_depth`` gauge, set by DeviceStream.read_splits):
+    # a round's overlap numbers carry their pipelining provenance.
+    depth_g = METRICS.gauges().get("pipeline.read_depth")
+    if depth_g:
+        modes["read_depth"] = int(depth_g)
     platform = None
     jax = sys.modules.get("jax")
     if jax is not None:

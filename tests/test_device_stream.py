@@ -1,0 +1,642 @@
+"""DeviceStream: the fused device graph + double-buffered split drive.
+
+Four layers of proof, per the PR 13 contract:
+
+1. **Policy + depth plumbing** — depth resolves conf key → env → default
+   and surfaces in the run manifest; the auto-rtt gate relaxes by the
+   pipeline depth (``hadoopbam.device.auto-rtt-ms``), base default
+   unchanged.
+2. **Double-buffer ordering drills** — splits yield in order under
+   out-of-order completion, a salvage-mode read failure mid-stream
+   degrades to an empty batch in its slot, and a spent deadline raises
+   at a stage boundary instead of dispatching more device work.
+3. **Disarmed contract + byte identity** — stream off: zero
+   ``device_stream.*`` counters and output byte-identical to the armed
+   runs; stream on (interpret-mode lanes, ≤3 KiB members per the test
+   budget): in-core, out-of-core and salvage sorts all byte-identical,
+   with ``LEDGER.assert_drained()`` clean and zero ``hbm.double_copy``
+   after every pipelined run.
+4. **Donation seams** — the slice+pad jit matches NumPy bit-for-bit,
+   the parse seam adopts the window (donor closed, no leak), and the
+   shared decode seam feeds the serve batcher/arena the same bytes the
+   native codec produces.
+
+Full-size-member end-to-end rides ``slow`` + ``device_stream`` (needs a
+real accelerator; the conftest guard skips it under a cpu pin).
+"""
+
+import gc
+import io
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import native
+from hadoop_bam_tpu.conf import (
+    DEVICE_AUTO_RTT_MS,
+    INFLATE_LANES,
+    READ_DEPTH,
+    Configuration,
+)
+from hadoop_bam_tpu.device_stream import (
+    DEFAULT_DEPTH,
+    DeviceStream,
+    StreamPolicy,
+    _slice_pad_fn,
+    resolve_depth,
+)
+from hadoop_bam_tpu.io.bam import BamInputFormat, RecordBatch
+from hadoop_bam_tpu.spec import bam, bgzf
+from hadoop_bam_tpu.utils.deadline import Deadline, DeadlineExceeded
+from hadoop_bam_tpu.utils.hbm import LEDGER
+from hadoop_bam_tpu.utils.tracing import (
+    METRICS,
+    delta,
+    run_manifest,
+    snapshot,
+)
+
+LANES_CONF = Configuration({INFLATE_LANES: "true"})
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    LEDGER._reset_for_tests()
+    yield
+    LEDGER._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _no_env_forces(monkeypatch):
+    """The gates must resolve from conf + the (cpu-declining) auto rule,
+    not from ambient env forces a developer shell might carry."""
+    for k in (
+        "HBAM_INFLATE_LANES",
+        "HBAM_DEFLATE_LANES",
+        "HBAM_DEVICE_WRITE",
+        "HBAM_DEVICE_PARSE",
+        "HBAM_READ_DEPTH",
+    ):
+        monkeypatch.delenv(k, raising=False)
+
+
+def _tiny_bam(path: str, n: int = 150, block_payload: int = 512) -> None:
+    refs = [("c1", 1 << 24)]
+    hdr = bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n@SQ\tSN:c1\tLN:16777216", refs
+    )
+    rng = np.random.default_rng(13)
+    stream = bytearray()
+    for i in range(n):
+        r = bam.build_record(
+            f"q{i:04d}", 0, int(rng.integers(0, 1 << 20)), 30, 0,
+            [(36, "M")], "ACGT" * 9, bytes([25] * 36),
+        )
+        stream += struct.pack("<I", len(r.raw)) + r.raw
+    buf = io.BytesIO()
+    w = bgzf.BgzfWriter(buf, level=1, append_terminator=False)
+    w.write(hdr.encode())
+    w.close()
+    body = native.deflate_blocks(
+        np.frombuffer(bytes(stream), np.uint8), level=1,
+        block_payload=block_payload,
+    )
+    with open(path, "wb") as f:
+        f.write(buf.getvalue() + bytes(body) + bgzf.TERMINATOR)
+
+
+# ---------------------------------------------------------------------------
+# Policy + depth plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_depth_resolution_precedence(monkeypatch):
+    assert resolve_depth() == DEFAULT_DEPTH
+    conf = Configuration({READ_DEPTH: "5"})
+    assert resolve_depth(conf) == 5
+    assert resolve_depth(conf, depth=3) == 3  # explicit wins
+    monkeypatch.setenv("HBAM_READ_DEPTH", "7")
+    assert resolve_depth() == 7
+    assert resolve_depth(conf) == 5  # conf key outranks the env var
+    monkeypatch.setenv("HBAM_READ_DEPTH", "bogus")
+    assert resolve_depth() == DEFAULT_DEPTH
+    assert resolve_depth(depth=0) == 1  # floor
+
+
+def test_auto_rtt_relaxation_scales_with_depth(monkeypatch):
+    """A ≥2-deep stream relaxes the auto-rtt gate by its depth; the
+    gates receive exactly that threshold."""
+    from hadoop_bam_tpu.ops import flate
+    from hadoop_bam_tpu.utils import backend as ub
+
+    assert flate.device_auto_rtt_ms(None) == 5.0
+    conf = Configuration({DEVICE_AUTO_RTT_MS: "70", READ_DEPTH: "4"})
+    assert flate.device_auto_rtt_ms(conf) == 70.0
+    assert flate.device_auto_rtt_ms(
+        Configuration({DEVICE_AUTO_RTT_MS: "junk"})
+    ) == 5.0
+    seen = []
+
+    def fake_ready(max_rtt_ms=5.0):
+        seen.append(max_rtt_ms)
+        return False
+
+    monkeypatch.setattr(ub, "local_tpu_ready", fake_ready)
+    pol = StreamPolicy.resolve(conf)
+    assert pol.depth == 4
+    assert pol.auto_rtt_ms == 70.0
+    assert pol.effective_rtt_ms == 280.0
+    assert seen == [280.0] * 3  # all three gates asked with the relaxed value
+    assert not pol.armed
+    # depth 1: no relaxation — the historic gate, default unchanged.
+    pol1 = StreamPolicy.resolve(
+        Configuration({DEVICE_AUTO_RTT_MS: "70", READ_DEPTH: "1"})
+    )
+    assert pol1.effective_rtt_ms == 70.0
+    assert StreamPolicy.resolve().effective_rtt_ms == 5.0 * DEFAULT_DEPTH
+
+
+def test_depth_gauge_surfaces_in_run_manifest(tmp_path):
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src, n=40)
+    conf = Configuration({READ_DEPTH: "3"})
+    fmt = BamInputFormat(conf)
+    splits = fmt.get_splits([src], split_size=1024)
+    stream = DeviceStream(conf=conf)
+    assert stream.depth == 3
+    list(stream.read_splits(fmt, splits))
+    assert METRICS.gauges().get("pipeline.read_depth") == 3.0
+    man = run_manifest(backend="host")
+    assert man.modes.get("read_depth") == 3
+
+
+# ---------------------------------------------------------------------------
+# Double-buffer ordering drills
+# ---------------------------------------------------------------------------
+
+
+class _FakeFmt:
+    """A split 'reader' with controllable per-split latency/failure —
+    the ordering drills don't need real BAM bytes."""
+
+    conf = None
+
+    def __init__(self, n, delays=None, fail=()):
+        self.n = n
+        self.delays = delays or {}
+        self.fail = set(fail)
+        self.splits = list(range(n))
+
+    def read_split(self, s, fields=None, with_keys=True, errors=None,
+                   stream=None):
+        import time
+
+        time.sleep(self.delays.get(s, 0.0))
+        if s in self.fail:
+            raise bgzf.BgzfError(f"injected split {s} failure")
+        off = np.array([4 * s + 4], dtype=np.int64)
+        return RecordBatch(
+            soa={"rec_off": off, "rec_len": np.array([0], np.int64)},
+            data=np.full(1, s, dtype=np.uint8),
+            keys=np.array([s], dtype=np.int64),
+        )
+
+
+def test_read_splits_order_preserved_under_out_of_order_completion():
+    # Early splits are the SLOW ones: with depth 3 the later reads
+    # finish first, and the drive must still yield 0..n-1 in order.
+    fmt = _FakeFmt(6, delays={0: 0.05, 1: 0.03})
+    stream = DeviceStream(depth=3)
+    got = [
+        int(b.data[0])
+        for b in stream.read_splits(fmt, fmt.splits, with_keys=True)
+    ]
+    assert got == list(range(6))
+
+
+def test_salvage_empty_batch_mid_stream_keeps_slot_and_order():
+    fmt = _FakeFmt(5, fail={2})
+    stream = DeviceStream(depth=2)
+    s0 = snapshot()
+    out = list(
+        stream.read_splits(fmt, fmt.splits, errors="salvage")
+    )
+    assert len(out) == 5
+    assert [b.n_records for b in out] == [1, 1, 0, 1, 1]
+    assert [int(b.data[0]) for i, b in enumerate(out) if i != 2] == [
+        0, 1, 3, 4,
+    ]
+    assert delta(s0)["counters"].get("salvage.splits_failed") == 1
+
+
+def test_strict_mode_still_raises_mid_stream():
+    fmt = _FakeFmt(4, fail={1})
+    stream = DeviceStream(depth=2)
+    with pytest.raises(bgzf.BgzfError):
+        list(stream.read_splits(fmt, fmt.splits, errors="strict"))
+
+
+def test_deadline_expiry_between_stages():
+    fmt = _FakeFmt(4)
+    dl = Deadline.after_ms(-1)  # already spent
+    stream = DeviceStream(deadline=dl, depth=2)
+    with pytest.raises(DeadlineExceeded) as ei:
+        list(stream.read_splits(fmt, fmt.splits))
+    assert ei.value.seam == "stream_read"
+    # The parse and encode seams guard the same budget.
+    b = RecordBatch(
+        soa={
+            "rec_off": np.array([4], np.int64),
+            "rec_len": np.array([40], np.int64),
+        },
+        data=np.zeros(64, np.uint8),
+        keys=np.empty(0, np.int64),
+    )
+    with pytest.raises(DeadlineExceeded):
+        stream.parse_split(b)
+
+
+def test_deadline_threaded_from_sort_bam(tmp_path):
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src, n=40)
+    with pytest.raises(DeadlineExceeded):
+        sort_bam(
+            [src], str(tmp_path / "out.bam"), backend="host",
+            split_size=1024, level=1, deadline=Deadline.after_ms(-1),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Disarmed contract + byte identity (stream on vs off)
+# ---------------------------------------------------------------------------
+
+
+def _sort(src, out, conf=None, **kw):
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    return sort_bam(
+        [src], out, conf=conf, backend="host", level=1, split_size=1024,
+        **kw,
+    )
+
+
+def test_disarmed_contract_zero_stream_counters(tmp_path):
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src)
+    s0 = snapshot()
+    _sort(src, str(tmp_path / "off.bam"))
+    d = delta(s0)["counters"]
+    assert not [k for k in d if k.startswith("device_stream.")], d
+    assert "hbm.double_copy" not in d
+    assert LEDGER.assert_drained()["leaked_bytes"] == 0
+
+
+def test_pipelined_sort_byte_identical_on_off_in_core(tmp_path):
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src)
+    off = str(tmp_path / "off.bam")
+    on = str(tmp_path / "on.bam")
+    _sort(src, off)
+    s0 = snapshot()
+    _sort(src, on, conf=LANES_CONF)
+    gc.collect()
+    d = delta(s0)["counters"]
+    # The stream really engaged (interpret-mode lanes on CPU)…
+    assert d.get("device_stream.decodes", 0) > 0
+    assert d.get("device_stream.windows", 0) > 0
+    # …the output is byte-identical…
+    assert open(on, "rb").read() == open(off, "rb").read()
+    # …and the pipelined run leaves the ledger drained with zero
+    # double-copy windows (the PR 11 regression guard for donation).
+    assert "hbm.double_copy" not in d
+    assert "hbm.leaked_bytes" not in d
+    assert LEDGER.assert_drained()["leaked_bytes"] == 0
+
+
+def test_pipelined_sort_byte_identical_out_of_core_and_salvage(tmp_path):
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src)
+    off = str(tmp_path / "off.bam")
+    _sort(src, off, memory_budget=8 << 10)
+    for name, kw in (
+        ("oncore", dict(memory_budget=8 << 10)),
+        ("salv", dict(memory_budget=8 << 10, errors="salvage")),
+    ):
+        out = str(tmp_path / f"{name}.bam")
+        s0 = snapshot()
+        _sort(src, out, conf=LANES_CONF, **kw)
+        gc.collect()
+        d = delta(s0)["counters"]
+        assert d.get("device_stream.decodes", 0) > 0, name
+        assert open(out, "rb").read() == open(off, "rb").read(), name
+        assert "hbm.double_copy" not in d, name
+        assert "hbm.leaked_bytes" not in d, name
+        assert LEDGER.assert_drained()["leaked_bytes"] == 0, name
+
+
+# ---------------------------------------------------------------------------
+# Donation seams
+# ---------------------------------------------------------------------------
+
+
+def test_slice_pad_matches_numpy():
+    data = np.arange(64, dtype=np.uint8)
+    out = np.asarray(_slice_pad_fn(10, 32, False)(data, 5))
+    ref = np.zeros(32, np.uint8)
+    ref[:10] = data[5:15]
+    assert np.array_equal(out, ref)
+
+
+def test_parse_split_adopts_window_no_leak(monkeypatch):
+    """The inflate→parse seam: the window is adopted into the parse
+    stream (donor closed in the ledger) and the parse stream's own
+    residency is released after dispatch — nothing left to drain, no
+    leak counters, even without backend donation support (CPU)."""
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.ops import decode as decode_mod
+
+    n = 3
+    win = np.zeros(256, np.uint8)
+    LEDGER.register(win, kind="split_window", holder="bam.split_window")
+    b = RecordBatch(
+        soa={
+            "rec_off": np.array([4, 44, 84], np.int64),
+            "rec_len": np.array([40, 40, 40], np.int64),
+        },
+        data=win,
+        keys=np.empty(0, np.int64),
+        device_data=win,
+    )
+
+    def fake_keys(padded, n_bytes):
+        z = jnp.zeros(8, jnp.int32)
+        return z, z, z, jnp.int32(n), jnp.int32(1)
+
+    monkeypatch.setattr(decode_mod, "keys_from_stream_device", fake_keys)
+    s0 = snapshot()
+    stream = DeviceStream()
+    res = stream.parse_split(b)
+    assert res is not None and res is not False
+    assert b.device_data is None  # the window was handed off
+    gc.collect()
+    d = delta(s0)["counters"]
+    assert "hbm.leaked_bytes" not in d
+    assert "hbm.double_copy" not in d
+    assert LEDGER.assert_drained()["leaked_bytes"] == 0
+
+
+def test_parse_split_keep_residency_leaves_window(monkeypatch):
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.ops import decode as decode_mod
+
+    win = np.zeros(128, np.uint8)
+    LEDGER.register(win, kind="split_window", holder="bam.split_window")
+    b = RecordBatch(
+        soa={
+            "rec_off": np.array([4], np.int64),
+            "rec_len": np.array([40], np.int64),
+        },
+        data=win,
+        keys=np.empty(0, np.int64),
+        device_data=win,
+    )
+    monkeypatch.setattr(
+        decode_mod,
+        "keys_from_stream_device",
+        lambda padded, n_bytes: (
+            jnp.zeros(4, jnp.int32),
+            jnp.zeros(4, jnp.int32),
+            jnp.zeros(4, jnp.int32),
+            jnp.int32(1),
+            jnp.int32(1),
+        ),
+    )
+    DeviceStream().parse_split(b, keep_residency=True)
+    assert b.device_data is not None  # the write path still gathers from it
+    assert LEDGER.release(b.device_data) is True
+
+
+# ---------------------------------------------------------------------------
+# Serve clients of the same abstraction
+# ---------------------------------------------------------------------------
+
+
+def _bgzf_member_stream(payloads):
+    raw = b"".join(bgzf.compress_block(p, level=1) for p in payloads)
+    co, cs, us = [], [], []
+    pos = 0
+    while pos < len(raw):
+        csize, usize = bgzf.read_block_at(raw, pos)
+        co.append(pos)
+        cs.append(csize)
+        us.append(usize)
+        pos += csize
+    arr = np.frombuffer(raw, np.uint8)
+    return arr, np.asarray(co, np.int64), np.asarray(cs, np.int32), \
+        np.asarray(us, np.int32)
+
+
+def test_decode_members_shared_seam_matches_native():
+    payloads = [b"hello " * 40, b"", bytes(range(256)) * 4]
+    raw, co, cs, us = _bgzf_member_stream(payloads)
+    ref_out, ref_offs = native.inflate_blocks(raw, co, cs, us)
+    s0 = snapshot()
+    # Armed stream (interpret lanes): same bytes, counted as a stream
+    # decode.
+    on = DeviceStream(conf=LANES_CONF)
+    out, offs = on.decode_members(raw, co, cs, us)
+    assert bytes(out) == bytes(ref_out)
+    assert np.array_equal(offs, ref_offs)
+    assert delta(s0)["counters"].get("device_stream.decodes") == 1
+    # Disarmed stream: native path, zero stream counters.
+    s1 = snapshot()
+    off_stream = DeviceStream()
+    out2, offs2 = off_stream.decode_members(raw, co, cs, us)
+    assert bytes(out2) == bytes(ref_out)
+    assert not [
+        k
+        for k in delta(s1)["counters"]
+        if k.startswith("device_stream.")
+    ]
+
+
+def test_lane_batcher_is_a_stream_client():
+    from hadoop_bam_tpu.serve.batching import LaneBatcher, default_decode_fn
+
+    payloads = [b"abc" * 100, b"xyz" * 33]
+    raw, co, cs, us = _bgzf_member_stream(payloads)
+    ref_out, ref_offs = native.inflate_blocks(raw, co, cs, us)
+    stream = DeviceStream(conf=LANES_CONF)
+    b = LaneBatcher(window_s=0.0, decode_fn=default_decode_fn(stream=stream))
+    try:
+        out, offs = b.submit(raw, co, cs, us)
+        assert bytes(out) == bytes(ref_out)
+        assert np.array_equal(offs, ref_offs)
+    finally:
+        b.close()
+    assert METRICS.report()["counters"].get("device_stream.decodes", 0) >= 1
+
+
+def test_arena_is_a_stream_client():
+    from hadoop_bam_tpu.serve.arena import HbmArena
+
+    stream = DeviceStream()
+    win = np.zeros(512, np.uint8)
+    LEDGER.register(win, kind="split_window", holder="bam.split_window")
+    batch = RecordBatch(
+        soa={"rec_off": np.empty(0, np.int64)},
+        data=np.zeros(16, np.uint8),
+        keys=np.empty(0, np.int64),
+        device_data=win,
+    )
+    arena = HbmArena(1 << 20, stream=stream)
+    arena.hold(("f", 0), batch)
+    # Residency rode the stream's ledger seam into the arena's holder.
+    assert LEDGER.live_by_holder() == {"serve.arena": 512}
+    assert arena.evict_lru() == 1
+    assert LEDGER.live_by_holder() == {}
+
+
+def test_serve_context_builds_one_stream():
+    from hadoop_bam_tpu.serve.endpoints import ServeContext
+
+    ctx = ServeContext.from_conf(Configuration(), with_batcher=True)
+    try:
+        assert ctx.stream is not None
+        assert ctx.arena.stream is ctx.stream
+        assert isinstance(ctx.stream, DeviceStream)
+    finally:
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# trace_report --compare + the h2d-hidden reducer (the PR's instrument)
+# ---------------------------------------------------------------------------
+
+
+def _trace_doc(events):
+    return {"traceEvents": events, "otherData": {}}
+
+
+def _stage(name, ts, dur, **args):
+    return {
+        "name": name, "cat": "stage", "ph": "X", "ts": ts, "dur": dur,
+        "pid": 1, "tid": 1, "args": args,
+    }
+
+
+def _h2d(ts, nbytes):
+    return {
+        "name": "transfers.h2d", "cat": "xfer", "ph": "X", "ts": ts,
+        "dur": 0, "pid": 1, "tid": 1, "args": {"bytes": nbytes},
+    }
+
+
+def test_trace_report_compare_prints_overlap_delta(tmp_path, capsys):
+    import pathlib
+
+    from tests.test_hbm import _load_module
+
+    tr = _load_module(
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools"
+        / "trace_report.py",
+        "trace_report_ds",
+    )
+    before = _trace_doc(
+        [
+            _stage("read", 0, 100, split=0),
+            _stage("inflate", 100, 100, split=0),
+            _stage("read", 200, 100, split=1),
+            _stage("inflate", 300, 100, split=1),
+        ]
+    )
+    after = _trace_doc(
+        [
+            _stage("read", 0, 100, split=0),
+            _stage("inflate", 100, 100, split=0),
+            _stage("read", 100, 100, split=1),
+            _stage("inflate", 200, 100, split=1),
+        ]
+    )
+    a = tmp_path / "before.json"
+    b = tmp_path / "after.json"
+    a.write_text(json.dumps(before))
+    b.write_text(json.dumps(after))
+    rc = tr.main(["--compare", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pipeline overlap" in out and "delta" in out
+    # JSON form carries the delta for the bench harness.
+    rc = tr.main(["--compare", str(a), str(b), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["overlap_delta"] > 0
+    assert doc["before"]["overlap_frac"] == 0.0
+
+
+def test_transfer_report_h2d_hidden_fraction():
+    import pathlib
+
+    from tests.test_hbm import _load_module
+
+    tr = _load_module(
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools"
+        / "trace_report.py",
+        "trace_report_ds2",
+    )
+    events = [
+        _stage("inflate", 100, 100),
+        _h2d(150, 1000),  # inside the stage: hidden
+        _h2d(300, 3000),  # outside every stage: exposed
+    ]
+    rep = tr.transfer_report(events)
+    assert rep["h2d_bytes"] == 4000
+    assert rep["h2d_hidden_bytes"] == 1000
+    assert rep["hidden_pct"] == 0.25
+    assert tr.transfer_report([_stage("x", 0, 1)]) is None
+
+
+# ---------------------------------------------------------------------------
+# Full-size, real-chip acceptance (slow + device_stream)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.device_stream
+def test_full_size_pipelined_sort_device_tiers(tmp_path):
+    """The whole pipelined device path at full-size members on a real
+    accelerator: inflate lanes + deflate lanes + device write armed via
+    the relaxed auto-rtt key, output byte-identical to the host path,
+    ledger drained, zero double-copy."""
+    from hadoop_bam_tpu.conf import DEFLATE_LANES, WRITE_DEVICE
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    src = str(tmp_path / "in.bam")
+    _tiny_bam(src, n=5000, block_payload=bgzf.MAX_PAYLOAD)
+    off = str(tmp_path / "off.bam")
+    on = str(tmp_path / "on.bam")
+    sort_bam([src], off, backend="host", level=1)
+    conf = Configuration(
+        {
+            INFLATE_LANES: "true",
+            DEFLATE_LANES: "true",
+            WRITE_DEVICE: "true",
+            DEVICE_AUTO_RTT_MS: "100",
+            READ_DEPTH: "3",
+        }
+    )
+    s0 = snapshot()
+    sort_bam([src], on, conf=conf, backend="device", level=1)
+    gc.collect()
+    d = delta(s0)["counters"]
+    assert open(on, "rb").read() == open(off, "rb").read()
+    assert "hbm.double_copy" not in d
+    assert LEDGER.assert_drained()["leaked_bytes"] == 0

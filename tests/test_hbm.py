@@ -765,10 +765,13 @@ def test_ledger_coverage_lint():
     ledger registration within ±40 lines, so a new residency seam cannot
     silently bypass the accounting.  Kernel internals (ops/pallas/) and
     the ledger itself are exempt; ``= None`` drops and the release
-    helper are not attaches."""
+    helper are not attaches.  The DeviceStream (PR 13) consolidated the
+    gather/parse attach sites — the lint walks it like every other file
+    and must keep finding sites there."""
     pkg = REPO / "hadoop_bam_tpu"
     bad = []
     n_sites = 0
+    files_with_sites = set()
     for f in sorted(pkg.rglob("*.py")):
         rel = f.relative_to(REPO)
         if "ops/pallas" in str(rel) or f.name == "hbm.py":
@@ -786,12 +789,17 @@ def test_ledger_coverage_lint():
             if re.search(r"device_(data|flat)\s*:\s*", line):
                 continue
             n_sites += 1
+            files_with_sites.add(f.name)
             lo = max(0, i - _WINDOW)
             hi = min(len(lines), i + _WINDOW + 1)
             window = "\n".join(lines[lo:hi])
             if not _LEDGER_CALL.search(window):
                 bad.append(f"{rel}:{i + 1}: {s}")
     assert n_sites >= 6, f"lint found too few attach sites ({n_sites})"
+    assert "device_stream.py" in files_with_sites, (
+        "the DeviceStream's residency seams fell out of the lint's "
+        f"attach patterns (scanned: {sorted(files_with_sites)})"
+    )
     assert not bad, (
         "residency attach sites without a ledger registration nearby:\n"
         + "\n".join(bad)

@@ -186,6 +186,101 @@ def stage_report(
     }
 
 
+def transfer_report(events: List[dict]) -> Optional[dict]:
+    """How much of the h2d traffic was *hidden* behind compute.
+
+    The transfer ledger emits zero-duration ``transfers.h2d`` instants
+    (``cat: "xfer"``, args carrying ``bytes``); a crossing whose
+    timestamp falls inside some stage's busy interval was dispatched
+    while a kernel/stage was running — on an async backend that upload
+    rides under the compute, which is exactly what the double-buffered
+    split drive is for.  Returns ``h2d_bytes`` / ``h2d_hidden_bytes`` /
+    ``hidden_pct`` (bytes-weighted) plus the d2h totals, or None when
+    the trace has no transfer instants (a host-only run).
+    """
+    stage_ivs: List[Interval] = []
+    h2d: List[Tuple[float, float]] = []  # (ts, bytes)
+    d2h_bytes = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        cat = e.get("cat")
+        if cat == "stage":
+            t0 = float(e["ts"])
+            stage_ivs.append((t0, t0 + float(e.get("dur", 0.0))))
+        elif cat == "xfer":
+            b = float((e.get("args") or {}).get("bytes", 0))
+            if e.get("name") == "transfers.h2d":
+                h2d.append((float(e["ts"]), b))
+            elif e.get("name") == "transfers.d2h":
+                d2h_bytes += b
+    if not h2d and not d2h_bytes:
+        return None
+    merged = _merge(stage_ivs)
+    total = sum(b for _, b in h2d)
+    hidden = 0.0
+    j = 0
+    for ts, b in sorted(h2d):
+        while j < len(merged) and merged[j][1] < ts:
+            j += 1
+        if j < len(merged) and merged[j][0] <= ts <= merged[j][1]:
+            hidden += b
+    return {
+        "h2d_bytes": total,
+        "h2d_hidden_bytes": hidden,
+        "hidden_pct": (hidden / total) if total > 0 else 0.0,
+        "d2h_bytes": d2h_bytes,
+        "h2d_events": len(h2d),
+    }
+
+
+def compare_report(before: dict, after: dict) -> str:
+    """Side-by-side per-stage busy/idle/overlap of two reduced reports
+    plus the pipeline-overlap delta — the before/after instrument for a
+    pipelining change (``--compare before.json after.json``)."""
+    names = sorted(
+        set(before["stages"]) | set(after["stages"]),
+        key=lambda k: -(
+            after["stages"].get(k, before["stages"].get(k, {}))
+            .get("busy_ms", 0.0)
+        ),
+    )
+    lines = [
+        f"{'':<34} {'— before —':^26} {'— after —':^26}",
+        f"{'stage':<34} {'busy ms':>10} {'idle':>6} {'ovlp':>6} "
+        f"{'busy ms':>10} {'idle':>6} {'ovlp':>6}",
+    ]
+
+    def _cols(rep, name) -> str:
+        s = rep["stages"].get(name)
+        if s is None:
+            return f"{'-':>10} {'-':>6} {'-':>6}"
+        return (
+            f"{s['busy_ms']:>10.3f} {s['idle_frac']:>6.1%} "
+            f"{s['overlap_frac']:>6.1%}"
+        )
+
+    for name in names:
+        lines.append(
+            f"{name:<34} {_cols(before, name)} {_cols(after, name)}"
+        )
+    ov_b, ov_a = before["overlap_frac"], after["overlap_frac"]
+    lines.append("")
+    lines.append(
+        f"pipeline overlap: {ov_b:.1%} -> {ov_a:.1%} "
+        f"(delta {ov_a - ov_b:+.1%})"
+    )
+    lines.append(
+        f"wall: {before['wall_ms']:.3f} ms -> {after['wall_ms']:.3f} ms"
+    )
+    tb, ta = before["top_stall"], after["top_stall"]
+    lines.append(
+        f"top stall: {tb['stage']} ({tb['exclusive_ms']:.3f} ms excl) -> "
+        f"{ta['stage']} ({ta['exclusive_ms']:.3f} ms excl)"
+    )
+    return "\n".join(lines)
+
+
 def memory_report(
     events: List[dict], category: str = "hbm"
 ) -> Optional[dict]:
@@ -390,7 +485,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="per-stage busy/idle/overlap + top stall from a "
         "--trace Chrome trace-event JSON"
     )
-    ap.add_argument("trace", help="trace file (sort --trace out.json)")
+    ap.add_argument(
+        "trace", nargs="?", default=None,
+        help="trace file (sort --trace out.json)",
+    )
     ap.add_argument(
         "--json", action="store_true",
         help="emit the reduced report as JSON instead of the table",
@@ -399,11 +497,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--category", default="stage",
         help="event category to attribute (default: stage)",
     )
+    ap.add_argument(
+        "--compare", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="two trace files: print the per-stage tables side by side "
+        "with the overlap-fraction delta (the pipelining before/after "
+        "instrument)",
+    )
     args = ap.parse_args(argv)
+    if args.compare is not None:
+        reps = []
+        for path in args.compare:
+            evs = load_events(path)
+            rep = stage_report(evs, category=args.category)
+            if rep is None:
+                print(
+                    f"no {args.category!r} events in {path}",
+                    file=sys.stderr,
+                )
+                return 1
+            reps.append(rep)
+        if args.json:
+            out = {
+                "before": reps[0],
+                "after": reps[1],
+                "overlap_delta": (
+                    reps[1]["overlap_frac"] - reps[0]["overlap_frac"]
+                ),
+            }
+            json.dump(out, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            print(compare_report(reps[0], reps[1]))
+        return 0
+    if args.trace is None:
+        ap.error("a trace file (or --compare BEFORE AFTER) is required")
     all_events, meta = load_trace(args.trace)
     events = [e for e in all_events if e.get("ph") == "X"]
     rep = stage_report(events, category=args.category)
     mem = memory_report(all_events)
+    xfer = transfer_report(all_events)
     if rep is None and mem is None:
         print(
             f"no {args.category!r} events in {args.trace} "
@@ -415,6 +547,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.json:
         out = dict(rep or {})
         out["memory"] = mem
+        out["transfers"] = xfer
         out["dropped_events"] = dropped
         json.dump(out, sys.stdout, indent=2, sort_keys=True)
         print()
@@ -428,6 +561,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         if rep is not None:
             print(format_report(rep))
+        if xfer is not None:
+            print(
+                f"\nh2d hidden behind compute: "
+                f"{xfer['h2d_hidden_bytes']:.0f} / "
+                f"{xfer['h2d_bytes']:.0f} B ({xfer['hidden_pct']:.1%} "
+                f"of upload bytes overlapped a running stage)"
+            )
         if mem is not None:
             print(format_memory_report(mem))
     return 0
