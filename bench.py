@@ -393,6 +393,18 @@ def _measure(platform: str) -> dict:
         out.update(_overload_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["overload_bench_error"] = str(e)[:120]
+    # Mesh observability probe (both platforms; the workers pin a
+    # virtual-CPU mesh either way): a 2-process multihost sort with the
+    # mesh trace plane armed, reduced by tools/mesh_report.py to the
+    # shuffle-byte, skew and straggler numbers ROADMAP #2's
+    # compressed-payload shuffle rework must move — with the folded
+    # ClusterManifest riding the round as provenance (a MULTICHIP round
+    # without one, or with any host degraded, never updates a headline —
+    # BENCH_NOTES).
+    try:
+        out.update(_multichip_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["multichip_bench_error"] = str(e)[:120]
     # Robustness diagnostics (both platforms): the salvage policy layer's
     # cost on a clean file (must be ≈0 — the disarmed seams and the
     # strict-first fast path are the design) and whether a sort over a
@@ -696,6 +708,100 @@ def _overload_bench(tmp: str) -> dict:
     }
 
 
+_MULTICHIP_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; out = sys.argv[5]; trace_dir = sys.argv[6]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.parallel import multihost
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+n = multihost.sort_bam_multihost([src], out, ctx=ctx, split_size=1 << 19,
+                                 level=1, mesh_trace=True,
+                                 mesh_trace_dir=trace_dir)
+print(f"MH_BENCH_OK pid={{pid}} n={{n}}", flush=True)
+"""
+
+
+def _multichip_bench(tmp: str) -> dict:
+    """Mesh observability numbers from a real 2-process multihost sort.
+
+    Two OS processes (jax.distributed + gloo, 4 virtual CPU devices
+    each) coordinate-sort a shared corpus with the mesh trace plane
+    armed; ``tools/mesh_report.py`` reduces the collected shards +
+    manifests to ``mh_shuffle_bytes_per_record`` (today: inflated record
+    bytes — the ~4× the compressed-payload shuffle must cut),
+    ``mh_skew_ratio`` (max/mean records per output shard) and
+    ``mh_straggler_overhead_pct`` (cluster host-time lost to barrier
+    waits).  The folded ClusterManifest rides the round verbatim so
+    finalize_round can degrade the round when any host degraded or the
+    byte matrix failed to balance."""
+    import socket
+    import subprocess
+
+    n = int(os.environ.get("HBAM_BENCH_MULTICHIP_RECORDS", "60000"))
+    src = os.path.join(tmp, "multichip_src.bam")
+    synth_bam(src, n)
+    out = os.path.join(tmp, "multichip_sorted.bam")
+    trace_dir = os.path.join(tmp, "multichip_trace")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = _MULTICHIP_WORKER.format(repo=repo)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             src, out, trace_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"MH_BENCH_OK pid={pid}" not in o:
+            raise RuntimeError(
+                f"multichip worker {pid} rc={p.returncode}: {o[-300:]}"
+            )
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hbam_mesh_report",
+        os.path.join(repo, "tools", "mesh_report.py"),
+    )
+    mr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mr)
+    rep = mr.mesh_report(trace_dir)
+    mx = rep["matrix"]
+    st = rep["straggler_table"]
+    return {
+        "mh_hosts": rep["num_hosts"],
+        "mh_records": mx["records"],
+        "mh_shuffle_bytes_per_record": mx["shuffle_bytes_per_record"],
+        "mh_shuffle_bytes_cross_host": mx["shuffle_bytes_cross_host"],
+        "mh_matrix_balanced": mx["balanced"],
+        "mh_skew_ratio": mx["skew_ratio"],
+        "mh_straggler_overhead_pct": st["straggler_overhead_pct"],
+        "mh_critical_path_host": st["critical_path_host"],
+        "mh_cluster_manifest": rep["cluster_manifest"],
+    }
+
+
 def _robustness_bench(tmp: str) -> dict:
     """``salvage_overhead_pct``: salvage-mode sort vs strict on a CLEAN
     file, host backend, min-of-2 interleaved (the policy layer is a
@@ -874,6 +980,16 @@ def finalize_round(result: dict, want: str, probed, error) -> dict:
     man = result.get("run_manifest") or {}
     if man.get("degraded"):
         reasons.extend(f"run manifest: {r}" for r in man.get("reasons", []))
+    # Mesh provenance: a round carrying multichip numbers vouches for
+    # them with its folded ClusterManifest — any degraded host, or a
+    # shuffle byte matrix that failed to balance, degrades the round
+    # (and a MULTICHIP round without a ClusterManifest at all never
+    # updates a headline — BENCH_NOTES "Mesh observability").
+    cm = result.get("mh_cluster_manifest") or {}
+    if cm.get("degraded"):
+        reasons.extend(
+            f"cluster manifest: {r}" for r in cm.get("reasons", [])
+        )
     # Tier counters vs the requested config: a device-labeled round whose
     # measurement process initialized a different jax backend is lying
     # about its platform even if every timer ran.

@@ -190,6 +190,17 @@ ERRORS_MODE = "hadoopbam.errors"
 # var takes precedence (it covers subprocess drills).  Unset = disarmed,
 # and the seams are zero-cost no-ops.
 FAULTS_PLAN = "hadoopbam.faults.plan"
+# Mesh observability plane (parallel/multihost.py): "true" arms every
+# process's timeline tracer for the run, exports a per-host trace shard
+# (trace-h<process_id>.json, clock-anchored at a dedicated barrier) plus
+# a per-host manifest through the shuffle byte plane, and has process 0
+# collect the shards into MESH_TRACE_DIR and fold the host manifests
+# into a ClusterManifest (cluster_manifest.json).  The HBAM_MESH_TRACE /
+# HBAM_MESH_TRACE_DIR env vars cover subprocess workers; unset =
+# disarmed (zero mh.* trace events, byte-identical output).
+# MESH_TRACE_DIR defaults to "<out_path>.mesh-trace".
+MESH_TRACE = "hadoopbam.mesh.trace"
+MESH_TRACE_DIR = "hadoopbam.mesh.trace-dir"
 # Timeline tracer ring capacity (events) for ``--trace`` runs
 # (utils/tracing.Tracer): the per-event buffer is bounded — on overflow
 # the OLDEST events drop (counted in the export's ``dropped_events``)

@@ -51,7 +51,11 @@ Directive reference:
                      adversary for the atomic-rename contract); ``items``,
                      ``attempts``, ``n``.
 ``exec.delay``       sleep ``ms`` inside an attempt; ``items``,
-                     ``attempts``, ``n``.
+                     ``attempts``, ``n``.  Also fired at the multihost
+                     read stage with item = process id and attempt =
+                     local split ordinal — ``items=1`` slows exactly
+                     host 1, the mesh straggler drill
+                     (tools/mesh_report.py must blame that host).
 ``exec.die``         ``os._exit(137)`` — SIGKILL's exit, mid-attempt (the
                      deterministic ``kill -9``); ``items``, ``attempts``,
                      ``n``.
@@ -296,7 +300,10 @@ class FaultPlan:
 
     def exec_attempt(self, item: int, attempt: int, tmp_path: str) -> None:
         """The executor seam: latency, torn tmp files, crashes, or hard
-        process death, per (item, attempt)."""
+        process death, per (item, attempt).  The multihost read stage
+        funnels through the same seam with (process id, split ordinal)
+        so one directive grammar drives both the part-write drills and
+        the mesh straggler/dead-host drills."""
         d = self._fire("exec.delay", item=item, attempt=attempt)
         if d is not None:
             time.sleep(d.int_param("ms", 100) / 1e3)

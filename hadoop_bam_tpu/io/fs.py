@@ -182,7 +182,11 @@ class HttpFilesystem(Filesystem):
     host-side, trading bandwidth for compatibility.
 
     ``headers`` ride every request (e.g. auth tokens); ``timeout`` is per
-    request, and transient failures retry ``retries`` times.
+    request, and transient failures retry ``retries`` times.  Each retry
+    counts ``retry_metric`` (default ``fs.http.retries``) — the retry
+    loop used to be silent, which hid flaky byte planes: the multihost
+    shuffle fetch passes ``mh.http.fetch_retries`` so its grace shows up
+    in the mesh manifests instead of vanishing.
     """
 
     def __init__(
@@ -190,10 +194,12 @@ class HttpFilesystem(Filesystem):
         headers: Optional[Dict[str, str]] = None,
         timeout: float = 60.0,
         retries: int = 2,
+        retry_metric: str = "fs.http.retries",
     ) -> None:
         self._headers = dict(headers or {})
         self._timeout = timeout
         self._retries = retries
+        self._retry_metric = retry_metric
         self._size_cache: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -213,7 +219,7 @@ class HttpFilesystem(Filesystem):
         import urllib.request
 
         last: Optional[Exception] = None
-        for _ in range(self._retries + 1):
+        for attempt in range(self._retries + 1):
             req = urllib.request.Request(url, method=method)
             for k, v in {**self._headers, **headers}.items():
                 req.add_header(k, v)
@@ -235,6 +241,11 @@ class HttpFilesystem(Filesystem):
                     break
             except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
                 last = e
+            if attempt < self._retries:
+                # A retry is about to happen: count it (the loop used to
+                # swallow these — a flaky plane looked identical to a
+                # clean one until it finally gave up).
+                METRICS.count(self._retry_metric, 1)
         raise OSError(f"HTTP {method} {url} failed: {last}") from last
 
     # -- the three primitives ----------------------------------------------

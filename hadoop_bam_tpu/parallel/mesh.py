@@ -21,6 +21,18 @@ def data_axis() -> str:
     return DATA_AXIS
 
 
+def process_of_device(
+    global_dev, local_device_count: int
+):
+    """Owning process of a global mesh device (scalar or array).
+
+    Valid under the mesh-contiguity contract ``sort_bam_multihost``
+    verifies (each process's devices occupy ``[pid*L, (pid+1)*L)`` in
+    ``jax.devices()`` order); the shuffle byte/key accounting maps
+    destination devices to destination processes through this."""
+    return global_dev // local_device_count
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

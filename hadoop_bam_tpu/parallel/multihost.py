@@ -35,7 +35,9 @@ sort of the same input.
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -43,11 +45,11 @@ import numpy as np
 
 import jax
 
-from .. import native
+from .. import faults, native
 from ..utils import nio
-from ..utils.tracing import METRICS, span
-from .mesh import DATA_AXIS, make_mesh
-from .shuffle import DistributedSort
+from ..utils.tracing import METRICS, TRACER, span, trace_ctx
+from .mesh import DATA_AXIS, make_mesh, process_of_device
+from .shuffle import KEY_ROW_BYTES, DistributedSort
 
 
 @dataclass
@@ -76,9 +78,22 @@ class MultihostContext:
         ]
 
     def barrier(self, name: str) -> None:
+        """Named global barrier, timed three ways: a cumulative span +
+        ``mh.barrier.<name>`` log2 histogram (milliseconds) in METRICS,
+        and — with the timeline tracer armed — a ``category="stage"``
+        trace event whose *start* is this host's arrival.  Barriers are
+        exactly where stragglers hide: on the merged mesh timeline the
+        host that arrived last at a barrier is the one every other
+        host's wait should be blamed on, which is precisely what
+        ``tools/mesh_report.py`` computes from these events."""
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        t0 = time.perf_counter()
+        with span(f"mh.barrier.{name}", category="stage"):
+            multihost_utils.sync_global_devices(name)
+        METRICS.observe(
+            f"mh.barrier.{name}", (time.perf_counter() - t0) * 1e3
+        )
 
     def allgather_counts(self, n: int) -> np.ndarray:
         """[num_processes] int64 — one scalar contributed per process."""
@@ -122,10 +137,23 @@ def initialize(
     )
 
 
-#: Debug/observability: per-process stats of the last sort_bam_multihost
-#: call (budget mode records its accounted peak of materialized record
-#: bytes here; tests assert against it).
+#: Thin debug view of the last sort_bam_multihost call (budget mode's
+#: accounted peak of materialized record bytes; tests assert against it).
+#: Retired into the mesh manifests: the authoritative per-host record is
+#: :data:`LAST_MANIFEST` (this process) and — on process 0 — the folded
+#: :data:`LAST_CLUSTER_MANIFEST`; ``peak_bytes`` also rides the
+#: ``mh.peak_bytes`` gauge so the metrics plane stays single-sourced
+#: through utils/tracing.
 LAST_STATS: dict = {}
+
+#: This process's host manifest from the last mesh-traced run ({} until
+#: one completes): run_manifest + byte/key matrices row + barrier waits.
+LAST_MANIFEST: dict = {}
+
+#: Process 0 only: the folded ClusterManifest dict of the last
+#: mesh-traced run ({} elsewhere / until one completes).  The CLI's
+#: ``--metrics`` report and the MULTICHIP bench rounds attach it.
+LAST_CLUSTER_MANIFEST: dict = {}
 
 
 # ---------------------------------------------------------------------------
@@ -181,6 +209,7 @@ def _serve_dir(directory: str, token: str):
             return p
 
         def do_HEAD(self):
+            METRICS.count("mh.http.requests", 1)
             p = self._path()
             if p is None:
                 return
@@ -190,11 +219,16 @@ def _serve_dir(directory: str, token: str):
             self.end_headers()
 
         def do_GET(self):
+            # Server-side fetch accounting (Hadoop's shuffle servlet has
+            # the same counters): requests, range-vs-whole, bytes out.
+            METRICS.count("mh.http.requests", 1)
             p = self._path()
             if p is None:
                 return
             size = os.path.getsize(p)
             rng = self.headers.get("Range")
+            if rng:
+                METRICS.count("mh.http.range_requests", 1)
             lo, hi = 0, size - 1
             status = 200
             if rng:
@@ -230,6 +264,7 @@ def _serve_dir(directory: str, token: str):
                         break
                     self.wfile.write(chunk)
                     remaining -= len(chunk)
+            METRICS.count("mh.http.bytes_served", n - remaining)
 
     # Peers must reach this address: the hostname by default (resolvable
     # on real clusters), HBAM_SHUFFLE_HOST to override (tests pin
@@ -301,6 +336,15 @@ def _write_byte_runs(
     body) ascending by *global source row*, plus ``.rows``/``.offs``
     sidecars so receivers can binary-search any (src_dev, src_row)
     reference the key shuffle hands them.
+
+    Sender side of the shuffle byte matrix: the ``.bin`` payload bytes
+    addressed to each destination process count ``mh.shuffle.sent.<dst>``
+    (the diagonal is this process's own share — it moves by local read,
+    not the network) and, with the tracer armed, land as cumulative
+    ``mh.shuffle.sent`` counter-track samples so Perfetto renders a
+    per-peer outgoing-bytes series.  The receiver measures the same
+    edges independently (``mh.shuffle.recv.<src>``); mesh_report and the
+    ClusterManifest assert the two sides agree per edge.
     """
     L = ctx.local_device_count
     first_global_dev = ctx.process_id * L
@@ -310,8 +354,9 @@ def _write_byte_runs(
         * rows_per_device
         + (row_of_record % rows_per_device).astype(np.int64)
     )
-    dest_proc = dest_dev // L
+    dest_proc = process_of_device(dest_dev, L)
     lens = batch.soa["rec_len"].astype(np.int64) + 4
+    sent_track: dict = {}
     for q in range(ctx.num_processes):
         sel = np.nonzero(dest_proc == q)[0]
         order = sel[np.argsort(g_row[sel], kind="stable")]
@@ -324,6 +369,9 @@ def _write_byte_runs(
         offs = np.empty(len(order) + 1, dtype=np.int64)
         offs[0] = 0
         np.cumsum(lens[order], out=offs[1:])
+        METRICS.count(f"mh.shuffle.sent.{q}", int(offs[-1]))
+        sent_track[str(q)] = float(offs[-1])
+        TRACER.counter("mh.shuffle.sent", sent_track)
         base = _bytes_file(shuffle_dir, ctx.process_id, q)
         for path, payload, rawbytes in (
             (base + ".bin", stream, True),
@@ -363,19 +411,30 @@ class _ByteFetcher:
             name = _bytes_name(s, ctx.process_id)
             if isinstance(sources[s], tuple):
                 url, token = sources[s]
-                f = HttpFilesystem(headers={"X-Hbam-Token": token})
+                f = HttpFilesystem(
+                    headers={"X-Hbam-Token": token},
+                    retry_metric="mh.http.fetch_retries",
+                )
                 base = url.rstrip("/")
-                return (
+                got = (
                     np.frombuffer(
                         f.read_all(f"{base}/{name}.bin"), dtype=np.uint8
                     ),
                     np.load(_io.BytesIO(f.read_all(f"{base}/{name}.rows"))),
                     np.load(_io.BytesIO(f.read_all(f"{base}/{name}.offs"))),
                 )
-            p = os.path.join(sources[s], name)
-            with open(p + ".bin", "rb") as fh:
-                buf = np.frombuffer(fh.read(), dtype=np.uint8)
-            return buf, np.load(p + ".rows"), np.load(p + ".offs")
+            else:
+                p = os.path.join(sources[s], name)
+                with open(p + ".bin", "rb") as fh:
+                    buf = np.frombuffer(fh.read(), dtype=np.uint8)
+                got = buf, np.load(p + ".rows"), np.load(p + ".offs")
+            # Receiver side of the shuffle byte matrix, measured from the
+            # bytes that actually arrived (not inferred from the sender).
+            METRICS.count(f"mh.shuffle.recv.{s}", int(len(got[0])))
+            TRACER.counter(
+                "mh.shuffle.recv", {str(s): float(len(got[0]))}
+            )
+            return got
 
         # Pull peers concurrently (Hadoop's parallel copier): the fetch
         # phase is network-bound, not peer-count-bound.
@@ -553,7 +612,7 @@ def _budget_byte_plane(
     peak_bytes: int,
     RecordBatch,
     write_part_fast,
-) -> int:
+) -> Tuple[int, List[int]]:
     """Out-of-core byte plane: the key-sorted spill runs ARE the shuffle.
 
     The shuffle's destination is a monotone function of the key, so each
@@ -564,7 +623,14 @@ def _budget_byte_plane(
     straight off the shared filesystem, or over authenticated HTTP range
     reads when the runs live on peers' local disks (``sources`` carries a
     directory or endpoint per process) — so peak materialized bytes is
-    one device's output, not the received shard."""
+    one device's output, not the received shard.
+
+    Returns ``(peak_bytes, records per local output device)``.  The
+    shuffle byte matrix is measured on both sides here too: the sender's
+    ``mh.shuffle.sent.<dst>`` comes from its own runs' byte offsets at
+    the cut indices (the runs ARE the byte plane, so the slice byte
+    spans are the shipped bytes), the receiver's ``mh.shuffle.recv.<src>``
+    from the slice bytes it actually read."""
     P_ = ctx.num_processes
     L = ctx.local_device_count
     n_runs_of = [
@@ -579,10 +645,32 @@ def _budget_byte_plane(
         cuts[j] = np.searchsorted(dr, np.arange(D + 1), side="left")
         rbase += c
     cuts_all = ctx.allgather_array(cuts)  # [P, max_runs, D+1]
+    # Sender side of the byte matrix: this process's runs live on local
+    # (or shared) disk — the bytes destination process q will pull are
+    # the runs' byte spans between q's device cuts, read off the
+    # memmapped offset sidecars (no record bytes touched).
+    from ..io import runs as runs_mod
+
+    own_dir = sources[ctx.process_id]
+    sent_bytes = np.zeros(P_, dtype=np.int64)
+    for j in range(len(own_counts)):
+        run = runs_mod.Run.open(own_dir, j)
+        for q in range(P_):
+            sent_bytes[q] += run.bytes_between(
+                int(cuts[j][q * L]), int(cuts[j][(q + 1) * L])
+            )
+    for q in range(P_):
+        METRICS.count(f"mh.shuffle.sent.{q}", int(sent_bytes[q]))
+    TRACER.counter(
+        "mh.shuffle.sent",
+        {str(q): float(sent_bytes[q]) for q in range(P_)},
+    )
     ctx.barrier("spill_published")
 
     access = [_RunAccess(src) for src in sources]
-    with span("mh.range_merge"):
+    recv_bytes = np.zeros(P_, dtype=np.int64)
+    out_counts: List[int] = []
+    with span("mh.range_merge", category="stage"):
         for g in range(ctx.process_id * L, (ctx.process_id + 1) * L):
             # Two passes over this device's slices: size everything, then
             # read each slice DIRECTLY into its place in one final buffer
@@ -601,6 +689,7 @@ def _budget_byte_plane(
                         j, i0, i1
                     )
                     slices.append((s, j, b0, sz))
+                    recv_bytes[s] += sz
                     key_parts.append(keys_s)
                     org_parts.append(org_s)
                     len_parts.append(lens_s)
@@ -640,13 +729,302 @@ def _budget_byte_plane(
                     data=np.empty(0, np.uint8),
                     keys=np.empty(0, np.int64),
                 )
+            out_counts.append(int(batch.n_records))
             tmp = os.path.join(td, f"_temporary.part-r-{g:05d}")
             with open(tmp, "wb") as f:
                 write_part_fast(f, batch, order=perm, level=level)
             os.replace(tmp, os.path.join(td, f"part-r-{g:05d}"))
             del batch
+    for s in range(P_):
+        METRICS.count(f"mh.shuffle.recv.{s}", int(recv_bytes[s]))
+    TRACER.counter(
+        "mh.shuffle.recv",
+        {str(s): float(recv_bytes[s]) for s in range(P_)},
+    )
     ctx.barrier("parts_written")
-    return peak_bytes
+    return peak_bytes, out_counts
+
+
+# ---------------------------------------------------------------------------
+# Mesh observability: per-host trace shards + manifests + the cluster fold.
+# ---------------------------------------------------------------------------
+
+
+def _shard_name(pid: int) -> str:
+    return f"trace-h{pid:03d}.json"
+
+
+def _manifest_name(pid: int) -> str:
+    return f"manifest-h{pid:03d}.json"
+
+
+def _read_from_source(source, name: str) -> bytes:
+    """One named flat file from a byte-plane source: a local/shared
+    directory, or an ``(url, token)`` endpoint — the same retrieval the
+    ``shufbytes-*`` runs ride."""
+    if isinstance(source, tuple):
+        from ..io.fs import HttpFilesystem
+
+        url, token = source
+        f = HttpFilesystem(
+            headers={"X-Hbam-Token": token},
+            retry_metric="mh.http.fetch_retries",
+        )
+        return f.read_all(f"{url.rstrip('/')}/{name}")
+    with open(os.path.join(source, name), "rb") as fh:
+        return fh.read()
+
+
+class _MeshObservability:
+    """The distributed observability plane of one ``sort_bam_multihost``
+    call (ISSUE 14 tentpole).
+
+    Armed (``mesh_trace``): every process arms the process-global
+    :data:`TRACER` (unless the caller already did), anchors its trace
+    clock at a dedicated ``trace_sync`` barrier — the per-host anchors
+    are exchanged via ``allgather_array`` and stamped into each shard's
+    ``otherData`` so ``tools/mesh_report.py`` can shift all shards onto
+    one merged timeline — and, after the parts are written, exports
+    ``trace-h<pid>.json`` + ``manifest-h<pid>.json`` into its byte-plane
+    directory.  Process 0 then pulls every shard through the same
+    locator list the ``shufbytes-*`` files use (local read or
+    authenticated HTTP), drops them into ``trace_dir``, and folds the
+    host manifests into a :class:`~..utils.tracing.ClusterManifest`
+    (written as ``cluster_manifest.json`` and kept in
+    :data:`LAST_CLUSTER_MANIFEST`).
+
+    Disarmed (the default): every method returns immediately — no extra
+    barriers, no exports, zero ``mh.shuffle.*`` / ``mh.barrier.*`` trace
+    events (the METRICS counters/gauges are the always-on metrics plane,
+    like the transfers ledger) and byte-identical output.
+    """
+
+    def __init__(self, ctx: MultihostContext, enabled: bool,
+                 trace_dir: str, byte_plane: str, conf, budget: bool):
+        self.ctx = ctx
+        self.enabled = enabled
+        self.trace_dir = trace_dir
+        self.byte_plane = byte_plane
+        self.conf = conf
+        self.budget = budget
+        self._started = False
+        self.anchor_us = 0.0
+        self.anchors: Optional[np.ndarray] = None
+        self._peer_manifests: dict = {}
+        self._mesh_meta: dict = {}
+        self._before = None
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self) -> None:
+        """Arm the tracer and anchor every host's clock at one barrier."""
+        if not self.enabled:
+            return
+        from ..utils.tracing import snapshot
+
+        self._before = snapshot()
+        if not TRACER.armed:
+            from ..utils.tracing import DEFAULT_TRACE_EVENTS
+
+            cap = DEFAULT_TRACE_EVENTS
+            if self.conf is not None:
+                from ..conf import TRACE_EVENTS
+
+                cap = self.conf.get_int(TRACE_EVENTS, DEFAULT_TRACE_EVENTS)
+            TRACER.start(capacity=cap)
+            self._started = True
+        # The shards' shared clock: every host leaves this barrier at
+        # ~the same wall instant and stamps its own ring clock; shifting
+        # each shard so the anchors coincide puts all hosts on one
+        # timeline (collective-exit skew is the alignment error bound).
+        self.ctx.barrier("trace_sync")
+        self.anchor_us = float(TRACER.now_us())
+        self.anchors = self.ctx.allgather_array(
+            np.asarray([self.anchor_us], dtype=np.float64)
+        ).reshape(-1)
+
+    def stage_barrier(self, name: str) -> None:
+        """An alignment barrier the observability plane inserts so
+        per-stage skew is measured at a named point (the read stage's
+        stragglers would otherwise smear into whichever collective runs
+        next and be blamed on the wrong host).  No-op when disarmed."""
+        if self.enabled:
+            self.ctx.barrier(name)
+
+    # -- manifests ---------------------------------------------------------
+
+    def host_manifest(self, peak_bytes: int, n_local: int,
+                      out_counts: List[int], skew_ratio: float) -> dict:
+        from ..utils.tracing import delta, run_manifest
+
+        d = delta(self._before) if self._before is not None else {
+            "counters": METRICS.report()["counters"], "span_seconds": {},
+        }
+        counters = d.get("counters", {})
+        spans = d.get("span_seconds", {})
+
+        def _edges(prefix: str) -> dict:
+            return {
+                k[len(prefix):]: int(v)
+                for k, v in counters.items()
+                if k.startswith(prefix)
+            }
+
+        return {
+            "host": self.ctx.process_id,
+            "num_processes": self.ctx.num_processes,
+            "byte_plane": self.byte_plane,
+            "memory_budget": self.budget,
+            "peak_bytes": int(peak_bytes),
+            "records_local": int(n_local),
+            "records_out": [int(c) for c in out_counts],
+            "skew_ratio": float(skew_ratio),
+            "shuffle_sent_bytes": _edges("mh.shuffle.sent."),
+            "shuffle_recv_bytes": _edges("mh.shuffle.recv."),
+            "keys_sent_bytes": _edges("mh.keys.sent."),
+            "keys_recv_bytes": _edges("mh.keys.recv."),
+            "barrier_wait_ms": {
+                k[len("mh.barrier."):]: round(v * 1e3, 3)
+                for k, v in spans.items()
+                if k.startswith("mh.barrier.")
+            },
+            "http": {
+                k[len("mh.http."):]: int(v)
+                for k, v in counters.items()
+                if k.startswith("mh.http.")
+            },
+            "anchor_us": self.anchor_us,
+            "run_manifest": run_manifest(
+                backend="multihost", conf=self.conf, counters=counters
+            ).as_dict(),
+        }
+
+    # -- publication + collection ------------------------------------------
+
+    def publish(self, serve_dir: str, sources: List, peak_bytes: int,
+                n_local: int, out_counts: List[int],
+                skew_ratio: float) -> None:
+        """Export this host's shard + manifest into its byte-plane
+        directory, then (process 0) collect every peer's into
+        ``trace_dir``.  Called after ``parts_written`` and *before* the
+        byte-plane directories are deleted."""
+        if not self.enabled:
+            return
+        pid = self.ctx.process_id
+        mesh_meta = {
+            "mesh": {
+                "host": pid,
+                "num_hosts": self.ctx.num_processes,
+                "anchor_us": self.anchor_us,
+                "anchors_us": [float(a) for a in (
+                    self.anchors if self.anchors is not None else []
+                )],
+                "byte_plane": self.byte_plane,
+            }
+        }
+        self._mesh_meta = mesh_meta
+        manifest = self.host_manifest(
+            peak_bytes, n_local, out_counts, skew_ratio
+        )
+        global LAST_MANIFEST
+        LAST_MANIFEST = manifest
+        TRACER.export_chrome(
+            os.path.join(serve_dir, _shard_name(pid)), other=mesh_meta
+        )
+        with open(os.path.join(serve_dir, _manifest_name(pid)), "w") as f:
+            json.dump(manifest, f)
+        self.ctx.barrier("trace_published")
+        if pid == 0:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            for s in range(1, self.ctx.num_processes):
+                blob = _read_from_source(sources[s], _shard_name(s))
+                with open(
+                    os.path.join(self.trace_dir, _shard_name(s)), "wb"
+                ) as f:
+                    f.write(blob)
+                mblob = _read_from_source(sources[s], _manifest_name(s))
+                self._peer_manifests[s] = json.loads(mblob.decode())
+                with open(
+                    os.path.join(self.trace_dir, _manifest_name(s)), "wb"
+                ) as f:
+                    f.write(mblob)
+        # Peers must not tear their serve dirs down under host 0's
+        # collection — everyone holds until the shards are safely out.
+        self.ctx.barrier("trace_collected")
+
+    def finalize(self, peak_bytes: int, n_local: int,
+                 out_counts: List[int], skew_ratio: float) -> None:
+        """After the merge: process 0 re-exports its own shard (now
+        covering ``mh.merge``) straight into ``trace_dir``, folds the
+        host manifests into the ClusterManifest, and writes
+        ``cluster_manifest.json``; every process disarms the tracer it
+        started."""
+        if not self.enabled:
+            return
+        try:
+            if self.ctx.process_id == 0:
+                from ..utils.tracing import cluster_manifest
+
+                os.makedirs(self.trace_dir, exist_ok=True)
+                own = self.host_manifest(
+                    peak_bytes, n_local, out_counts, skew_ratio
+                )
+                global LAST_MANIFEST, LAST_CLUSTER_MANIFEST
+                LAST_MANIFEST = own
+                TRACER.export_chrome(
+                    os.path.join(self.trace_dir, _shard_name(0)),
+                    other=self._mesh_meta,
+                )
+                with open(
+                    os.path.join(self.trace_dir, _manifest_name(0)), "w"
+                ) as f:
+                    json.dump(own, f)
+                manifests = [own] + [
+                    self._peer_manifests[s]
+                    for s in sorted(self._peer_manifests)
+                ]
+                cm = cluster_manifest(
+                    manifests, byte_plane=self.byte_plane
+                ).as_dict()
+                LAST_CLUSTER_MANIFEST = cm
+                with open(
+                    os.path.join(self.trace_dir, "cluster_manifest.json"),
+                    "w",
+                ) as f:
+                    json.dump(cm, f, indent=2, sort_keys=True)
+        finally:
+            if self._started:
+                TRACER.stop()
+
+
+def _resolve_mesh_trace(conf, mesh_trace: Optional[bool]) -> bool:
+    """Explicit argument → ``hadoopbam.mesh.trace`` → HBAM_MESH_TRACE."""
+    if mesh_trace is not None:
+        return bool(mesh_trace)
+    if conf is not None:
+        from ..conf import MESH_TRACE
+
+        if conf.get(MESH_TRACE) is not None:
+            return conf.get_boolean(MESH_TRACE, False)
+    env = os.environ.get("HBAM_MESH_TRACE", "").strip().lower()
+    return env not in ("", "0", "false", "off", "no")
+
+
+def _resolve_mesh_trace_dir(
+    conf, mesh_trace_dir: Optional[str], out_path: str
+) -> str:
+    if mesh_trace_dir:
+        return mesh_trace_dir
+    if conf is not None:
+        from ..conf import MESH_TRACE_DIR
+
+        got = conf.get(MESH_TRACE_DIR)
+        if got:
+            return got
+    env = os.environ.get("HBAM_MESH_TRACE_DIR")
+    if env:
+        return env
+    return os.path.abspath(out_path) + ".mesh-trace"
 
 
 # ---------------------------------------------------------------------------
@@ -664,17 +1042,27 @@ def sort_bam_multihost(
     samples_per_device: int = 64,
     memory_budget: Optional[int] = None,
     byte_plane: str = "fs",
+    mesh_trace: Optional[bool] = None,
+    mesh_trace_dir: Optional[str] = None,
 ) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime
     (full docs on the implementation below; resources — shuffle data
     servers, local spill directories — are owned by an ExitStack so every
-    failure path tears them down)."""
+    failure path tears them down).
+
+    ``mesh_trace`` (default: ``hadoopbam.mesh.trace`` conf key /
+    HBAM_MESH_TRACE env, off) arms the mesh observability plane: every
+    process records a per-host timeline shard and a host manifest,
+    process 0 collects them into ``mesh_trace_dir`` (default
+    ``<out_path>.mesh-trace``) and folds a ClusterManifest — reduce with
+    ``tools/mesh_report.py``."""
     import contextlib
 
     with contextlib.ExitStack() as stack:
         return _sort_bam_multihost_impl(
             in_paths, out_path, ctx, conf, split_size, level,
             samples_per_device, memory_budget, byte_plane, stack,
+            mesh_trace, mesh_trace_dir,
         )
 
 
@@ -689,6 +1077,8 @@ def _sort_bam_multihost_impl(
     memory_budget: Optional[int],
     byte_plane: str,
     _stack,
+    mesh_trace: Optional[bool] = None,
+    mesh_trace_dir: Optional[str] = None,
 ) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime.
 
@@ -732,13 +1122,22 @@ def _sort_bam_multihost_impl(
         ctx = initialize()
     if byte_plane not in ("fs", "http"):
         raise ValueError(f"byte_plane must be 'fs' or 'http': {byte_plane!r}")
+    obs = _MeshObservability(
+        ctx,
+        enabled=_resolve_mesh_trace(conf, mesh_trace),
+        trace_dir=_resolve_mesh_trace_dir(conf, mesh_trace_dir, out_path),
+        byte_plane=byte_plane,
+        conf=conf,
+        budget=memory_budget is not None,
+    )
+    obs.arm()
     if memory_budget is not None:
         # A split inflates as one batch: keep it well under the budget
         # (same clamp rule as the single-host external sort).
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
     fmt = BamInputFormat(conf)
     header = read_header(in_paths[0]).with_sort_order("coordinate")
-    with span("mh.plan"):
+    with span("mh.plan", category="stage"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
     mine = ctx.owned(splits)
 
@@ -761,10 +1160,25 @@ def _sort_bam_multihost_impl(
         else:
             os.makedirs(spill_dir, exist_ok=True)
 
+    # The mesh straggler drill's injection point: the PR 7 ``exec.delay``
+    # (/crash/die/torn) directive fires here per split with item = this
+    # process id and attempt = the local split ordinal, so a plan like
+    # ``exec.delay:items=1,ms=250,n=*`` slows exactly host 1's read stage
+    # — the injected-delay drill mesh_report must attribute correctly.
+    _plan = faults.ACTIVE
+    _torn = os.path.join(
+        out_dir_pre, f"_mh_torn_{ctx.process_id:03d}.tmp"
+    )
+
     peak_bytes = 0
     if memory_budget is None:
-        with span("mh.read"):
-            batches = [fmt.read_split(s) for s in mine]
+        with span("mh.read", category="stage"):
+            batches = []
+            for j, s in enumerate(mine):
+                if _plan is not None:
+                    _plan.exec_attempt(ctx.process_id, j, _torn)
+                with trace_ctx(split=ctx.process_id + j * ctx.num_processes):
+                    batches.append(fmt.read_split(s))
             own_counts = [b.n_records for b in batches]
             local = _concat_batches(batches)
             del batches
@@ -776,9 +1190,14 @@ def _sort_bam_multihost_impl(
         own_counts = []
         key_cols: List[np.ndarray] = []
         perm_cols: List[np.ndarray] = []  # per run: the sort permutation
-        with span("mh.read_spill"):
+        with span("mh.read_spill", category="stage"):
             for ri, s in enumerate(mine):
-                b = fmt.read_split(s)
+                if _plan is not None:
+                    _plan.exec_attempt(ctx.process_id, ri, _torn)
+                with trace_ctx(
+                    split=ctx.process_id + ri * ctx.num_processes
+                ):
+                    b = fmt.read_split(s)
                 peak_bytes = max(peak_bytes, int(len(b.data)))
                 perm = np.argsort(b.keys, kind="stable")
                 runs_mod.write_run(spill_dir, ri, b, perm)
@@ -787,6 +1206,10 @@ def _sort_bam_multihost_impl(
                 own_counts.append(b.n_records)
                 del b
         n_local = int(sum(own_counts))
+    # Armed runs align here so read-stage skew is measured at a named
+    # barrier instead of smearing into the counts allgather below (and
+    # being blamed on the wrong host); disarmed runs are unchanged.
+    obs.stage_barrier("read_done")
 
     # Global record ordinals: allgather per-split record counts (padded to
     # the round-robin width) so every process derives the same exclusive
@@ -879,7 +1302,7 @@ def _sort_bam_multihost_impl(
 
     overflow = -1
     cap = None
-    with span("mh.key_shuffle"):
+    with span("mh.key_shuffle", category="stage"):
         while True:
             ds = DistributedSort(
                 ctx.mesh,
@@ -929,6 +1352,30 @@ def _sort_bam_multihost_impl(
     dest_l = np.concatenate(_local_view(res.dest, rows))
     dest_of_record = dest_l[row_of_record]
 
+    # Key-plane byte accounting: routed rows per destination process ×
+    # KEY_ROW_BYTES (the six all_to_all columns).  The sender counts
+    # from its own routing table; the receiver-side column comes from
+    # the allgathered row-count matrix (both sides route identically by
+    # construction — the byte plane below is the independently-measured
+    # matrix the balance assert actually bites on).
+    key_rows = np.bincount(
+        process_of_device(dest_of_record, L), minlength=P_
+    ).astype(np.int64)
+    key_matrix = ctx.allgather_array(key_rows)  # [P, P] rows sent s->q
+    for q in range(P_):
+        METRICS.count(
+            f"mh.keys.sent.{q}", int(key_rows[q]) * KEY_ROW_BYTES
+        )
+    for s in range(P_):
+        METRICS.count(
+            f"mh.keys.recv.{s}",
+            int(key_matrix[s][ctx.process_id]) * KEY_ROW_BYTES,
+        )
+    TRACER.counter(
+        "mh.keys.sent",
+        {str(q): float(key_rows[q] * KEY_ROW_BYTES) for q in range(P_)},
+    )
+
     # td / shuffle_dir were derived from out_path at function entry (the
     # budget spill path needs them before the shuffle).
     if ctx.process_id == 0:
@@ -945,7 +1392,7 @@ def _sort_bam_multihost_impl(
 
             write_dir = _tf.mkdtemp(prefix="hbam_shuf_")
             _stack.callback(nio.delete_recursive, write_dir)
-        with span("mh.byte_shuffle.write"):
+        with span("mh.byte_shuffle.write", category="stage"):
             _write_byte_runs(
                 write_dir, ctx, local, dest_of_record, row_of_record, rows
             )
@@ -953,6 +1400,7 @@ def _sort_bam_multihost_impl(
             sources: List = _start_http_plane(ctx, write_dir, _stack)
         else:
             sources = [shuffle_dir] * ctx.num_processes
+        serve_dir = write_dir
         # The input shard is on disk in destination-keyed runs now; release
         # it so fetch-side peak is ~received-shard, not input+received.
         del local, dest_of_record, row_of_record, dest_l
@@ -960,7 +1408,8 @@ def _sort_bam_multihost_impl(
 
         # Receiver: each local device's sorted rows → one part file each
         # (the ExitStack owns server/spill teardown on every outcome).
-        with span("mh.byte_shuffle.fetch"):
+        out_counts: List[int] = []
+        with span("mh.byte_shuffle.fetch", category="stage"):
             fetcher = _ByteFetcher(sources, ctx, rows)
             cap_rows = res.hi.shape[0] // D
             v_sh = _local_view(res.valid, cap_rows)
@@ -982,6 +1431,7 @@ def _sort_bam_multihost_impl(
                     data=data,
                     keys=keys,
                 )
+                out_counts.append(int(len(sd)))
                 tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
                 with open(tmp, "wb") as f:
                     write_part_fast(f, batch, order=None, level=level)
@@ -989,12 +1439,7 @@ def _sort_bam_multihost_impl(
                     tmp, os.path.join(td, f"part-r-{g_dev:05d}")
                 )
         ctx.barrier("parts_written")
-        if byte_plane == "http":
-            # Every process fetched its share: drop the outgoing shard
-            # now so it does not coexist with the merge on disk (the
-            # ExitStack callback stays as the failure-path backstop;
-            # delete_recursive is idempotent).
-            nio.delete_recursive(write_dir)
+        cleanup_dir = write_dir if byte_plane == "http" else None
     else:
         if byte_plane == "http":
             sources: List = _start_http_plane(ctx, spill_dir, _stack)
@@ -1003,20 +1448,44 @@ def _sort_bam_multihost_impl(
                 os.path.join(shuffle_dir, f"spill-{s:03d}")
                 for s in range(ctx.num_processes)
             ]
-        peak_bytes = _budget_byte_plane(
+        serve_dir = spill_dir
+        peak_bytes, out_counts = _budget_byte_plane(
             ctx, td, sources, splits, own_counts, dest_of_record,
             level, D, peak_bytes, RecordBatch, write_part_fast,
         )
-        if byte_plane == "http":
-            # parts_written barrier has passed inside the plane: the
-            # spill runs are no longer needed by any peer.
-            nio.delete_recursive(spill_dir)
+        cleanup_dir = spill_dir if byte_plane == "http" else None
+
+    # Partition skew: output records per shard (one shard per global
+    # device), allgathered so every host derives the same ratio — the
+    # number the compressed-payload shuffle rework must not regress.
+    oc = np.zeros(L, dtype=np.int64)
+    oc[: len(out_counts)] = out_counts
+    all_oc = ctx.allgather_array(oc).reshape(-1)  # [D]
+    mean_oc = float(all_oc.mean()) if all_oc.size else 0.0
+    skew_ratio = float(all_oc.max()) / mean_oc if mean_oc > 0 else 0.0
+    METRICS.set_gauge("mh.skew_ratio", skew_ratio)
+    # peak_bytes single-sourced through the tracing gauge layer (the
+    # standing constraint); LAST_STATS stays as the thin legacy view.
+    METRICS.set_gauge("mh.peak_bytes", float(peak_bytes))
     LAST_STATS["peak_bytes"] = peak_bytes
 
+    # Mesh observability: shard + manifest out through the byte plane,
+    # host 0 collects — must run before the plane directories go away.
+    obs.publish(
+        serve_dir, sources, peak_bytes, n_local, out_counts, skew_ratio
+    )
+    if cleanup_dir is not None:
+        # Every process fetched its share (and host 0 its shards): drop
+        # the outgoing/local-spill dir now so it does not coexist with
+        # the merge on disk (the ExitStack callback stays as the
+        # failure-path backstop; delete_recursive is idempotent).
+        nio.delete_recursive(cleanup_dir)
+
     if ctx.process_id == 0:
-        with span("mh.merge"):
+        with span("mh.merge", category="stage"):
             nio.write_success(td)
             merge_bam_parts(td, out_path, header)
             nio.delete_recursive(td)
+    obs.finalize(peak_bytes, n_local, out_counts, skew_ratio)
     ctx.barrier("merged")
     return n_total

@@ -46,6 +46,16 @@ from .mesh import DATA_AXIS
 _HI_PAD = np.int32(0x7FFFFFFF)
 _LO_PAD = np.uint32(0xFFFFFFFF)
 
+#: Bytes one routed row carries across the six ``all_to_all`` buffers
+#: (hi int32 + lo uint32 + valid bool + src_dev int32 + src_row int32 +
+#: org int32) — the key-plane cost of shipping one record's key to its
+#: destination.  The multihost byte accounting (``mh.keys.sent.<dst>`` /
+#: ``mh.keys.recv.<src>``) multiplies routed-row counts by this; the
+#: padding slots of the fixed ``[D, capacity]`` send buffers also cross
+#: the wire but carry no record, so they are deliberately excluded — the
+#: matrix reports payload, capacity headroom is a tuning knob.
+KEY_ROW_BYTES = 21
+
 
 class ShuffleResult(NamedTuple):
     hi: jax.Array  # int32[D*C] sorted within+across devices

@@ -507,22 +507,37 @@ class Tracer:
                 out.append(e)
         return out
 
+    def now_us(self) -> float:
+        """The current timestamp on the armed ring's clock (microseconds
+        since :meth:`start`; 0.0 when disarmed) — the mesh trace shards'
+        clock anchor: every host stamps this right after the same global
+        barrier, so ``tools/mesh_report.py`` can shift each shard onto
+        one merged timeline."""
+        if not self.armed:
+            return 0.0
+        return (time.perf_counter() - self._epoch) * 1e6
+
     def drops_snapshot(self) -> Tuple[int, Dict[str, int]]:
         """``(total dropped, per-category dropped)`` — taken together so
         exemplar completeness verdicts see one consistent view."""
         with self._lock:
             return self.dropped_events, dict(self.dropped_by_category)
 
-    def export_chrome(self, path_or_stream) -> int:
-        """Write the Chrome trace-event JSON; returns the event count."""
+    def export_chrome(self, path_or_stream, other: Optional[dict] = None) -> int:
+        """Write the Chrome trace-event JSON; returns the event count.
+        ``other`` merges extra keys into ``otherData`` (the mesh shards
+        carry their host id and clock anchor there)."""
         evs = self.chrome_events()
+        other_data = {
+            "dropped_events": self.dropped_events,
+            "dropped_by_category": dict(self.dropped_by_category),
+        }
+        if other:
+            other_data.update(other)
         doc = {
             "traceEvents": evs,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "dropped_events": self.dropped_events,
-                "dropped_by_category": dict(self.dropped_by_category),
-            },
+            "otherData": other_data,
         }
         if hasattr(path_or_stream, "write"):
             json.dump(doc, path_or_stream)
@@ -993,6 +1008,124 @@ def run_manifest(
         conf_deltas=conf_deltas,
         degraded=bool(reasons),
         reasons=reasons,
+    )
+
+
+class ClusterManifest:
+    """Provenance of one multi-host run: every host's :class:`RunManifest`
+    plus its byte-plane accounting, folded into one cluster verdict.
+
+    A mesh round is only as honest as its weakest host — ``degraded`` is
+    True when ANY host's manifest is degraded, when the shuffle byte
+    matrix fails to balance (some edge's sender-side bytes disagree with
+    the receiver-side measurement — lost or duplicated shuffle data), or
+    when a host that should have reported never did.  ``hosts`` keeps the
+    per-host detail (tier decisions, peak_bytes, sent/recv rows) so "which
+    host, and why" stays answerable from the artifact alone.  The old
+    module-global ``multihost.LAST_STATS`` dict is retired into this
+    (kept as a thin view for existing tests).
+    """
+
+    def __init__(
+        self,
+        hosts: List[dict],
+        byte_plane: Optional[str] = None,
+        degraded: bool = False,
+        reasons: Optional[List[str]] = None,
+        edges_balanced: bool = True,
+        skew_ratio: Optional[float] = None,
+        shuffle_bytes: int = 0,
+        keys_bytes: int = 0,
+        records: int = 0,
+    ) -> None:
+        self.hosts = hosts
+        self.byte_plane = byte_plane
+        self.degraded = degraded
+        self.reasons = reasons or []
+        self.edges_balanced = edges_balanced
+        self.skew_ratio = skew_ratio
+        self.shuffle_bytes = shuffle_bytes
+        self.keys_bytes = keys_bytes
+        self.records = records
+
+    def as_dict(self) -> dict:
+        return {
+            "num_hosts": len(self.hosts),
+            "hosts": [dict(h) for h in self.hosts],
+            "byte_plane": self.byte_plane,
+            "edges_balanced": self.edges_balanced,
+            "skew_ratio": self.skew_ratio,
+            "shuffle_bytes": self.shuffle_bytes,
+            "keys_bytes": self.keys_bytes,
+            "records": self.records,
+            "degraded": self.degraded,
+            "reasons": list(self.reasons),
+        }
+
+
+def cluster_manifest(
+    host_manifests: List[dict], byte_plane: Optional[str] = None
+) -> ClusterManifest:
+    """Fold per-host mesh manifests into a :class:`ClusterManifest`.
+
+    Each input dict is one host's published manifest (built by
+    ``parallel/multihost.py``): ``host``, ``num_processes``,
+    ``run_manifest`` (a :meth:`RunManifest.as_dict`), ``peak_bytes``,
+    ``records_local``, ``records_out`` (per local device),
+    ``shuffle_sent_bytes`` / ``shuffle_recv_bytes`` (per peer process,
+    measured independently on each side of every edge), the key-plane
+    twins, ``skew_ratio`` and ``barrier_wait_ms``.  Pure function of its
+    inputs so tests can drive it with synthetic host sets."""
+    hosts = sorted((dict(h) for h in host_manifests), key=lambda h: h.get("host", 0))
+    reasons: List[str] = []
+    n_expect = max(
+        [len(hosts)] + [int(h.get("num_processes", 0)) for h in hosts]
+    )
+    seen = {int(h.get("host", -1)) for h in hosts}
+    for p in range(n_expect):
+        if p not in seen:
+            reasons.append(f"host {p} never published a manifest")
+    for h in hosts:
+        rm = h.get("run_manifest") or {}
+        if rm.get("degraded"):
+            why = "; ".join(rm.get("reasons", [])) or "unspecified"
+            reasons.append(f"host {h.get('host')} degraded: {why}")
+    # The byte matrix must balance: what host s measured writing for q
+    # must equal what host q measured fetching from s, per edge.
+    edges_balanced = True
+    shuffle_bytes = 0
+    for hs in hosts:
+        s = hs.get("host")
+        sent = hs.get("shuffle_sent_bytes") or {}
+        for hq in hosts:
+            q = hq.get("host")
+            b_sent = int(sent.get(str(q), 0))
+            b_recv = int((hq.get("shuffle_recv_bytes") or {}).get(str(s), 0))
+            shuffle_bytes += b_sent
+            if b_sent != b_recv:
+                edges_balanced = False
+                reasons.append(
+                    f"shuffle byte matrix imbalanced on edge {s}->{q}: "
+                    f"sent {b_sent} != received {b_recv}"
+                )
+    keys_bytes = sum(
+        int(b)
+        for h in hosts
+        for b in (h.get("keys_sent_bytes") or {}).values()
+    )
+    records = sum(int(h.get("records_local", 0)) for h in hosts)
+    skews = [h["skew_ratio"] for h in hosts if h.get("skew_ratio")]
+    return ClusterManifest(
+        hosts=hosts,
+        byte_plane=byte_plane
+        or (hosts[0].get("byte_plane") if hosts else None),
+        degraded=bool(reasons),
+        reasons=reasons,
+        edges_balanced=edges_balanced,
+        skew_ratio=max(skews) if skews else None,
+        shuffle_bytes=shuffle_bytes,
+        keys_bytes=keys_bytes,
+        records=records,
     )
 
 

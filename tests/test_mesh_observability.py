@@ -1,0 +1,488 @@
+"""Mesh observability plane (ISSUE 14): per-host trace shards, the
+shuffle byte matrix, straggler attribution, and the cluster run manifest.
+
+Three layers of coverage:
+
+- **tools/mesh_report.py units** on the checked-in 2-host fixture
+  (tests/data/mesh_trace): clock-anchored shard merge, straggler naming,
+  barrier-wait blame, byte-matrix balance + imbalance detection, and the
+  ClusterManifest fold drills (degraded host propagates, imbalanced edge
+  degrades, missing host degrades);
+- **in-process runs** on the 8-device test mesh (one process — the same
+  SPMD program): armed runs publish shards/manifests/gauges and stay
+  byte-identical to disarmed runs; budget mode routes ``peak_bytes``
+  through the ``mh.peak_bytes`` gauge; the HTTP byte-plane server counts
+  requests/bytes/ranges and the fetch path counts its retries;
+- the **2-process spawned dryrun** (CPU, gloo, HTTP byte plane, tiny
+  corpus per the interpret-mode test-budget note): merged trace loads,
+  per-edge sent==recv, skew computed, the injected ``exec.delay`` drill
+  (PR 7 fault seam, item = process id) makes mesh_report name host 1 the
+  straggler, and host 1's injected degradation propagates into the
+  ClusterManifest — with output still byte-identical to the
+  single-process oracle.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+from bench import synth_bam  # noqa: E402
+
+FIXTURE = REPO / "tests" / "data" / "mesh_trace"
+
+
+def _load_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh_report_mod():
+    return _load_module(REPO / "tools" / "mesh_report.py", "mesh_report")
+
+
+# ---------------------------------------------------------------------------
+# mesh_report units on the checked-in fixture.
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_merge_aligns_clocks(mesh_report_mod):
+    """Shards shift so the trace_sync anchors coincide and every event
+    is re-labeled pid=host (one Perfetto lane per host)."""
+    mr = mesh_report_mod
+    shards = mr.load_shards(str(FIXTURE))
+    assert [s["host"] for s in shards] == [0, 1]
+    merged, info = mr.merge_shards(shards)
+    # Host 1's anchor was 5000us vs host 0's 1000us: shifted by -4000.
+    assert info["shifts_us"][1] == -4000.0
+    by_host = {}
+    for e in merged:
+        if e.get("ph") == "X" and e["name"] == "mh.read":
+            by_host[e["pid"]] = e["ts"]
+    # Both hosts left trace_sync at ~the same instant, so both reads
+    # start at the same merged timestamp.
+    assert by_host[0] == by_host[1] == 1000.0
+    # Lane metadata present for Perfetto.
+    assert any(
+        e.get("ph") == "M" and e["args"]["name"] == "host 1"
+        for e in merged
+    )
+
+
+def test_fixture_straggler_table(mesh_report_mod):
+    mr = mesh_report_mod
+    merged, _ = mr.merge_shards(mr.load_shards(str(FIXTURE)))
+    st = mr.straggler_table(merged)
+    # Host 1's read ran 2450us vs 500us: critical path + straggler.
+    assert st["critical_path_host"] == 1
+    assert st["straggler"]["host"] == 1
+    # read_done: host 0 waited 2ms for host 1 — blamed on host 1.
+    b = st["barriers"]["read_done"]
+    assert b["straggler"] == 1
+    assert b["blamed_ms"] == pytest.approx(2.0, abs=1e-6)
+    assert st["stages"]["mh.read"]["1"] == pytest.approx(2.45, abs=1e-6)
+    # Barriers are attributed, not counted as stage busy.
+    assert "mh.barrier.read_done" not in st["stages"]
+    assert 0 < st["straggler_overhead_pct"] < 100
+
+
+def test_fixture_matrix_balance_and_imbalance(mesh_report_mod):
+    mr = mesh_report_mod
+    manifests = mr.load_manifests(str(FIXTURE))
+    mx = mr.byte_matrix(manifests)
+    assert mx["balanced"] and mx["mismatches"] == []
+    assert mx["sent"][0][1] == 200 and mx["recv"][1][0] == 200
+    assert mx["shuffle_bytes"] == 1000
+    assert mx["shuffle_bytes_cross_host"] == 500
+    assert mx["skew_ratio"] == pytest.approx(1.2)
+    assert mx["shuffle_bytes_per_record"] == pytest.approx(10.0)
+    # Lose 10 bytes on the 1->0 edge receiver-side: detected, named.
+    bad = [dict(m) for m in manifests]
+    bad[0] = dict(bad[0], shuffle_recv_bytes={"0": 100, "1": 290})
+    mx2 = mr.byte_matrix(bad)
+    assert not mx2["balanced"]
+    assert mx2["mismatches"] == [
+        {"edge": "1->0", "sent": 300, "recv": 290}
+    ]
+
+
+def test_fixture_cli_end_to_end(mesh_report_mod, tmp_path, capsys):
+    """main() renders the tables, writes a merged Perfetto trace, and
+    returns 0 on a balanced matrix."""
+    merged_out = str(tmp_path / "merged.json")
+    rc = mesh_report_mod.main([str(FIXTURE), "--merged", merged_out])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "straggler: host 1" in out
+    assert "balanced (sent==recv per edge)" in out
+    with open(merged_out) as f:
+        doc = json.load(f)
+    assert {e.get("pid") for e in doc["traceEvents"]} == {0, 1}
+
+
+def test_cluster_manifest_fold_drills():
+    from hadoop_bam_tpu.utils.tracing import cluster_manifest
+
+    with open(FIXTURE / "manifest-h000.json") as f:
+        m0 = json.load(f)
+    with open(FIXTURE / "manifest-h001.json") as f:
+        m1 = json.load(f)
+    cm = cluster_manifest([m0, m1], byte_plane="fs").as_dict()
+    assert not cm["degraded"] and cm["edges_balanced"]
+    assert cm["num_hosts"] == 2 and cm["records"] == 100
+    assert cm["shuffle_bytes"] == 1000 and cm["keys_bytes"] == 210
+    # One degraded host degrades the cluster, with the host named.
+    m1_bad = dict(
+        m1,
+        run_manifest=dict(
+            m1["run_manifest"], degraded=True,
+            reasons=["salvage mode quarantined data"],
+        ),
+    )
+    cm2 = cluster_manifest([m0, m1_bad]).as_dict()
+    assert cm2["degraded"]
+    assert any("host 1 degraded" in r for r in cm2["reasons"])
+    # An imbalanced edge degrades the cluster even with clean hosts.
+    m0_bad = dict(m0, shuffle_recv_bytes={"0": 100, "1": 299})
+    cm3 = cluster_manifest([m0_bad, m1]).as_dict()
+    assert cm3["degraded"] and not cm3["edges_balanced"]
+    assert any("edge 1->0" in r for r in cm3["reasons"])
+    # A host that never published is itself a degradation.
+    cm4 = cluster_manifest([m0]).as_dict()
+    assert cm4["degraded"]
+    assert any("host 1 never published" in r for r in cm4["reasons"])
+
+
+# ---------------------------------------------------------------------------
+# In-process runs on the 8-device test mesh.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bam_20k(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("mesh_obs") / "in.bam")
+    synth_bam(p, 20_000)
+    return p
+
+
+def test_armed_run_publishes_and_stays_byte_identical(bam_20k, tmp_path):
+    """Armed vs disarmed single-process runs: identical output bytes;
+    the armed run leaves shards + manifests + the cluster fold; the
+    disarmed run leaves the tracer disarmed and records zero trace
+    events (the mh.* counters/gauges are the always-on metrics plane)."""
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.parallel import multihost
+    from hadoop_bam_tpu.utils.tracing import METRICS, TRACER
+
+    ctx = multihost.initialize()
+    assert not TRACER.armed
+    out_off = str(tmp_path / "off.bam")
+    multihost.sort_bam_multihost(
+        [bam_20k], out_off, ctx=ctx, split_size=1 << 18, level=1
+    )
+    # Disarmed contract: the tracer never armed, so zero mh.shuffle.* /
+    # mh.barrier.* (or any) trace events were recorded.
+    assert not TRACER.armed and TRACER.events() == []
+
+    out_on = str(tmp_path / "on.bam")
+    td = str(tmp_path / "mesh-trace")
+    multihost.sort_bam_multihost(
+        [bam_20k], out_on, ctx=ctx, split_size=1 << 18, level=1,
+        mesh_trace=True, mesh_trace_dir=td,
+    )
+    assert not TRACER.armed  # the plane stops the tracer it started
+    d1 = native.decompress_all(open(out_on, "rb").read())
+    d2 = native.decompress_all(open(out_off, "rb").read())
+    assert np.array_equal(d1, d2), "mesh trace changed the output"
+
+    names = sorted(os.listdir(td))
+    assert names == [
+        "cluster_manifest.json", "manifest-h000.json", "trace-h000.json",
+    ]
+    with open(os.path.join(td, "trace-h000.json")) as f:
+        shard = json.load(f)
+    mesh = shard["otherData"]["mesh"]
+    assert mesh["host"] == 0 and mesh["num_hosts"] == 1
+    assert mesh["anchor_us"] > 0 and mesh["anchors_us"] == [
+        mesh["anchor_us"]
+    ]
+    evs = shard["traceEvents"]
+    stages = {e["name"] for e in evs if e.get("cat") == "stage"}
+    for want in (
+        "mh.read", "mh.key_shuffle", "mh.byte_shuffle.write",
+        "mh.byte_shuffle.fetch", "mh.merge",
+        "mh.barrier.read_done", "mh.barrier.parts_written",
+    ):
+        assert want in stages, f"missing {want} in {sorted(stages)}"
+    # Per-peer counter tracks rode the ring (ph "C").
+    counter_names = {e["name"] for e in evs if e.get("ph") == "C"}
+    assert {"mh.shuffle.sent", "mh.shuffle.recv",
+            "mh.keys.sent"} <= counter_names
+
+    # The manifest + fold: single host, diagonal-only matrix, balanced.
+    cm = multihost.LAST_CLUSTER_MANIFEST
+    assert cm and not cm["degraded"] and cm["edges_balanced"]
+    assert cm["records"] == 20_000
+    h0 = cm["hosts"][0]
+    assert len(h0["records_out"]) == 8  # one shard per device
+    assert sum(h0["records_out"]) == 20_000
+    assert h0["shuffle_sent_bytes"] == h0["shuffle_recv_bytes"]
+    assert h0["keys_sent_bytes"]["0"] == 20_000 * 21
+    assert h0["barrier_wait_ms"]  # barriers were timed
+    assert multihost.LAST_MANIFEST["host"] == 0
+    # Metrics plane: gauges + the barrier histogram are first-class.
+    g = METRICS.gauges()
+    assert g["mh.skew_ratio"] == pytest.approx(
+        cm["skew_ratio"], rel=1e-6
+    )
+    assert METRICS.histogram("mh.barrier.parts_written") is not None
+
+
+def test_budget_mode_peak_gauge_and_matrix(bam_20k, tmp_path):
+    """Out-of-core mesh sort: peak_bytes rides the mh.peak_bytes gauge
+    (LAST_STATS stays as the thin view) and the spill-run byte matrix
+    balances."""
+    from hadoop_bam_tpu.parallel import multihost
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    ctx = multihost.initialize()
+    td = str(tmp_path / "mesh-trace")
+    budget = 5 << 20
+    multihost.sort_bam_multihost(
+        [bam_20k], str(tmp_path / "b.bam"), ctx=ctx, split_size=1 << 18,
+        level=1, memory_budget=budget, mesh_trace=True, mesh_trace_dir=td,
+    )
+    peak = multihost.LAST_STATS["peak_bytes"]
+    assert 0 < peak <= budget
+    assert METRICS.gauges()["mh.peak_bytes"] == float(peak)
+    cm = multihost.LAST_CLUSTER_MANIFEST
+    assert cm["hosts"][0]["peak_bytes"] == peak
+    assert cm["edges_balanced"] and not cm["degraded"]
+    assert cm["hosts"][0]["memory_budget"] is True
+    assert sum(cm["hosts"][0]["records_out"]) == 20_000
+
+
+def test_conf_and_env_arming(bam_20k, tmp_path, monkeypatch):
+    """hadoopbam.mesh.trace / HBAM_MESH_TRACE resolve like the other
+    toggles: explicit argument > conf key > env var."""
+    from hadoop_bam_tpu.conf import MESH_TRACE, MESH_TRACE_DIR, Configuration
+    from hadoop_bam_tpu.parallel import multihost
+
+    ctx = multihost.initialize()
+    td = str(tmp_path / "via-conf")
+    conf = Configuration(
+        {MESH_TRACE: "true", MESH_TRACE_DIR: td}
+    )
+    multihost.sort_bam_multihost(
+        [bam_20k], str(tmp_path / "c.bam"), ctx=ctx, split_size=1 << 18,
+        level=1, conf=conf,
+    )
+    assert os.path.isfile(os.path.join(td, "cluster_manifest.json"))
+    # Env fallback (the subprocess-worker path).
+    td2 = str(tmp_path / "via-env")
+    monkeypatch.setenv("HBAM_MESH_TRACE", "1")
+    monkeypatch.setenv("HBAM_MESH_TRACE_DIR", td2)
+    multihost.sort_bam_multihost(
+        [bam_20k], str(tmp_path / "e.bam"), ctx=ctx, split_size=1 << 18,
+        level=1,
+    )
+    assert os.path.isfile(os.path.join(td2, "cluster_manifest.json"))
+    # Explicit argument wins over the env var.
+    monkeypatch.setenv("HBAM_MESH_TRACE", "1")
+    out3 = str(tmp_path / "n.bam")
+    multihost.sort_bam_multihost(
+        [bam_20k], out3, ctx=ctx, split_size=1 << 18, level=1,
+        mesh_trace=False,
+    )
+    assert not os.path.exists(out3 + ".mesh-trace")
+
+
+# ---------------------------------------------------------------------------
+# HTTP byte-plane counters.
+# ---------------------------------------------------------------------------
+
+
+def test_http_plane_server_counters_and_fetch_retries(tmp_path):
+    """The data server counts requests / range requests / bytes served;
+    the fetch path's silent retry loop now counts mh.http.fetch_retries."""
+    from hadoop_bam_tpu.io.fs import HttpFilesystem
+    from hadoop_bam_tpu.parallel.multihost import _serve_dir
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    blob = os.urandom(4096)
+    with open(tmp_path / "payload.bin", "wb") as f:
+        f.write(blob)
+    os.environ["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    try:
+        srv, base = _serve_dir(str(tmp_path), "tok")
+    finally:
+        os.environ.pop("HBAM_SHUFFLE_HOST", None)
+    try:
+        before = METRICS.report()["counters"]
+        fs = HttpFilesystem(headers={"X-Hbam-Token": "tok"})
+        assert fs.read_all(f"{base}/payload.bin") == blob
+        assert (
+            fs.read_range(f"{base}/payload.bin", 100, 200)
+            == blob[100:300]
+        )
+        after = METRICS.report()["counters"]
+        assert after.get("mh.http.requests", 0) - before.get(
+            "mh.http.requests", 0
+        ) >= 2
+        assert after.get("mh.http.range_requests", 0) - before.get(
+            "mh.http.range_requests", 0
+        ) >= 1
+        served = after.get("mh.http.bytes_served", 0) - before.get(
+            "mh.http.bytes_served", 0
+        )
+        assert served >= 4096 + 200
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    # Fetch retries: a dead endpoint exhausts its retries, each counted.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()[1]
+    before = METRICS.report()["counters"].get("mh.http.fetch_retries", 0)
+    flaky = HttpFilesystem(
+        retries=2, timeout=2.0, retry_metric="mh.http.fetch_retries"
+    )
+    with pytest.raises(OSError):
+        flaky.read_all(f"http://127.0.0.1:{dead}/nope")
+    after = METRICS.report()["counters"].get("mh.http.fetch_retries", 0)
+    assert after - before == 2
+
+
+# ---------------------------------------------------------------------------
+# The 2-process spawned dryrun: the acceptance drill.
+# ---------------------------------------------------------------------------
+
+_OBS_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; out = sys.argv[5]; trace_dir = sys.argv[6]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.parallel import multihost
+from hadoop_bam_tpu.utils.tracing import METRICS
+if pid == 1:
+    # Degraded-host injection: a salvage-class counter fired MID-RUN
+    # (the manifest's counters are a per-run delta) makes host 1's
+    # RunManifest degraded; the ClusterManifest must propagate it.
+    _orig_write = multihost._write_byte_runs
+    def _inject_then_write(*a, **k):
+        METRICS.count("salvage.records_dropped", 1)
+        return _orig_write(*a, **k)
+    multihost._write_byte_runs = _inject_then_write
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+n = multihost.sort_bam_multihost([src], out, ctx=ctx, split_size=1 << 16,
+                                 level=1, byte_plane="http",
+                                 mesh_trace=True, mesh_trace_dir=trace_dir)
+print(f"MH_OBS_OK pid={{pid}} n={{n}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_observability(bam_20k, tmp_path, mesh_report_mod):
+    """The ISSUE 14 acceptance drill: 2 real processes over the HTTP
+    byte plane with the mesh trace armed and an exec.delay straggler
+    injected on host 1 (the PR 7 fault seam, item = process id).
+
+    Asserts: byte-identical output, a merged mesh trace that loads, a
+    balanced per-edge byte matrix, a computed skew ratio, mesh_report
+    naming host 1 the straggler, and ClusterManifest degraded-propagation
+    from host 1's injected salvage counter."""
+    out = str(tmp_path / "mh_obs.bam")
+    trace_dir = str(tmp_path / "mesh-trace")
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    # The straggler drill: delay host 1's read of every split by 150 ms
+    # (items filters on the process id at the mesh read seam).
+    env["HBAM_FAULTS"] = "exec.delay:items=1,ms=150,n=*"
+    worker = _OBS_WORKER.format(repo=str(REPO))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             bam_20k, out, trace_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"MH_OBS_OK pid={pid} n=20000" in o, o[-2000:]
+
+    # Output unchanged by the whole plane (delay included).
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.pipeline import sort_bam
+
+    out_ref = str(tmp_path / "ref.bam")
+    sort_bam([bam_20k], out_ref, level=1, backend="host",
+             split_size=1 << 16)
+    d1 = native.decompress_all(open(out, "rb").read())
+    d2 = native.decompress_all(open(out_ref, "rb").read())
+    assert np.array_equal(d1, d2), "mesh-traced output differs from oracle"
+
+    # All four artifacts collected by host 0 through the HTTP plane.
+    names = sorted(os.listdir(trace_dir))
+    assert names == [
+        "cluster_manifest.json",
+        "manifest-h000.json", "manifest-h001.json",
+        "trace-h000.json", "trace-h001.json",
+    ]
+    rep = mesh_report_mod.mesh_report(trace_dir)
+    assert rep["num_hosts"] == 2 and rep["events"] > 0
+    mx = rep["matrix"]
+    assert mx["balanced"], mx["mismatches"]
+    assert mx["records"] == 20_000
+    assert mx["shuffle_bytes_cross_host"] > 0  # real cross-host traffic
+    assert mx["skew_ratio"] >= 1.0
+    st = rep["straggler_table"]
+    assert st["straggler"]["host"] == 1, st
+    assert st["straggler"]["blame_ms"] > 100  # ≥1 delayed split's worth
+    # Host 1 read slower than host 0 on the merged clock.
+    assert st["stages"]["mh.read"]["1"] > st["stages"]["mh.read"]["0"]
+    cm = rep["cluster_manifest"]
+    assert cm["degraded"], cm
+    assert any("host 1 degraded" in r for r in cm["reasons"]), cm["reasons"]
+    assert cm["edges_balanced"]
+    # The HTTP byte plane's own counters made it into the manifests.
+    manifests = mesh_report_mod.load_manifests(trace_dir)
+    assert any(m["http"].get("requests", 0) > 0 for m in manifests)
+    assert any(m["http"].get("bytes_served", 0) > 0 for m in manifests)
+    # The delay drill is auditable: host 1's manifest recorded the fired
+    # fault directives in its run manifest modes.
+    h1 = [m for m in manifests if m["host"] == 1][0]
+    assert h1["run_manifest"]["modes"].get("faults.fired.exec.delay")

@@ -806,6 +806,12 @@ _AMBIENT_EXEMPT = (
     "collate/fixmate.py",
     "collate/host.py",
     "utils/tracing.py",  # the emitter itself
+    # The multihost driver is batch/SPMD-only — one job per process
+    # group, never dispatched from the serve daemon, so there is no
+    # request to attribute its mh.* stage/barrier events to; its
+    # per-host attribution lives in the mesh shards (pid = host) and
+    # the ClusterManifest instead (tests/test_mesh_observability.py).
+    "parallel/multihost.py",
 )
 
 

@@ -554,7 +554,8 @@ def test_registry_gauges_in_report_delta_and_prometheus():
 
 _NAME_CALL = re.compile(
     r'(?:METRICS\.count|METRICS\.observe|METRICS\.set_gauge|[^.\w]span'
-    r'|_trace_stage|count_h2d|count_d2h)\(\s*\n?\s*(f?)"([^"]+)'
+    r'|_trace_stage|count_h2d|count_d2h|TRACER\.counter)'
+    r'\(\s*\n?\s*(f?)"([^"]+)'
 )
 
 

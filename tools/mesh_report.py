@@ -1,0 +1,494 @@
+#!/usr/bin/env python3
+"""Mesh observability reducer: per-host trace shards → one cluster story.
+
+A mesh-traced ``sort_bam_multihost`` run (``mesh_trace=True`` /
+HBAM_MESH_TRACE) leaves a directory of artifacts, collected by process 0
+through the shuffle byte plane:
+
+- ``trace-h<pid>.json`` — one Chrome trace-event shard per host, its
+  ``otherData.mesh`` block carrying the host id and the clock anchor the
+  host stamped right after the shared ``trace_sync`` barrier;
+- ``manifest-h<pid>.json`` — the host's manifest (RunManifest + its row
+  of the shuffle byte matrix + barrier waits + peak bytes);
+- ``cluster_manifest.json`` — the folded ClusterManifest.
+
+This reducer (stdlib-only, like tools/trace_report.py whose interval
+machinery it reuses):
+
+1. **merges** the shards onto one clock — each shard is shifted so the
+   barrier anchors coincide (all hosts leave the same barrier at ~the
+   same wall instant; collective-exit skew bounds the alignment error)
+   and re-labeled ``pid = host`` so Perfetto renders one lane per host
+   (``--merged OUT.json`` writes the merged, Perfetto-loadable trace);
+2. reduces the merged timeline to a **straggler table** — per host ×
+   mesh stage (``mh.read``, ``mh.key_shuffle``,
+   ``mh.byte_shuffle.write/fetch``, ``mh.range_merge``, ``mh.merge``)
+   busy time, the critical-path host flagged, and every
+   ``mh.barrier.<name>`` wait attributed to the host that arrived LAST
+   (the blamed host; everyone else's wait at that barrier is its fault);
+3. prints the **N×N shuffle byte matrix** from the manifests and asserts
+   it balances — each edge's sender-side measurement must equal the
+   receiver-side one — plus the key-plane matrix and the partition-skew
+   ratio (max/mean records per output shard).
+
+``straggler_overhead_pct`` is the fraction of cluster host-time spent
+waiting at barriers for stragglers: ``100 × Σ barrier waits /
+(num_hosts × merged wall)`` — the number the MULTICHIP bench rounds
+carry per round.
+
+Usage:  python tools/mesh_report.py TRACE_DIR [--json] [--merged OUT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_report  # noqa: E402  (interval machinery + trace loader)
+
+SHARD_RE = re.compile(r"^trace-h(\d+)\.json$")
+MANIFEST_RE = re.compile(r"^manifest-h(\d+)\.json$")
+
+#: Coarse mesh stages (the per-host lanes of the straggler table); any
+#: other ``mh.*`` stage event still rides the merged trace, and barriers
+#: (``mh.barrier.*``) are attributed separately.
+MESH_STAGE_PREFIX = "mh."
+BARRIER_PREFIX = "mh.barrier."
+
+
+# ---------------------------------------------------------------------------
+# Loading.
+# ---------------------------------------------------------------------------
+
+
+def load_shards(trace_dir: str) -> List[dict]:
+    """Every ``trace-h*.json`` in the directory, sorted by host id.
+
+    Returns ``[{"host", "events", "meta", "anchor_us"}, …]``; raises if a
+    shard carries no mesh anchor (it would be un-mergeable)."""
+    shards = []
+    for name in sorted(os.listdir(trace_dir)):
+        m = SHARD_RE.match(name)
+        if not m:
+            continue
+        events, meta = trace_report.load_trace(
+            os.path.join(trace_dir, name)
+        )
+        mesh = meta.get("mesh") or {}
+        if "anchor_us" not in mesh:
+            raise ValueError(
+                f"{name}: no mesh clock anchor in otherData — not a "
+                "mesh shard?"
+            )
+        shards.append(
+            {
+                "host": int(m.group(1)),
+                "events": events,
+                "meta": meta,
+                "anchor_us": float(mesh["anchor_us"]),
+            }
+        )
+    if not shards:
+        raise FileNotFoundError(
+            f"no trace-h*.json shards under {trace_dir}"
+        )
+    return sorted(shards, key=lambda s: s["host"])
+
+
+def load_manifests(trace_dir: str) -> List[dict]:
+    out = []
+    for name in sorted(os.listdir(trace_dir)):
+        m = MANIFEST_RE.match(name)
+        if not m:
+            continue
+        with open(os.path.join(trace_dir, name)) as f:
+            out.append(json.load(f))
+    return sorted(out, key=lambda h: h.get("host", 0))
+
+
+def load_cluster_manifest(trace_dir: str) -> Optional[dict]:
+    p = os.path.join(trace_dir, "cluster_manifest.json")
+    if not os.path.isfile(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# The mesh merge: every shard onto one clock, one Perfetto lane per host.
+# ---------------------------------------------------------------------------
+
+
+def merge_shards(shards: List[dict]) -> Tuple[List[dict], dict]:
+    """Shift every shard so the barrier anchors coincide and re-label
+    events ``pid = host``.
+
+    The anchor is each host's own ring clock stamped right after the
+    shared ``trace_sync`` barrier, so ``ref_anchor - anchor_h`` is the
+    offset host *h*'s whole timeline needs.  Returns ``(merged events
+    sorted by ts, info)`` where info carries the per-host shifts;
+    metadata events name each lane ``host <h>`` for Perfetto."""
+    ref = shards[0]["anchor_us"]
+    merged: List[dict] = []
+    shifts: Dict[int, float] = {}
+    for sh in shards:
+        host = sh["host"]
+        shift = ref - sh["anchor_us"]
+        shifts[host] = shift
+        merged.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": host,
+                "tid": 0,
+                "args": {"name": f"host {host}"},
+            }
+        )
+        for e in sh["events"]:
+            if e.get("ph") == "M":
+                continue
+            e2 = dict(e)
+            if "ts" in e2:
+                e2["ts"] = float(e2["ts"]) + shift
+            e2["pid"] = host
+            merged.append(e2)
+    merged.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return merged, {"shifts_us": shifts, "ref_host": shards[0]["host"]}
+
+
+# ---------------------------------------------------------------------------
+# Straggler attribution.
+# ---------------------------------------------------------------------------
+
+
+def straggler_table(events: List[dict]) -> Optional[dict]:
+    """Per host × mesh stage busy time + barrier-wait blame.
+
+    Stage busy is the union length of each (host, ``mh.*`` stage) event
+    set (barriers excluded).  For every ``mh.barrier.<name>``, each
+    host's event starts at its *arrival*; the host that arrived last is
+    the straggler for that barrier and every other host's wait there is
+    attributed (blamed) to it.  The overall ``straggler`` is the host
+    with the most blame; ``critical_path_host`` the one with the most
+    busy time."""
+    stage_ivs: Dict[Tuple[int, str], List[Tuple[float, float]]] = {}
+    barrier_evs: Dict[str, List[dict]] = {}
+    hosts: set = set()
+    t_min, t_max = float("inf"), float("-inf")
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        if not name.startswith(MESH_STAGE_PREFIX):
+            continue
+        host = int(e.get("pid", 0))
+        hosts.add(host)
+        t0 = float(e["ts"])
+        t1 = t0 + float(e.get("dur", 0.0))
+        t_min, t_max = min(t_min, t0), max(t_max, t1)
+        if name.startswith(BARRIER_PREFIX):
+            barrier_evs.setdefault(name[len(BARRIER_PREFIX):], []).append(
+                {"host": host, "t0": t0, "wait_us": t1 - t0}
+            )
+        else:
+            stage_ivs.setdefault((host, name), []).append((t0, t1))
+    if not hosts:
+        return None
+    wall_us = max(t_max - t_min, 1e-9)
+
+    stages: Dict[str, Dict[str, float]] = {}
+    busy_by_host: Dict[int, float] = {h: 0.0 for h in hosts}
+    for (host, name), ivs in stage_ivs.items():
+        busy = trace_report._union_len(ivs)
+        stages.setdefault(name, {})[str(host)] = busy / 1e3
+        busy_by_host[host] += busy
+
+    barriers: Dict[str, dict] = {}
+    blame_ms: Dict[int, float] = {h: 0.0 for h in hosts}
+    wait_total_us = 0.0
+    for name, evs in barrier_evs.items():
+        last = max(evs, key=lambda v: v["t0"])
+        waits = {str(v["host"]): round(v["wait_us"] / 1e3, 3) for v in evs}
+        blamed_us = sum(
+            v["wait_us"] for v in evs if v["host"] != last["host"]
+        )
+        blame_ms[last["host"]] += blamed_us / 1e3
+        wait_total_us += sum(v["wait_us"] for v in evs)
+        barriers[name] = {
+            "straggler": last["host"],
+            "wait_ms": waits,
+            "blamed_ms": round(blamed_us / 1e3, 3),
+        }
+    n = len(hosts)
+    straggler = max(blame_ms, key=blame_ms.get) if blame_ms else None
+    critical = max(busy_by_host, key=busy_by_host.get)
+    return {
+        "hosts": sorted(hosts),
+        "wall_ms": wall_us / 1e3,
+        "stages": stages,
+        "busy_ms_by_host": {
+            str(h): round(b / 1e3, 3) for h, b in busy_by_host.items()
+        },
+        "critical_path_host": critical,
+        "barriers": barriers,
+        "straggler": {
+            "host": straggler,
+            "blame_ms": round(blame_ms.get(straggler, 0.0), 3)
+            if straggler is not None
+            else 0.0,
+        },
+        "barrier_wait_ms_total": round(wait_total_us / 1e3, 3),
+        "straggler_overhead_pct": round(
+            100.0 * wait_total_us / (n * wall_us), 3
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The shuffle byte matrix (+ key plane + skew) from the host manifests.
+# ---------------------------------------------------------------------------
+
+
+def byte_matrix(manifests: List[dict]) -> Optional[dict]:
+    """N×N sent/recv matrices with the per-edge balance assert.
+
+    ``sent[s][q]`` is host *s*'s sender-side measurement of the bytes it
+    shipped to *q* (the diagonal is the host's own share — a local move);
+    ``recv[q][s]`` is *q*'s independent receiver-side measurement of the
+    same edge.  Any disagreement is lost or duplicated shuffle data and
+    lands in ``mismatches``."""
+    if not manifests:
+        return None
+    n = max(
+        [len(manifests)]
+        + [int(h.get("num_processes", 0)) for h in manifests]
+    )
+    by_host = {int(h.get("host", 0)): h for h in manifests}
+    sent = [[0] * n for _ in range(n)]
+    recv = [[0] * n for _ in range(n)]
+    keys_sent = [[0] * n for _ in range(n)]
+    mismatches: List[dict] = []
+    for s in range(n):
+        hs = by_host.get(s, {})
+        for q in range(n):
+            hq = by_host.get(q, {})
+            sent[s][q] = int(
+                (hs.get("shuffle_sent_bytes") or {}).get(str(q), 0)
+            )
+            recv[q][s] = int(
+                (hq.get("shuffle_recv_bytes") or {}).get(str(s), 0)
+            )
+            keys_sent[s][q] = int(
+                (hs.get("keys_sent_bytes") or {}).get(str(q), 0)
+            )
+            if sent[s][q] != recv[q][s]:
+                mismatches.append(
+                    {"edge": f"{s}->{q}", "sent": sent[s][q],
+                     "recv": recv[q][s]}
+                )
+    records = sum(int(h.get("records_local", 0)) for h in manifests)
+    out_counts = [
+        c for h in manifests for c in (h.get("records_out") or [])
+    ]
+    mean = (sum(out_counts) / len(out_counts)) if out_counts else 0.0
+    total = sum(sum(row) for row in sent)
+    off_diag = total - sum(sent[i][i] for i in range(n))
+    return {
+        "num_hosts": n,
+        "sent": sent,
+        "recv": recv,
+        "keys_sent": keys_sent,
+        "balanced": not mismatches,
+        "mismatches": mismatches,
+        "shuffle_bytes": total,
+        "shuffle_bytes_cross_host": off_diag,
+        "records": records,
+        "shuffle_bytes_per_record": round(total / records, 3)
+        if records
+        else 0.0,
+        "skew_ratio": round(max(out_counts) / mean, 4)
+        if mean > 0
+        else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The full reduction + rendering.
+# ---------------------------------------------------------------------------
+
+
+def mesh_report(trace_dir: str) -> dict:
+    """The whole reduction for one mesh trace directory."""
+    shards = load_shards(trace_dir)
+    merged, info = merge_shards(shards)
+    manifests = load_manifests(trace_dir)
+    rep = {
+        "num_hosts": len(shards),
+        "merge": info,
+        "events": len(merged),
+        "straggler_table": straggler_table(merged),
+        "matrix": byte_matrix(manifests),
+        "cluster_manifest": load_cluster_manifest(trace_dir),
+        "dropped_events": sum(
+            int(s["meta"].get("dropped_events", 0) or 0) for s in shards
+        ),
+    }
+    return rep
+
+
+def _fmt_matrix(rows: List[List[int]], label: str) -> List[str]:
+    n = len(rows)
+    head = f"{label:<10}" + "".join(f"{'->' + str(q):>14}" for q in range(n))
+    lines = [head]
+    for s in range(n):
+        lines.append(
+            f"{'host ' + str(s):<10}"
+            + "".join(f"{rows[s][q]:>14,}" for q in range(n))
+        )
+    return lines
+
+
+def format_report(rep: dict) -> str:
+    lines: List[str] = []
+    st = rep.get("straggler_table")
+    if st:
+        lines.append(
+            f"mesh wall: {st['wall_ms']:.3f} ms over "
+            f"{rep['num_hosts']} host(s); critical-path host "
+            f"{st['critical_path_host']} "
+            f"(busy {st['busy_ms_by_host'][str(st['critical_path_host'])]:.3f} ms)"
+        )
+        lines.append("")
+        hosts = st["hosts"]
+        lines.append(
+            f"{'stage':<26}" + "".join(f"{'h' + str(h):>12}" for h in hosts)
+        )
+        for name in sorted(st["stages"]):
+            row = st["stages"][name]
+            lines.append(
+                f"{name:<26}"
+                + "".join(
+                    f"{row.get(str(h), 0.0):>12.3f}" for h in hosts
+                )
+            )
+        lines.append(
+            "busy ms".ljust(26)
+            + "".join(
+                f"{st['busy_ms_by_host'][str(h)]:>12.3f}" for h in hosts
+            )
+        )
+        lines.append("")
+        lines.append(
+            f"{'barrier':<26}{'straggler':>10}{'blamed ms':>12}  waits"
+        )
+        for name in sorted(st["barriers"]):
+            b = st["barriers"][name]
+            waits = " ".join(
+                f"h{h}={w:.1f}" for h, w in sorted(b["wait_ms"].items())
+            )
+            lines.append(
+                f"{name:<26}{'h' + str(b['straggler']):>10}"
+                f"{b['blamed_ms']:>12.3f}  {waits}"
+            )
+        s = st["straggler"]
+        lines.append(
+            f"\nstraggler: host {s['host']} "
+            f"(blamed for {s['blame_ms']:.3f} ms of barrier wait); "
+            f"straggler overhead {st['straggler_overhead_pct']:.2f}% of "
+            "cluster host-time"
+        )
+    mx = rep.get("matrix")
+    if mx:
+        lines.append("")
+        lines.extend(_fmt_matrix(mx["sent"], "sent B"))
+        verdict = (
+            "balanced (sent==recv per edge)"
+            if mx["balanced"]
+            else f"IMBALANCED: {mx['mismatches']}"
+        )
+        lines.append(f"shuffle byte matrix: {verdict}")
+        lines.append(
+            f"shuffle bytes: {mx['shuffle_bytes']:,} total "
+            f"({mx['shuffle_bytes_cross_host']:,} cross-host), "
+            f"{mx['shuffle_bytes_per_record']} B/record over "
+            f"{mx['records']:,} records; partition skew "
+            f"{mx['skew_ratio']}x (max/mean records per shard)"
+        )
+    cm = rep.get("cluster_manifest")
+    if cm is not None:
+        lines.append("")
+        if cm.get("degraded"):
+            lines.append("cluster manifest: DEGRADED")
+            for r in cm.get("reasons", []):
+                lines.append(f"  - {r}")
+        else:
+            lines.append(
+                "cluster manifest: clean "
+                f"({cm.get('num_hosts')} hosts, byte plane "
+                f"{cm.get('byte_plane')}, peak bytes "
+                + ", ".join(
+                    f"h{h.get('host')}={h.get('peak_bytes')}"
+                    for h in cm.get("hosts", [])
+                )
+                + ")"
+            )
+    if rep.get("dropped_events"):
+        lines.append(
+            f"\nwarning: {rep['dropped_events']} events dropped from "
+            "shard rings — lanes may be truncated (raise "
+            "hadoopbam.trace.events)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-host mesh trace shards, attribute "
+        "stragglers, and check the shuffle byte matrix"
+    )
+    ap.add_argument(
+        "trace_dir",
+        help="mesh trace directory (trace-h*.json + manifest-h*.json "
+        "+ cluster_manifest.json)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the reduced report as JSON instead of the tables",
+    )
+    ap.add_argument(
+        "--merged", default=None, metavar="OUT.json",
+        help="also write the merged (clock-aligned, one Perfetto lane "
+        "per host) Chrome trace here",
+    )
+    args = ap.parse_args(argv)
+    rep = mesh_report(args.trace_dir)
+    if args.merged:
+        shards = load_shards(args.trace_dir)
+        merged, _ = merge_shards(shards)
+        with open(args.merged, "w") as f:
+            json.dump(
+                {"traceEvents": merged, "displayTimeUnit": "ms"}, f
+            )
+        print(
+            f"{args.merged}: {len(merged)} events "
+            f"({rep['num_hosts']} host lanes)",
+            file=sys.stderr,
+        )
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print(format_report(rep))
+    # The acceptance gate a driver script can rely on: nonzero when the
+    # matrix does not balance (lost/duplicated shuffle bytes).
+    mx = rep.get("matrix")
+    return 0 if (mx is None or mx["balanced"]) else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

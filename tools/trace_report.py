@@ -498,6 +498,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="event category to attribute (default: stage)",
     )
     ap.add_argument(
+        "--pid", type=int, default=None,
+        help="only attribute events of this pid — a merged mesh trace "
+        "(tools/mesh_report.py) carries one pid per host, and busy "
+        "unions across hosts are meaningless",
+    )
+    ap.add_argument(
         "--compare", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
         help="two trace files: print the per-stage tables side by side "
         "with the overlap-fraction delta (the pipelining before/after "
@@ -533,6 +539,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("a trace file (or --compare BEFORE AFTER) is required")
     all_events, meta = load_trace(args.trace)
     events = [e for e in all_events if e.get("ph") == "X"]
+    pids = {e.get("pid") for e in events}
+    if args.pid is not None:
+        events = [e for e in events if e.get("pid") == args.pid]
+    elif len(pids) > 1:
+        print(
+            f"note: {len(pids)} pids in this trace — a merged mesh "
+            "trace? per-host lanes and straggler attribution live in "
+            "tools/mesh_report.py (or re-run with --pid N for one host)",
+            file=sys.stderr,
+        )
     rep = stage_report(events, category=args.category)
     mem = memory_report(all_events)
     xfer = transfer_report(all_events)
