@@ -715,28 +715,44 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 src = sys.argv[4]; out = sys.argv[5]; trace_dir = sys.argv[6]
+out_raw = sys.argv[7]; trace_dir_raw = sys.argv[8]
 sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.conf import Configuration, SHUFFLE_COMPRESS
 from hadoop_bam_tpu.parallel import multihost
 ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
                            process_id=pid)
+# Compressed plane (the default) then the raw plane, back to back on the
+# same mesh: the ratio headline and its must-not-regress raw baseline
+# come from one round.
 n = multihost.sort_bam_multihost([src], out, ctx=ctx, split_size=1 << 19,
                                  level=1, mesh_trace=True,
                                  mesh_trace_dir=trace_dir)
-print(f"MH_BENCH_OK pid={{pid}} n={{n}}", flush=True)
+conf_raw = Configuration({{SHUFFLE_COMPRESS: "false"}})
+n2 = multihost.sort_bam_multihost([src], out_raw, ctx=ctx, conf=conf_raw,
+                                  split_size=1 << 19, level=1,
+                                  mesh_trace=True,
+                                  mesh_trace_dir=trace_dir_raw)
+print(f"MH_BENCH_OK pid={{pid}} n={{n}} n2={{n2}}", flush=True)
 """
 
 
 def _multichip_bench(tmp: str) -> dict:
-    """Mesh observability numbers from a real 2-process multihost sort.
+    """Mesh shuffle numbers from a real 2-process multihost sort.
 
     Two OS processes (jax.distributed + gloo, 4 virtual CPU devices
-    each) coordinate-sort a shared corpus with the mesh trace plane
-    armed; ``tools/mesh_report.py`` reduces the collected shards +
-    manifests to ``mh_shuffle_bytes_per_record`` (today: inflated record
-    bytes — the ~4× the compressed-payload shuffle must cut),
-    ``mh_skew_ratio`` (max/mean records per output shard) and
-    ``mh_straggler_overhead_pct`` (cluster host-time lost to barrier
-    waits).  The folded ClusterManifest rides the round verbatim so
+    each) coordinate-sort a shared corpus twice, back to back on the
+    same mesh: once over the compressed byte plane (the default — BGZF
+    members on the wire) and once over the raw plane
+    (``hadoopbam.shuffle.compress=false``), both with the mesh trace
+    armed.  ``tools/mesh_report.py`` reduces each run's shards +
+    manifests; the round emits ``mh_shuffle_bytes_per_record`` (WIRE
+    bytes — the compressed headline) beside ``mh_shuffle_ratio``
+    (raw/wire; the accounting-desync canary — a round missing it is
+    degraded) and the raw plane's ``mh_shuffle_bytes_per_record_raw``
+    (the must-not-regress baseline, 200 B/record at PR 14), plus
+    ``mh_skew_ratio`` and ``mh_straggler_overhead_pct`` as before.  The
+    two outputs must be byte-identical (``mh_planes_identical``); the
+    compressed run's folded ClusterManifest rides the round verbatim so
     finalize_round can degrade the round when any host degraded or the
     byte matrix failed to balance."""
     import socket
@@ -746,7 +762,9 @@ def _multichip_bench(tmp: str) -> dict:
     src = os.path.join(tmp, "multichip_src.bam")
     synth_bam(src, n)
     out = os.path.join(tmp, "multichip_sorted.bam")
+    out_raw = os.path.join(tmp, "multichip_sorted_raw.bam")
     trace_dir = os.path.join(tmp, "multichip_trace")
+    trace_dir_raw = os.path.join(tmp, "multichip_trace_raw")
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -758,7 +776,7 @@ def _multichip_bench(tmp: str) -> dict:
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", worker, str(pid), "2", str(port),
-             src, out, trace_dir],
+             src, out, trace_dir, out_raw, trace_dir_raw],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=repo,
         )
@@ -787,14 +805,23 @@ def _multichip_bench(tmp: str) -> dict:
     mr = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mr)
     rep = mr.mesh_report(trace_dir)
+    rep_raw = mr.mesh_report(trace_dir_raw)
     mx = rep["matrix"]
+    mx_raw = rep_raw["matrix"]
     st = rep["straggler_table"]
+    with open(out, "rb") as f1, open(out_raw, "rb") as f2:
+        identical = f1.read() == f2.read()
     return {
         "mh_hosts": rep["num_hosts"],
         "mh_records": mx["records"],
         "mh_shuffle_bytes_per_record": mx["shuffle_bytes_per_record"],
+        "mh_shuffle_bytes_per_record_raw": mx_raw[
+            "shuffle_bytes_per_record"
+        ],
+        "mh_shuffle_ratio": mx["shuffle_ratio"],
         "mh_shuffle_bytes_cross_host": mx["shuffle_bytes_cross_host"],
-        "mh_matrix_balanced": mx["balanced"],
+        "mh_matrix_balanced": mx["balanced"] and mx_raw["balanced"],
+        "mh_planes_identical": identical,
         "mh_skew_ratio": mx["skew_ratio"],
         "mh_straggler_overhead_pct": st["straggler_overhead_pct"],
         "mh_critical_path_host": st["critical_path_host"],
@@ -989,6 +1016,19 @@ def finalize_round(result: dict, want: str, probed, error) -> dict:
     if cm.get("degraded"):
         reasons.extend(
             f"cluster manifest: {r}" for r in cm.get("reasons", [])
+        )
+    # Compressed-shuffle accounting (PR 15): a multichip round that
+    # carries a ClusterManifest but no shuffle ratio means the raw-twin
+    # counters went missing (accounting desync) — degraded; so is one
+    # whose raw and compressed planes disagreed on the output bytes.
+    if cm and result.get("mh_shuffle_ratio") is None:
+        reasons.append(
+            "multichip round missing mh_shuffle_ratio (shuffle byte "
+            "accounting desync)"
+        )
+    if result.get("mh_planes_identical") is False:
+        reasons.append(
+            "compressed and raw shuffle planes produced different output"
         )
     # Tier counters vs the requested config: a device-labeled round whose
     # measurement process initialized a different jax backend is lying

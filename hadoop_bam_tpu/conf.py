@@ -190,6 +190,27 @@ ERRORS_MODE = "hadoopbam.errors"
 # var takes precedence (it covers subprocess drills).  Unset = disarmed,
 # and the seams are zero-cost no-ops.
 FAULTS_PLAN = "hadoopbam.faults.plan"
+# Compressed-payload mesh shuffle (parallel/multihost.py): record bytes
+# cross hosts as ≤64 KiB BGZF members (the Hadoop
+# mapreduce.map.output.compress stance at ICI/NIC speed) — the sender
+# re-blocks each destination's record run through the device deflate (or
+# host zlib when the lanes tier declines), receivers inflate batched on
+# the inflate lanes, and the memory budget's spill runs hold compressed
+# members.  "false" selects the raw byte plane (plain size+body streams,
+# the pre-PR-15 wire format); output is byte-identical either way.  The
+# HBAM_SHUFFLE_COMPRESS env var covers subprocess workers.
+SHUFFLE_COMPRESS = "hadoopbam.shuffle.compress"
+# BGZF member payload size (bytes) for the shuffle re-block, clamped to
+# the device codec cap (ops.flate.DEV_MAX_PAYLOAD, 57088 — a ≤64 KiB
+# member on the wire).  Tests shrink it so interpret-mode lanes members
+# stay ≤3 KiB; production leaves it at the cap.  HBAM_SHUFFLE_MEMBER_BYTES
+# is the env twin.
+SHUFFLE_MEMBER_BYTES = "hadoopbam.shuffle.member-bytes"
+# Receiver-side parallel fetch pool width (Hadoop's parallel copier,
+# mapreduce.reduce.shuffle.parallelcopies): this key → the
+# HBAM_SHUFFLE_FETCH_THREADS env var → 8, capped at the peer count.
+# The resolved value is surfaced in every host manifest.
+SHUFFLE_FETCH_THREADS = "hadoopbam.shuffle.fetch-threads"
 # Mesh observability plane (parallel/multihost.py): "true" arms every
 # process's timeline tracer for the run, exports a per-host trace shard
 # (trace-h<process_id>.json, clock-anchored at a dedicated barrier) plus

@@ -320,6 +320,34 @@ class DeviceStream:
             return out, offs, None
         return out, offs
 
+    def deflate_stream(
+        self, payload, level: int = 1, block_payload: Optional[int] = None
+    ) -> bytes:
+        """Compress a host byte stream into back-to-back BGZF members
+        (no terminator) through the stream's deflate tier policy — the
+        mesh shuffle's sender seam.  A lanes-armed stream rides
+        ``deflate_blocks_device`` (per-member host-zlib tier-down as
+        everywhere else, including the forced-tier-down fault seam); an
+        unarmed stream uses the native host codec directly — real
+        compression either way, and the member blocking (a cut every
+        ``block_payload`` bytes) is identical, so the caller's member
+        table math holds across tiers."""
+        if self.policy.deflate_lanes:
+            from .ops import flate
+
+            self._count("deflates")
+            return flate.deflate_blocks_device(
+                np.asarray(payload),
+                level=level,
+                block_payload=block_payload,
+                use_lanes=True,
+                conf=self.conf,
+            )
+        from . import native
+
+        kw = {} if block_payload is None else {"block_payload": block_payload}
+        return native.deflate_blocks(payload, level=level, **kw)
+
     # -- the double-buffered split drive ------------------------------------
 
     def read_splits(

@@ -45,6 +45,11 @@ Directive reference:
                      ``members``, ``n``.
 ``flate.corrupt``    flip a byte of a host-inflated payload *before* the
                      CRC gate (detected corruption); ``n``.
+``mh.corrupt``       flip a byte of a fetched mesh-shuffle BGZF member's
+                     compressed payload *in flight* (receiver side, after
+                     the wire, before inflate — the CRC gate catches it);
+                     ``members`` (match set over the member index within
+                     one fetched stream), ``n``.
 ``exec.crash``       raise inside an executor attempt; ``items``,
                      ``attempts`` (match sets), ``n``.
 ``exec.torn``        write a garbage tmp file, then raise (the torn-write
@@ -97,6 +102,7 @@ _SITES = frozenset(
         "flate.inflate.tierdown",
         "flate.deflate.tierdown",
         "flate.corrupt",
+        "mh.corrupt",
         "exec.crash",
         "exec.torn",
         "exec.delay",
@@ -297,6 +303,14 @@ class FaultPlan:
         out = bytearray(payload)
         out[pos] ^= 0xFF
         return bytes(out)
+
+    def mh_corrupt(self, member: int) -> bool:
+        """The mesh-shuffle data-plane seam: should fetched shuffle
+        member ``member`` be corrupted in flight?  The caller flips one
+        byte of the member's *compressed* payload, so the BGZF CRC gate
+        — not luck — catches it at inflate time (strict raises; salvage
+        quarantines exactly that member)."""
+        return self._fire("mh.corrupt", member=member) is not None
 
     def exec_attempt(self, item: int, attempt: int, tmp_path: str) -> None:
         """The executor seam: latency, torn tmp files, crashes, or hard

@@ -20,12 +20,28 @@ TPU-native equivalent here:
   with ``byte_plane="http"`` — over authenticated HTTP range fetches from
   each process's LOCAL disk (Hadoop's map-output servlet + parallel
   copier, no shared filesystem in the data path): each process writes one
-  run of raw records per destination process, sorted by global source row
+  run of records per destination process, sorted by global source row
   with a memmappable row/offset sidecar; after a global barrier every
   process fetches and gathers exactly the bytes its devices' key ranges
   own.  Both planes compose with ``memory_budget`` (key-sorted spill
   runs, contiguous per-destination slices, receiver-side (key, ordinal)
   range merge).
+
+  By default the wire format is **compressed**: the sender re-blocks each
+  destination's record run into ≤64 KiB BGZF members through the job's
+  :class:`~..device_stream.DeviceStream` deflate seam (device deflate
+  when the lanes tier is armed, host zlib otherwise — per-member
+  tier-down as everywhere else) and ships the members plus a tiny member
+  table ``(raw_off, raw_len, comp_off, comp_len)``; the ``.rows``/
+  ``.offs`` sidecars keep addressing *raw* space, so receivers inflate
+  the members batched through the same stream's decode seam and the
+  gather contract is byte-identical to the raw plane.  This is Hadoop's
+  ``mapreduce.map.output.compress`` stance rebuilt at ICI/NIC speed:
+  keys ride the mesh ``all_to_all``, record bytes ride BGZF.
+  ``hadoopbam.shuffle.compress=false`` selects the raw plane.  The byte
+  matrix counters measure the **wire** (compressed) bytes per edge, with
+  raw twins (``mh.shuffle.sent_raw.<dst>``) making the per-edge
+  compression ratio a first-class measurement.
 
 ``sort_bam_multihost`` is the end-to-end driver: it produces a part file
 per *global device* and process 0 performs the ordinary header+parts+
@@ -167,6 +183,247 @@ def _bytes_name(src: int, dst: int) -> str:
 
 def _bytes_file(d: str, src: int, dst: int) -> str:
     return os.path.join(d, _bytes_name(src, dst))
+
+
+# ---------------------------------------------------------------------------
+# The compressed wire format: BGZF members + a member table in raw space.
+# ---------------------------------------------------------------------------
+
+#: Member-table sidecar suffix: one flat int64 ``.npy`` holding
+#: ``(raw_off, raw_len, comp_off, comp_len)`` per member (flattened so
+#: the ranged-``.npy`` reader handles it unchanged on the HTTP plane).
+_MTAB_SUFFIX = ".mtab.npy"
+
+
+def _resolve_shuffle_compress(conf) -> bool:
+    """``hadoopbam.shuffle.compress`` → HBAM_SHUFFLE_COMPRESS → True."""
+    if conf is not None:
+        from ..conf import SHUFFLE_COMPRESS
+
+        if conf.get(SHUFFLE_COMPRESS) is not None:
+            return conf.get_boolean(SHUFFLE_COMPRESS, True)
+    env = os.environ.get("HBAM_SHUFFLE_COMPRESS", "").strip().lower()
+    if env:
+        return env not in ("0", "false", "off", "no")
+    return True
+
+
+def _resolve_member_bytes(conf) -> int:
+    """Shuffle member payload: conf → env → the device codec cap
+    (``DEV_MAX_PAYLOAD`` — a ≤64 KiB member on the wire, the same
+    deterministic blocking the part writer uses)."""
+    from ..ops.flate import DEV_MAX_PAYLOAD
+
+    v = 0
+    if conf is not None:
+        from ..conf import SHUFFLE_MEMBER_BYTES
+
+        v = conf.get_int(SHUFFLE_MEMBER_BYTES, 0)
+    if v <= 0:
+        env = os.environ.get("HBAM_SHUFFLE_MEMBER_BYTES", "")
+        try:
+            v = int(env) if env else 0
+        except ValueError:
+            v = 0
+    if v <= 0:
+        v = DEV_MAX_PAYLOAD
+    return max(512, min(v, DEV_MAX_PAYLOAD))
+
+
+def _resolve_fetch_threads(conf) -> int:
+    """Peer-fetch pool width: ``hadoopbam.shuffle.fetch-threads`` →
+    HBAM_SHUFFLE_FETCH_THREADS → 8 (callers cap at the peer count)."""
+    v = 0
+    if conf is not None:
+        from ..conf import SHUFFLE_FETCH_THREADS
+
+        v = conf.get_int(SHUFFLE_FETCH_THREADS, 0)
+    if v <= 0:
+        env = os.environ.get("HBAM_SHUFFLE_FETCH_THREADS", "")
+        try:
+            v = int(env) if env else 0
+        except ValueError:
+            v = 0
+    return v if v > 0 else 8
+
+
+def _deflate_member_stream(
+    raw, dstream, level: int, member_bytes: int
+) -> Tuple[bytes, np.ndarray]:
+    """Re-block a raw record stream into BGZF members for the wire.
+
+    Returns ``(member stream bytes, flat int64 member table)`` where the
+    table is ``(raw_off, raw_len, comp_off, comp_len)`` per member.  The
+    deflate rides the job's DeviceStream seam (device lanes when armed,
+    host zlib otherwise; per-member tier-down inside).  A stream the
+    codec *grew* (incompressible payload) falls back to stored members
+    (level 0 — ~31 B overhead per member instead of deflate expansion),
+    counted as ``mh.shuffle.store_fallback``."""
+    n = int(len(raw))
+    if n == 0:
+        return b"", np.zeros(0, dtype=np.int64)
+    lvl = level if level > 0 else 1
+    if dstream is not None:
+        comp = dstream.deflate_stream(
+            raw, level=lvl, block_payload=member_bytes
+        )
+    else:
+        comp = native.deflate_blocks(
+            raw, level=lvl, block_payload=member_bytes
+        )
+    if len(comp) >= n:
+        METRICS.count("mh.shuffle.store_fallback", 1)
+        comp = native.deflate_blocks(
+            raw, level=0, block_payload=member_bytes
+        )
+    return comp, _member_table(comp, n)
+
+
+def _member_table(comp: bytes, raw_total: int) -> np.ndarray:
+    """Scan a member stream into the flat ``(raw_off, raw_len,
+    comp_off, comp_len)`` table; the raw sizes must tile exactly the
+    raw stream the ``.offs`` sidecar addresses (anything else is an
+    accounting desync, caught here rather than as a garbled gather)."""
+    co, cs, us = native.scan_blocks(np.frombuffer(comp, dtype=np.uint8))
+    us64 = us.astype(np.int64)
+    if int(us64.sum()) != raw_total:
+        raise RuntimeError(
+            f"shuffle member table desync: members carry "
+            f"{int(us64.sum())} raw bytes, sidecars address {raw_total}"
+        )
+    mtab = np.empty((len(us), 4), dtype=np.int64)
+    mtab[:, 0] = np.concatenate(([0], np.cumsum(us64[:-1])))
+    mtab[:, 1] = us64
+    mtab[:, 2] = co
+    mtab[:, 3] = cs
+    return mtab.reshape(-1)
+
+
+def _member_cover(mtab: np.ndarray, b0: int, b1: int) -> Tuple[int, int]:
+    """Member index range [m0, m1) covering raw byte span [b0, b1)."""
+    m = mtab.reshape(-1, 4)
+    if b1 <= b0 or len(m) == 0:
+        return 0, 0
+    raw_off = m[:, 0]
+    m0 = max(0, int(np.searchsorted(raw_off, b0, side="right")) - 1)
+    m1 = int(np.searchsorted(raw_off, b1, side="left"))
+    return m0, m1
+
+
+def _cover_comp_bytes(mtab: np.ndarray, b0: int, b1: int) -> int:
+    """Wire bytes of the members covering raw span [b0, b1) — the unit
+    both sides of the budget plane's byte matrix count in."""
+    m0, m1 = _member_cover(mtab, b0, b1)
+    if m1 <= m0:
+        return 0
+    m = mtab.reshape(-1, 4)
+    return int(m[m1 - 1, 2] + m[m1 - 1, 3] - m[m0, 2])
+
+
+def _inflate_member_stream(
+    comp: np.ndarray, mtab: np.ndarray, dstream, errors: Optional[str]
+) -> Tuple[np.ndarray, List[Tuple[int, int]]]:
+    """Inflate a fetched member stream back to raw record bytes.
+
+    Returns ``(raw uint8, quarantined raw intervals)``.  The armed
+    ``mh.corrupt`` fault seam flips a byte of a member's compressed
+    payload here — after the wire, before inflate — so the BGZF CRC gate
+    is what catches it.  Strict mode propagates the codec error (the
+    whole sort fails loudly); ``errors="salvage"`` retries member by
+    member, quarantining exactly the corrupt ones (``salvage.*``
+    counters) and zero-filling their raw spans so the caller's gather
+    can drop the records they carried while survivors stay byte-exact.
+    """
+    m = mtab.reshape(-1, 4)
+    nm = len(m)
+    if nm == 0:
+        return np.empty(0, dtype=np.uint8), []
+    co = np.ascontiguousarray(m[:, 2], dtype=np.int64)
+    cs = np.ascontiguousarray(m[:, 3], dtype=np.int32)
+    us = np.ascontiguousarray(m[:, 1], dtype=np.int32)
+    plan = faults.ACTIVE
+    if plan is not None:
+        for i in range(nm):
+            if plan.mh_corrupt(i):
+                comp = np.array(comp, copy=True)
+                # Mid-payload of member i: past the 18-byte gzip header,
+                # before the 8-byte CRC/ISIZE trailer.
+                pos = int(co[i]) + 18 + max(0, (int(cs[i]) - 26) // 2)
+                comp[pos] ^= 0xFF
+
+    def _decode(data, coffs, csz, usz):
+        if dstream is not None:
+            return dstream.decode_members(
+                data, coffs, csz, usz, on_error="host"
+            )
+        return native.inflate_blocks(data, coffs, csz, usz)
+
+    if errors != "salvage":
+        out, _ = _decode(comp, co, cs, us)
+        return out, []
+    try:
+        out, _ = _decode(comp, co, cs, us)
+        return out, []
+    except Exception:
+        pass  # re-walk member by member below, quarantining failures
+    offs = np.zeros(nm + 1, dtype=np.int64)
+    np.cumsum(us.astype(np.int64), out=offs[1:])
+    out = np.zeros(int(offs[-1]), dtype=np.uint8)
+    bad: List[Tuple[int, int]] = []
+    for i in range(nm):
+        try:
+            p, _ = native.inflate_blocks(
+                comp, co[i : i + 1], cs[i : i + 1], us[i : i + 1]
+            )
+            out[int(offs[i]) : int(offs[i + 1])] = p
+        except Exception:
+            bad.append((int(offs[i]), int(offs[i + 1])))
+            METRICS.count("salvage.members_quarantined", 1)
+            METRICS.count("salvage.bytes_quarantined", int(us[i]))
+    return out, bad
+
+
+def _write_run_compressed(
+    directory: str,
+    idx: int,
+    batch,
+    perm: np.ndarray,
+    dstream,
+    level: int,
+    member_bytes: int,
+) -> None:
+    """Spill one sorted run in the compressed wire format: the data file
+    is a BGZF member stream (what ``io.runs.write_run`` writes, deflated
+    through the shuffle's member re-block) plus the ``.mtab.npy`` member
+    table; the key/offset sidecars are unchanged and keep addressing RAW
+    space, so the budget plane's cut tables, slice math and (key,
+    ordinal) merge are plane-independent."""
+    from ..io import runs as runs_mod
+    from ..io.bam import gather_record_array
+
+    data_p, keys_p, offs_p, _ = runs_mod.run_paths(directory, idx)
+    stream = gather_record_array(batch, perm)
+    keys_sorted = np.ascontiguousarray(batch.keys[perm], dtype=np.int64)
+    lens = batch.soa["rec_len"].astype(np.int64)[perm] + 4
+    offs = np.empty(len(lens) + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    with span("mh.byte_shuffle.deflate", category="stage"):
+        comp, mtab = _deflate_member_stream(
+            stream, dstream, level, member_bytes
+        )
+    mtab_p = os.path.join(directory, f"run-{idx:05d}{_MTAB_SUFFIX}")
+    targets = (
+        (data_p, lambda f: f.write(comp)),
+        (keys_p, lambda f: np.save(f, keys_sorted)),
+        (offs_p, lambda f: np.save(f, offs)),
+        (mtab_p, lambda f: np.save(f, mtab)),
+    )
+    for path, writer in targets:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            writer(f)
+        os.replace(tmp, path)
 
 
 def _serve_dir(directory: str, token: str):
@@ -329,21 +586,31 @@ def _write_byte_runs(
     dest_dev: np.ndarray,
     row_of_record: np.ndarray,
     rows_per_device: int,
+    compress: bool = False,
+    dstream=None,
+    member_bytes: int = 0,
+    level: int = 1,
 ) -> None:
     """Ship this process's records to their destination processes.
 
-    One file per destination process, containing raw records (size word +
-    body) ascending by *global source row*, plus ``.rows``/``.offs``
-    sidecars so receivers can binary-search any (src_dev, src_row)
-    reference the key shuffle hands them.
+    One run per destination process, records ascending by *global source
+    row*, plus ``.rows``/``.offs`` sidecars so receivers can
+    binary-search any (src_dev, src_row) reference the key shuffle hands
+    them.  With ``compress`` (the default plane) the run is re-blocked
+    into ≤64 KiB BGZF members (``.bgzf`` + the ``.mtab.npy`` member
+    table) through the job's DeviceStream deflate seam; the sidecars
+    keep addressing *raw* space, so the receiver's row binary search is
+    plane-independent.  Raw plane: the pre-PR-15 ``.bin`` stream.
 
-    Sender side of the shuffle byte matrix: the ``.bin`` payload bytes
-    addressed to each destination process count ``mh.shuffle.sent.<dst>``
-    (the diagonal is this process's own share — it moves by local read,
-    not the network) and, with the tracer armed, land as cumulative
-    ``mh.shuffle.sent`` counter-track samples so Perfetto renders a
-    per-peer outgoing-bytes series.  The receiver measures the same
-    edges independently (``mh.shuffle.recv.<src>``); mesh_report and the
+    Sender side of the shuffle byte matrix: the **wire** bytes addressed
+    to each destination process count ``mh.shuffle.sent.<dst>``
+    (compressed bytes on the compressed plane; the diagonal is this
+    process's own share — it moves by local read, not the network), with
+    the raw twin ``mh.shuffle.sent_raw.<dst>`` making the per-edge
+    compression ratio first-class.  With the tracer armed the wire
+    bytes also land as cumulative ``mh.shuffle.sent`` counter-track
+    samples.  The receiver measures the same edges independently
+    (``mh.shuffle.recv.<src>`` / ``recv_raw``); mesh_report and the
     ClusterManifest assert the two sides agree per edge.
     """
     L = ctx.local_device_count
@@ -369,19 +636,36 @@ def _write_byte_runs(
         offs = np.empty(len(order) + 1, dtype=np.int64)
         offs[0] = 0
         np.cumsum(lens[order], out=offs[1:])
-        METRICS.count(f"mh.shuffle.sent.{q}", int(offs[-1]))
-        sent_track[str(q)] = float(offs[-1])
-        TRACER.counter("mh.shuffle.sent", sent_track)
+        raw_total = int(offs[-1])
+        METRICS.count(f"mh.shuffle.sent_raw.{q}", raw_total)
         base = _bytes_file(shuffle_dir, ctx.process_id, q)
-        for path, payload, rawbytes in (
-            (base + ".bin", stream, True),
-            (base + ".rows", g_row[order], False),
-            (base + ".offs", offs, False),
-        ):
+        if compress:
+            with span("mh.byte_shuffle.deflate", category="stage"):
+                comp, mtab = _deflate_member_stream(
+                    stream, dstream, level, member_bytes
+                )
+            wire = len(comp)
+            targets = (
+                (base + ".bgzf", memoryview(comp), True),
+                (base + _MTAB_SUFFIX, mtab, False),
+                (base + ".rows", g_row[order], False),
+                (base + ".offs", offs, False),
+            )
+        else:
+            wire = raw_total
+            targets = (
+                (base + ".bin", memoryview(stream), True),
+                (base + ".rows", g_row[order], False),
+                (base + ".offs", offs, False),
+            )
+        METRICS.count(f"mh.shuffle.sent.{q}", wire)
+        sent_track[str(q)] = float(wire)
+        TRACER.counter("mh.shuffle.sent", sent_track)
+        for path, payload, rawbytes in targets:
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 if rawbytes:
-                    f.write(memoryview(payload))  # no tobytes() copy
+                    f.write(payload)  # no tobytes() copy
                 else:
                     np.save(f, payload)
             os.replace(tmp, path)
@@ -395,10 +679,23 @@ class _ByteFetcher:
     directory (shared-FS plane, and the local fast path for a process's
     own files) or an ``(http_base, token)`` endpoint (network plane —
     the Hadoop shuffle's HTTP fetch, authenticated by the job's fetch
-    token)."""
+    token).
+
+    On the compressed plane each fetch pulls the ``.bgzf`` member stream
+    (fewer bytes on the same wire) and inflates it *inside the fetch
+    thread* through the stream's decode seam (the inflate lanes when
+    armed, native zlib otherwise) — so source A's inflate overlaps
+    source B's fetch instead of serializing after the whole fetch phase
+    (visible as ``mh.byte_shuffle.inflate`` stage events nested in the
+    fetch stage).  ``errors="salvage"`` quarantines corrupt members
+    (CRC-failing after the wire) instead of failing the sort; the
+    records they carried are dropped at :meth:`gather` time with
+    ``salvage.*`` counters, survivors byte-exact."""
 
     def __init__(self, sources: List, ctx: MultihostContext,
-                 rows_per_device: int):
+                 rows_per_device: int, compress: bool = False,
+                 dstream=None, fetch_threads: int = 8,
+                 errors: Optional[str] = None):
         import io as _io
         from concurrent.futures import ThreadPoolExecutor
 
@@ -406,9 +703,13 @@ class _ByteFetcher:
 
         self.rows = rows_per_device
         self.ctx = ctx
+        P_ = ctx.num_processes
+        #: Per source: quarantined raw intervals (salvage mode only).
+        self.bad: List[List[Tuple[int, int]]] = [[] for _ in range(P_)]
 
         def fetch_one(s: int):
             name = _bytes_name(s, ctx.process_id)
+            ext = ".bgzf" if compress else ".bin"
             if isinstance(sources[s], tuple):
                 url, token = sources[s]
                 f = HttpFilesystem(
@@ -416,30 +717,55 @@ class _ByteFetcher:
                     retry_metric="mh.http.fetch_retries",
                 )
                 base = url.rstrip("/")
-                got = (
-                    np.frombuffer(
-                        f.read_all(f"{base}/{name}.bin"), dtype=np.uint8
-                    ),
-                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.rows"))),
-                    np.load(_io.BytesIO(f.read_all(f"{base}/{name}.offs"))),
+
+                def rd(suffix: str) -> bytes:
+                    return f.read_all(f"{base}/{name}{suffix}")
+
+                wire_buf = np.frombuffer(rd(ext), dtype=np.uint8)
+                rows = np.load(_io.BytesIO(rd(".rows")))
+                offs = np.load(_io.BytesIO(rd(".offs")))
+                mtab = (
+                    np.load(_io.BytesIO(rd(_MTAB_SUFFIX)))
+                    if compress
+                    else None
                 )
             else:
                 p = os.path.join(sources[s], name)
-                with open(p + ".bin", "rb") as fh:
-                    buf = np.frombuffer(fh.read(), dtype=np.uint8)
-                got = buf, np.load(p + ".rows"), np.load(p + ".offs")
+                with open(p + ext, "rb") as fh:
+                    wire_buf = np.frombuffer(fh.read(), dtype=np.uint8)
+                rows = np.load(p + ".rows")
+                offs = np.load(p + ".offs")
+                mtab = np.load(p + _MTAB_SUFFIX) if compress else None
             # Receiver side of the shuffle byte matrix, measured from the
             # bytes that actually arrived (not inferred from the sender).
-            METRICS.count(f"mh.shuffle.recv.{s}", int(len(got[0])))
+            METRICS.count(f"mh.shuffle.recv.{s}", int(len(wire_buf)))
             TRACER.counter(
-                "mh.shuffle.recv", {str(s): float(len(got[0]))}
+                "mh.shuffle.recv", {str(s): float(len(wire_buf))}
             )
-            return got
+            if compress:
+                with span("mh.byte_shuffle.inflate", category="stage"):
+                    raw, bad = _inflate_member_stream(
+                        wire_buf, mtab, dstream, errors
+                    )
+                self.bad[s] = bad
+            else:
+                raw = wire_buf
+            METRICS.count(f"mh.shuffle.recv_raw.{s}", int(len(raw)))
+            if len(offs) and int(offs[-1]) != len(raw):
+                raise RuntimeError(
+                    f"byte shuffle sidecar desync from process {s}: "
+                    f"offs address {int(offs[-1])} raw bytes, stream "
+                    f"carries {len(raw)}"
+                )
+            return raw, rows, offs
 
         # Pull peers concurrently (Hadoop's parallel copier): the fetch
-        # phase is network-bound, not peer-count-bound.
-        P_ = ctx.num_processes
-        with ThreadPoolExecutor(max_workers=min(8, P_)) as pool:
+        # phase is network-bound, not peer-count-bound.  Pool width is
+        # ``hadoopbam.shuffle.fetch-threads`` (surfaced in the host
+        # manifest), capped at the peer count.
+        with ThreadPoolExecutor(
+            max_workers=max(1, min(fetch_threads, P_))
+        ) as pool:
             got = list(pool.map(fetch_one, range(P_)))
         bufs = [g[0] for g in got]
         self.rows_tab = [g[1] for g in got]
@@ -460,6 +786,13 @@ class _ByteFetcher:
 
         Buffers are concatenated once and the ragged copy is a single
         ``native.gather_records`` call — no per-record Python loop.
+
+        Salvage mode only: records whose raw span touches a quarantined
+        member's interval are DROPPED from the output (counted as
+        ``salvage.records_dropped``; a record straddling into a bad
+        member is unrecoverable too) — the returned arrays then hold the
+        byte-exact survivors in unchanged order.  Strict runs (and clean
+        salvage runs) return exactly the pre-compression contract.
         """
         L = self.ctx.local_device_count
         g = src_dev.astype(np.int64) * self.rows + src_row.astype(np.int64)
@@ -467,6 +800,7 @@ class _ByteFetcher:
         n = len(g)
         out_len = np.zeros(n, dtype=np.int64)
         src_off = np.zeros(n, dtype=np.int64)
+        keep: Optional[np.ndarray] = None
         for s in range(self.ctx.num_processes):
             m = src_proc == s
             if not m.any():
@@ -480,6 +814,28 @@ class _ByteFetcher:
                 )
             src_off[m] = self.offs_tab[s][idx] + self.base[s]
             out_len[m] = self.offs_tab[s][idx + 1] - self.offs_tab[s][idx]
+            if self.bad[s]:
+                # Quarantined intervals are sorted and disjoint (member
+                # spans): a record overlaps one iff the first interval
+                # ending after the record's start begins before its end.
+                lo = np.asarray(self.offs_tab[s][idx], dtype=np.int64)
+                hi = lo + out_len[m]
+                starts = np.array([a for a, _ in self.bad[s]], np.int64)
+                ends = np.array([b for _, b in self.bad[s]], np.int64)
+                j = np.searchsorted(ends, lo, side="right")
+                ov = (j < len(starts)) & (
+                    starts[np.minimum(j, len(starts) - 1)] < hi
+                )
+                if ov.any():
+                    if keep is None:
+                        keep = np.ones(n, dtype=bool)
+                    keep[np.nonzero(m)[0][ov]] = False
+        if keep is not None:
+            ndrop = int((~keep).sum())
+            METRICS.count("salvage.records_dropped", ndrop)
+            src_off = src_off[keep]
+            out_len = out_len[keep]
+            n = len(src_off)
         data = native.gather_records(
             self.big, src_off + 4, out_len - 4, order=None
         )
@@ -532,11 +888,26 @@ class _RunAccess:
     """Uniform access to one process's spill runs for the budget plane:
     a local directory (shared-FS plane / own files, memmapped sidecars)
     or an ``(http_base, token)`` endpoint (network plane, ranged reads).
-    Per-run handles are cached; bulk data never is."""
+    Per-run handles are cached; bulk data never is.
 
-    def __init__(self, source):
+    On the compressed plane the run's data file is a BGZF member stream
+    (the spill IS the wire format — the budget now bounds *compressed*
+    residency) and byte addressing stays in raw space via the
+    ``.mtab.npy`` member table: :meth:`read_into` fetches exactly the
+    compressed members covering the requested raw span and inflates them
+    per window at gather time.  A one-member cache per run keeps a
+    boundary member shared by two adjacent device windows from being
+    fetched (or counted) twice, so the receiver-side wire accounting
+    equals the sender's analytic member-cover count per edge."""
+
+    def __init__(self, source, compressed: bool = False, dstream=None):
         self._source = source
         self._cache: dict = {}
+        self.compressed = compressed
+        self._dstream = dstream
+        #: Per run: (member index, inflated payload) of the last member
+        #: of the previous window — the boundary-member reuse cache.
+        self._last: dict = {}
 
     def _handles(self, j: int):
         got = self._cache.get(j)
@@ -550,11 +921,19 @@ class _RunAccess:
             url, token = self._source
             f = HttpFilesystem(headers={"X-Hbam-Token": token})
             stem = f"{url.rstrip('/')}/run-{j:05d}"
+            mtab = None
+            if self.compressed:
+                import io as _io
+
+                mtab = np.load(
+                    _io.BytesIO(f.read_all(stem + _MTAB_SUFFIX))
+                )
             got = (
                 _RemoteNpy(f, stem + runs_mod.RUN_KEYS_EXT),
                 _RemoteNpy(f, stem + runs_mod.RUN_OFFS_EXT),
                 _RemoteNpy(f, stem + ".org.npy"),
                 (f, stem + runs_mod.RUN_DATA_EXT),
+                mtab,
             )
         else:
             run = runs_mod.Run.open(self._source, j)
@@ -562,7 +941,14 @@ class _RunAccess:
                 os.path.join(self._source, f"run-{j:05d}.org.npy"),
                 mmap_mode="r",
             )
-            got = (run.keys, run.offs, org, run.data_path)
+            mtab = None
+            if self.compressed:
+                mtab = np.load(
+                    os.path.join(
+                        self._source, f"run-{j:05d}{_MTAB_SUFFIX}"
+                    )
+                )
+            got = (run.keys, run.offs, org, run.data_path, mtab)
         self._cache[j] = got
         return got
 
@@ -574,7 +960,7 @@ class _RunAccess:
 
     def slices(self, j: int, i0: int, i1: int):
         """(keys[i0:i1], org[i0:i1], lens, byte_start, byte_len)."""
-        keys, offs, org, _ = self._handles(j)
+        keys, offs, org, _, _ = self._handles(j)
         o = self._sl(offs, i0, i1 + 1)
         return (
             self._sl(keys, i0, i1),
@@ -584,20 +970,66 @@ class _RunAccess:
             int(o[-1] - o[0]),
         )
 
-    def read_into(self, j: int, view, byte_start: int, size: int) -> None:
-        _, _, _, loc = self._handles(j)
+    def _read_span(self, loc, start: int, size: int) -> np.ndarray:
         if isinstance(loc, tuple):
             f, url = loc
-            data = f.read_range(url, byte_start, size)
+            data = f.read_range(url, start, size)
             if len(data) != size:
                 raise IOError(f"short HTTP read from {url}")
-            view[:] = np.frombuffer(data, np.uint8)
-        else:
-            with open(loc, "rb") as fh:
-                fh.seek(byte_start)
-                got = fh.readinto(memoryview(view))
-            if got != size:
-                raise IOError(f"short read from spill run {loc}")
+            return np.frombuffer(data, np.uint8)
+        out = np.empty(size, dtype=np.uint8)
+        with open(loc, "rb") as fh:
+            fh.seek(start)
+            got = fh.readinto(memoryview(out))
+        if got != size:
+            raise IOError(f"short read from spill run {loc}")
+        return out
+
+    def read_into(self, j: int, view, byte_start: int, size: int) -> int:
+        """Fill ``view`` with raw record bytes [byte_start, byte_start+
+        size) of run ``j``; returns the WIRE bytes newly pulled for it
+        (== size on the raw plane; the compressed members fetched —
+        boundary member deduplicated — on the compressed plane)."""
+        _, _, _, loc, mtab = self._handles(j)
+        if not self.compressed:
+            view[:] = self._read_span(loc, byte_start, size)
+            return size
+        m = mtab.reshape(-1, 4)
+        m0, m1 = _member_cover(mtab, byte_start, byte_start + size)
+        if m1 <= m0:
+            return 0
+        parts: List[np.ndarray] = []
+        wire = 0
+        fetch0 = m0
+        cached = self._last.get(j)
+        if cached is not None and cached[0] == m0:
+            parts.append(cached[1])
+            fetch0 = m0 + 1
+        if fetch0 < m1:
+            c0 = int(m[fetch0, 2])
+            c1 = int(m[m1 - 1, 2] + m[m1 - 1, 3])
+            comp = self._read_span(loc, c0, c1 - c0)
+            wire = c1 - c0
+            co = np.ascontiguousarray(m[fetch0:m1, 2] - c0, np.int64)
+            cs = np.ascontiguousarray(m[fetch0:m1, 3], np.int32)
+            us = np.ascontiguousarray(m[fetch0:m1, 1], np.int32)
+            with span("mh.byte_shuffle.inflate", category="stage"):
+                if self._dstream is not None:
+                    raw, roffs = self._dstream.decode_members(
+                        comp, co, cs, us, on_error="host"
+                    )
+                else:
+                    raw, roffs = native.inflate_blocks(comp, co, cs, us)
+            parts.append(raw)
+            # Cache the final member alone for the next window's seam.
+            self._last[j] = (
+                m1 - 1,
+                np.array(raw[int(roffs[-2]) : int(roffs[-1])], copy=True),
+            )
+        raw_all = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        s0 = byte_start - int(m[m0, 0])
+        view[:] = raw_all[s0 : s0 + size]
+        return wire
 
 
 def _budget_byte_plane(
@@ -612,6 +1044,8 @@ def _budget_byte_plane(
     peak_bytes: int,
     RecordBatch,
     write_part_fast,
+    compress: bool = False,
+    dstream=None,
 ) -> Tuple[int, List[int]]:
     """Out-of-core byte plane: the key-sorted spill runs ARE the shuffle.
 
@@ -630,7 +1064,13 @@ def _budget_byte_plane(
     ``mh.shuffle.sent.<dst>`` comes from its own runs' byte offsets at
     the cut indices (the runs ARE the byte plane, so the slice byte
     spans are the shipped bytes), the receiver's ``mh.shuffle.recv.<src>``
-    from the slice bytes it actually read."""
+    from the slice bytes it actually read.  With ``compress`` the runs
+    were spilled as BGZF member streams: both sides count the WIRE bytes
+    of the members covering each slice (the sender analytically from the
+    member table, the receiver from the member spans it actually pulled,
+    boundary members deduplicated) with raw twins beside them, and
+    receivers inflate per window — the memory budget bounds compressed
+    fetch residency."""
     P_ = ctx.num_processes
     L = ctx.local_device_count
     n_runs_of = [
@@ -653,22 +1093,41 @@ def _budget_byte_plane(
 
     own_dir = sources[ctx.process_id]
     sent_bytes = np.zeros(P_, dtype=np.int64)
+    sent_raw = np.zeros(P_, dtype=np.int64)
     for j in range(len(own_counts)):
         run = runs_mod.Run.open(own_dir, j)
+        mtab_j = (
+            np.load(os.path.join(own_dir, f"run-{j:05d}{_MTAB_SUFFIX}"))
+            if compress
+            else None
+        )
         for q in range(P_):
-            sent_bytes[q] += run.bytes_between(
-                int(cuts[j][q * L]), int(cuts[j][(q + 1) * L])
-            )
+            i0 = int(cuts[j][q * L])
+            i1 = int(cuts[j][(q + 1) * L])
+            raw_b = run.bytes_between(i0, i1)
+            sent_raw[q] += raw_b
+            if compress:
+                b0 = int(run.offs[i0])
+                sent_bytes[q] += _cover_comp_bytes(
+                    mtab_j, b0, b0 + raw_b
+                )
+            else:
+                sent_bytes[q] += raw_b
     for q in range(P_):
         METRICS.count(f"mh.shuffle.sent.{q}", int(sent_bytes[q]))
+        METRICS.count(f"mh.shuffle.sent_raw.{q}", int(sent_raw[q]))
     TRACER.counter(
         "mh.shuffle.sent",
         {str(q): float(sent_bytes[q]) for q in range(P_)},
     )
     ctx.barrier("spill_published")
 
-    access = [_RunAccess(src) for src in sources]
+    access = [
+        _RunAccess(src, compressed=compress, dstream=dstream)
+        for src in sources
+    ]
     recv_bytes = np.zeros(P_, dtype=np.int64)
+    recv_raw = np.zeros(P_, dtype=np.int64)
     out_counts: List[int] = []
     with span("mh.range_merge", category="stage"):
         for g in range(ctx.process_id * L, (ctx.process_id + 1) * L):
@@ -689,7 +1148,7 @@ def _budget_byte_plane(
                         j, i0, i1
                     )
                     slices.append((s, j, b0, sz))
-                    recv_bytes[s] += sz
+                    recv_raw[s] += sz
                     key_parts.append(keys_s)
                     org_parts.append(org_s)
                     len_parts.append(lens_s)
@@ -698,7 +1157,9 @@ def _budget_byte_plane(
                 data = np.empty(total, dtype=np.uint8)
                 pos = 0
                 for s, j, b0, sz in slices:
-                    access[s].read_into(j, data[pos : pos + sz], b0, sz)
+                    recv_bytes[s] += access[s].read_into(
+                        j, data[pos : pos + sz], b0, sz
+                    )
                     pos += sz
                 lens = np.concatenate(len_parts)
                 keys_all = np.concatenate(key_parts)
@@ -737,6 +1198,7 @@ def _budget_byte_plane(
             del batch
     for s in range(P_):
         METRICS.count(f"mh.shuffle.recv.{s}", int(recv_bytes[s]))
+        METRICS.count(f"mh.shuffle.recv_raw.{s}", int(recv_raw[s]))
     TRACER.counter(
         "mh.shuffle.recv",
         {str(s): float(recv_bytes[s]) for s in range(P_)},
@@ -800,13 +1262,16 @@ class _MeshObservability:
     """
 
     def __init__(self, ctx: MultihostContext, enabled: bool,
-                 trace_dir: str, byte_plane: str, conf, budget: bool):
+                 trace_dir: str, byte_plane: str, conf, budget: bool,
+                 compressed: bool = False, fetch_threads: int = 8):
         self.ctx = ctx
         self.enabled = enabled
         self.trace_dir = trace_dir
         self.byte_plane = byte_plane
         self.conf = conf
         self.budget = budget
+        self.compressed = compressed
+        self.fetch_threads = fetch_threads
         self._started = False
         self.anchor_us = 0.0
         self.anchors: Optional[np.ndarray] = None
@@ -879,8 +1344,12 @@ class _MeshObservability:
             "records_local": int(n_local),
             "records_out": [int(c) for c in out_counts],
             "skew_ratio": float(skew_ratio),
+            "shuffle_compressed": self.compressed,
+            "fetch_threads": int(self.fetch_threads),
             "shuffle_sent_bytes": _edges("mh.shuffle.sent."),
             "shuffle_recv_bytes": _edges("mh.shuffle.recv."),
+            "shuffle_sent_raw_bytes": _edges("mh.shuffle.sent_raw."),
+            "shuffle_recv_raw_bytes": _edges("mh.shuffle.recv_raw."),
             "keys_sent_bytes": _edges("mh.keys.sent."),
             "keys_recv_bytes": _edges("mh.keys.recv."),
             "barrier_wait_ms": {
@@ -1044,6 +1513,7 @@ def sort_bam_multihost(
     byte_plane: str = "fs",
     mesh_trace: Optional[bool] = None,
     mesh_trace_dir: Optional[str] = None,
+    errors: Optional[str] = None,
 ) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime
     (full docs on the implementation below; resources — shuffle data
@@ -1055,14 +1525,22 @@ def sort_bam_multihost(
     process records a per-host timeline shard and a host manifest,
     process 0 collects them into ``mesh_trace_dir`` (default
     ``<out_path>.mesh-trace``) and folds a ClusterManifest — reduce with
-    ``tools/mesh_report.py``."""
+    ``tools/mesh_report.py``.
+
+    ``errors`` (default: ``hadoopbam.errors`` conf key, strict) selects
+    the compressed byte plane's corruption policy: strict fails the sort
+    on a member that arrives corrupt; ``"salvage"`` quarantines exactly
+    that member (``salvage.*`` counters) and finishes with the surviving
+    records byte-exact.  Salvage applies to the in-core fetch plane;
+    the budget plane's windowed reads stay strict (its spill runs are
+    local/validated, not in-flight fetches)."""
     import contextlib
 
     with contextlib.ExitStack() as stack:
         return _sort_bam_multihost_impl(
             in_paths, out_path, ctx, conf, split_size, level,
             samples_per_device, memory_budget, byte_plane, stack,
-            mesh_trace, mesh_trace_dir,
+            mesh_trace, mesh_trace_dir, errors,
         )
 
 
@@ -1079,6 +1557,7 @@ def _sort_bam_multihost_impl(
     _stack,
     mesh_trace: Optional[bool] = None,
     mesh_trace_dir: Optional[str] = None,
+    errors: Optional[str] = None,
 ) -> int:
     """Coordinate-sort BAM(s) across every process of the JAX runtime.
 
@@ -1122,6 +1601,19 @@ def _sort_bam_multihost_impl(
         ctx = initialize()
     if byte_plane not in ("fs", "http"):
         raise ValueError(f"byte_plane must be 'fs' or 'http': {byte_plane!r}")
+    if errors is None and conf is not None:
+        from ..conf import ERRORS_MODE
+
+        errors = conf.get(ERRORS_MODE)
+    # The compressed wire format + its per-job codec seams: tier policy,
+    # residency and donation resolve ONCE here (the DeviceStream), and
+    # every deflate/inflate the shuffle does rides that stream.
+    compress_shuffle = _resolve_shuffle_compress(conf)
+    member_bytes = _resolve_member_bytes(conf)
+    fetch_threads = _resolve_fetch_threads(conf)
+    from ..device_stream import DeviceStream
+
+    dstream = DeviceStream(conf=conf, name="mh.shuffle")
     obs = _MeshObservability(
         ctx,
         enabled=_resolve_mesh_trace(conf, mesh_trace),
@@ -1129,6 +1621,8 @@ def _sort_bam_multihost_impl(
         byte_plane=byte_plane,
         conf=conf,
         budget=memory_budget is not None,
+        compressed=compress_shuffle,
+        fetch_threads=fetch_threads,
     )
     obs.arm()
     if memory_budget is not None:
@@ -1200,7 +1694,13 @@ def _sort_bam_multihost_impl(
                     b = fmt.read_split(s)
                 peak_bytes = max(peak_bytes, int(len(b.data)))
                 perm = np.argsort(b.keys, kind="stable")
-                runs_mod.write_run(spill_dir, ri, b, perm)
+                if compress_shuffle:
+                    _write_run_compressed(
+                        spill_dir, ri, b, perm, dstream, level,
+                        member_bytes,
+                    )
+                else:
+                    runs_mod.write_run(spill_dir, ri, b, perm)
                 key_cols.append(np.ascontiguousarray(b.keys[perm]))
                 perm_cols.append(perm.astype(np.int64))
                 own_counts.append(b.n_records)
@@ -1394,7 +1894,9 @@ def _sort_bam_multihost_impl(
             _stack.callback(nio.delete_recursive, write_dir)
         with span("mh.byte_shuffle.write", category="stage"):
             _write_byte_runs(
-                write_dir, ctx, local, dest_of_record, row_of_record, rows
+                write_dir, ctx, local, dest_of_record, row_of_record,
+                rows, compress=compress_shuffle, dstream=dstream,
+                member_bytes=member_bytes, level=level,
             )
         if byte_plane == "http":
             sources: List = _start_http_plane(ctx, write_dir, _stack)
@@ -1410,7 +1912,11 @@ def _sort_bam_multihost_impl(
         # (the ExitStack owns server/spill teardown on every outcome).
         out_counts: List[int] = []
         with span("mh.byte_shuffle.fetch", category="stage"):
-            fetcher = _ByteFetcher(sources, ctx, rows)
+            fetcher = _ByteFetcher(
+                sources, ctx, rows, compress=compress_shuffle,
+                dstream=dstream, fetch_threads=fetch_threads,
+                errors=errors,
+            )
             cap_rows = res.hi.shape[0] // D
             v_sh = _local_view(res.valid, cap_rows)
             sd_sh = _local_view(res.src_dev, cap_rows)
@@ -1425,13 +1931,15 @@ def _sort_bam_multihost_impl(
                 sd = sd_sh[k][v]
                 sr = sr_sh[k][v]
                 data, rec_off, rec_len = fetcher.gather(sd, sr)
-                keys = np.zeros(len(sd), dtype=np.int64)  # writer-unused
+                # len(rec_off) == len(sd) except in salvage mode, where
+                # quarantined members' records were dropped.
+                keys = np.zeros(len(rec_off), dtype=np.int64)  # writer-unused
                 batch = RecordBatch(
                     soa={"rec_off": rec_off, "rec_len": rec_len},
                     data=data,
                     keys=keys,
                 )
-                out_counts.append(int(len(sd)))
+                out_counts.append(int(len(rec_off)))
                 tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
                 with open(tmp, "wb") as f:
                     write_part_fast(f, batch, order=None, level=level)
@@ -1452,6 +1960,7 @@ def _sort_bam_multihost_impl(
         peak_bytes, out_counts = _budget_byte_plane(
             ctx, td, sources, splits, own_counts, dest_of_record,
             level, D, peak_bytes, RecordBatch, write_part_fast,
+            compress=compress_shuffle, dstream=dstream,
         )
         cleanup_dir = spill_dir if byte_plane == "http" else None
 

@@ -226,6 +226,12 @@ class DistributedSort:
     ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Host convenience: int64 keys (padded to D*rows) → globally sorted
         keys + the permutation (indices into the input), via the device mesh.
+
+        Capacity overflow (a skewed input concentrating one (src, dst)
+        pair past ``capacity_per_pair``) retries ONCE automatically with
+        the capacity doubled — counted as ``mh.shuffle.capacity_retry``
+        — so skew degrades to one extra round-trip instead of a failed
+        sort; only a retry that *still* overflows raises.
         """
         from ..ops.keys import pack_keys_np, split_keys_np
 
@@ -253,10 +259,28 @@ class DistributedSort:
             jnp.asarray(inv.astype(np.int32)),
         )
         if int(res.overflow) > 0:
-            raise RuntimeError(
-                f"shuffle capacity exceeded by {int(res.overflow)} rows; "
-                f"re-run with larger capacity_per_pair (now {self.capacity})"
+            from ..utils.tracing import METRICS
+
+            METRICS.count("mh.shuffle.capacity_retry", 1)
+            retry = DistributedSort(
+                self.mesh,
+                rows_per_device=self.rows,
+                capacity_per_pair=min(self.rows, self.capacity * 2),
+                samples_per_device=self.samples,
             )
+            res = retry(
+                jnp.asarray(hi),
+                jnp.asarray(lo),
+                jnp.asarray(v),
+                jnp.asarray(inv.astype(np.int32)),
+            )
+            if int(res.overflow) > 0:
+                raise RuntimeError(
+                    f"shuffle capacity exceeded by {int(res.overflow)} "
+                    f"rows even after the doubled-capacity retry "
+                    f"(capacity {retry.capacity}); re-run with larger "
+                    "capacity_per_pair"
+                )
         s_val = np.asarray(res.valid)
         s_hi = np.asarray(res.hi)[s_val]
         s_lo = np.asarray(res.lo)[s_val]

@@ -1037,6 +1037,8 @@ class ClusterManifest:
         shuffle_bytes: int = 0,
         keys_bytes: int = 0,
         records: int = 0,
+        shuffle_raw_bytes: int = 0,
+        shuffle_ratio: Optional[float] = None,
     ) -> None:
         self.hosts = hosts
         self.byte_plane = byte_plane
@@ -1047,6 +1049,8 @@ class ClusterManifest:
         self.shuffle_bytes = shuffle_bytes
         self.keys_bytes = keys_bytes
         self.records = records
+        self.shuffle_raw_bytes = shuffle_raw_bytes
+        self.shuffle_ratio = shuffle_ratio
 
     def as_dict(self) -> dict:
         return {
@@ -1056,6 +1060,8 @@ class ClusterManifest:
             "edges_balanced": self.edges_balanced,
             "skew_ratio": self.skew_ratio,
             "shuffle_bytes": self.shuffle_bytes,
+            "shuffle_raw_bytes": self.shuffle_raw_bytes,
+            "shuffle_ratio": self.shuffle_ratio,
             "keys_bytes": self.keys_bytes,
             "records": self.records,
             "degraded": self.degraded,
@@ -1113,6 +1119,18 @@ def cluster_manifest(
         for h in hosts
         for b in (h.get("keys_sent_bytes") or {}).values()
     )
+    # Compression accounting (PR 15): the sent matrix counts WIRE bytes;
+    # its raw twin makes the cluster-wide shuffle ratio first-class.
+    shuffle_raw_bytes = sum(
+        int(b)
+        for h in hosts
+        for b in (h.get("shuffle_sent_raw_bytes") or {}).values()
+    )
+    shuffle_ratio = (
+        round(shuffle_raw_bytes / shuffle_bytes, 4)
+        if shuffle_bytes and shuffle_raw_bytes
+        else None
+    )
     records = sum(int(h.get("records_local", 0)) for h in hosts)
     skews = [h["skew_ratio"] for h in hosts if h.get("skew_ratio")]
     return ClusterManifest(
@@ -1126,6 +1144,8 @@ def cluster_manifest(
         shuffle_bytes=shuffle_bytes,
         keys_bytes=keys_bytes,
         records=records,
+        shuffle_raw_bytes=shuffle_raw_bytes,
+        shuffle_ratio=shuffle_ratio,
     )
 
 

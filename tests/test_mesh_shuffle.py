@@ -1,0 +1,635 @@
+"""Compressed-payload mesh shuffle (ISSUE 15): BGZF members as the
+cross-host data plane.
+
+Coverage layers:
+
+- **codec units**: member-table round-trip (compress → table → inflate
+  byte-exact), the empty stream, and the store-mode fallback on an
+  incompressible payload;
+- **key-plane lint**: ``KEY_ROW_BYTES`` recomputed from the dtypes that
+  actually cross ``lax.all_to_all`` (adding a seventh exchange buffer
+  without updating the constant fails here, not as a silently-wrong
+  byte matrix);
+- **sort_global capacity retry**: a skewed input overflows once, retries
+  automatically with doubled capacity (``mh.shuffle.capacity_retry``),
+  and only a still-overflowing retry raises;
+- **in-process runs** on the 8-device test mesh: compressed-vs-raw
+  byte identity (in-core and budget mode), wire-vs-raw twin counters,
+  the per-edge ratio in the ClusterManifest, the fetch-threads conf
+  resolution surfaced in the host manifest, the per-member deflate
+  tier-down mid-shuffle (interpret-mode lanes, ≤3 KiB members per the
+  test-budget note), and the ``mh.corrupt`` fault drill (strict raises,
+  salvage quarantines with ``salvage.*`` counters and byte-exact
+  survivors);
+- the **2-process spawned drill**: compressed FS, compressed HTTP and
+  raw HTTP planes back to back on one mesh — all three byte-identical
+  to the single-process oracle, the compressed trace's byte matrix
+  balanced in the wire domain with ratio > 1 and fewer cross-host wire
+  bytes than the raw plane shipped.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+from bench import synth_bam  # noqa: E402
+
+
+def _load_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh_report_mod():
+    return _load_module(REPO / "tools" / "mesh_report.py", "mesh_report")
+
+
+@pytest.fixture(scope="module")
+def bam_small(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("mesh_shuf") / "in.bam")
+    synth_bam(p, 8_000)
+    return p
+
+
+@pytest.fixture(scope="module")
+def oracle_small(bam_small, tmp_path_factory):
+    """Raw-plane in-core multihost sort of ``bam_small`` — the oracle
+    every compressed-plane variant (in-core, budget, salvage survivors)
+    is compared against; one sort shared across the module."""
+    from hadoop_bam_tpu.conf import SHUFFLE_COMPRESS, Configuration
+    from hadoop_bam_tpu.parallel import multihost
+
+    out = str(tmp_path_factory.mktemp("mesh_shuf_oracle") / "oracle.bam")
+    ctx = multihost.initialize()
+    multihost.sort_bam_multihost(
+        [bam_small], out, ctx=ctx,
+        conf=Configuration({SHUFFLE_COMPRESS: "false"}),
+        split_size=1 << 17, level=1,
+    )
+    return out
+
+
+def _counters():
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    return dict(METRICS.report()["counters"])
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _records_of(bam_path):
+    """Decompressed (header bytes, [record bytes, …]) of a BAM file —
+    the survivors-exact assertion walks these."""
+    from hadoop_bam_tpu import native
+
+    raw = native.decompress_all(open(bam_path, "rb").read()).tobytes()
+    assert raw[:4] == b"BAM\x01"
+    l_text = struct.unpack_from("<I", raw, 4)[0]
+    pos = 8 + l_text
+    n_ref = struct.unpack_from("<I", raw, pos)[0]
+    pos += 4
+    for _ in range(n_ref):
+        l_name = struct.unpack_from("<I", raw, pos)[0]
+        pos += 4 + l_name + 4
+    header = raw[:pos]
+    recs = []
+    while pos < len(raw):
+        sz = struct.unpack_from("<I", raw, pos)[0]
+        recs.append(raw[pos : pos + 4 + sz])
+        pos += 4 + sz
+    return header, recs
+
+
+# ---------------------------------------------------------------------------
+# Codec units: the member table round-trip.
+# ---------------------------------------------------------------------------
+
+
+def test_member_table_roundtrip():
+    """Compress → member table consistent with the deterministic
+    blocking → batched inflate reproduces the input byte-exactly."""
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.parallel import multihost as mh
+
+    rng = np.random.default_rng(7)
+    # Compressible, record-stream-shaped payload spanning many members.
+    raw = np.tile(
+        np.arange(256, dtype=np.uint8), 40
+    )  # 10240 B of repeating bytes
+    raw = np.concatenate([raw, rng.integers(0, 4, 2000).astype(np.uint8)])
+    member = 2048
+    comp, mtab = mh._deflate_member_stream(raw, None, 1, member)
+    m = mtab.reshape(-1, 4)
+    assert len(m) == -(-len(raw) // member)
+    # Raw space tiles the input at the blocking cut.
+    assert list(m[:, 0]) == [i * member for i in range(len(m))]
+    assert int(m[:, 1].sum()) == len(raw)
+    assert int(m[-1, 1]) == len(raw) - (len(m) - 1) * member
+    # Comp space tiles the member stream.
+    assert int(m[0, 2]) == 0
+    assert int(m[-1, 2] + m[-1, 3]) == len(comp)
+    assert np.array_equal(m[1:, 2], m[:-1, 2] + m[:-1, 3])
+    # Round-trip through the receiver's inflate path, strict mode.
+    out, bad = mh._inflate_member_stream(
+        np.frombuffer(comp, np.uint8), mtab, None, None
+    )
+    assert bad == [] and np.array_equal(out, raw)
+    # The generic scanner agrees with the table.
+    co, cs, us = native.scan_blocks(np.frombuffer(comp, np.uint8))
+    assert np.array_equal(co, m[:, 2]) and np.array_equal(us, m[:, 1])
+
+
+def test_member_table_empty_and_cover():
+    from hadoop_bam_tpu.parallel import multihost as mh
+
+    comp, mtab = mh._deflate_member_stream(
+        np.empty(0, np.uint8), None, 1, 2048
+    )
+    assert comp == b"" and len(mtab) == 0
+    out, bad = mh._inflate_member_stream(
+        np.empty(0, np.uint8), mtab, None, None
+    )
+    assert len(out) == 0 and bad == []
+    # Member-cover math on a synthetic 3-member table.
+    m = np.array(
+        [[0, 100, 0, 50], [100, 100, 50, 60], [200, 50, 110, 30]],
+        np.int64,
+    ).reshape(-1)
+    assert mh._member_cover(m, 0, 100) == (0, 1)
+    assert mh._member_cover(m, 0, 101) == (0, 2)
+    assert mh._member_cover(m, 99, 100) == (0, 1)
+    assert mh._member_cover(m, 100, 200) == (1, 2)
+    assert mh._member_cover(m, 150, 220) == (1, 3)
+    assert mh._member_cover(m, 5, 5) == (0, 0)
+    assert mh._cover_comp_bytes(m, 0, 100) == 50
+    assert mh._cover_comp_bytes(m, 150, 220) == 90
+    assert mh._cover_comp_bytes(m, 5, 5) == 0
+
+
+def test_store_mode_fallback_on_incompressible():
+    """A stream deflate would GROW falls back to stored members —
+    bounded framing overhead, counted, still byte-exact."""
+    from hadoop_bam_tpu.parallel import multihost as mh
+
+    rng = np.random.default_rng(13)
+    raw = rng.integers(0, 256, 10_000).astype(np.uint8)  # incompressible
+    before = _counters()
+    comp, mtab = mh._deflate_member_stream(raw, None, 1, 2048)
+    after = _counters()
+    assert _delta(before, after, "mh.shuffle.store_fallback") == 1
+    # Stored members: ~31 B overhead per member, never deflate expansion.
+    assert len(raw) < len(comp) < len(raw) + 40 * len(mtab.reshape(-1, 4))
+    out, bad = mh._inflate_member_stream(
+        np.frombuffer(comp, np.uint8), mtab, None, None
+    )
+    assert bad == [] and np.array_equal(out, raw)
+
+
+# ---------------------------------------------------------------------------
+# Key-plane lint: KEY_ROW_BYTES recomputed from the exchange dtypes.
+# ---------------------------------------------------------------------------
+
+
+def test_key_row_bytes_matches_exchange_dtypes(monkeypatch):
+    """The byte accounting's hand-summed constant is recomputed from the
+    dtypes that ACTUALLY cross ``lax.all_to_all``: a seventh exchange
+    buffer (or a widened column) desyncs here at trace time instead of
+    silently skewing the key-plane matrix."""
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.parallel import shuffle as sh
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    recorded = []
+    orig = jax.lax.all_to_all
+
+    def spy(x, *a, **k):
+        recorded.append(x.dtype)
+        return orig(x, *a, **k)
+
+    monkeypatch.setattr(jax.lax, "all_to_all", spy)
+    mesh = make_mesh()
+    ds = sh.DistributedSort(mesh, rows_per_device=4, samples_per_device=4)
+    n = mesh.devices.size * 4
+    shd = ds.sharding()
+    ds(
+        jax.device_put(jnp.zeros(n, jnp.int32), shd),
+        jax.device_put(jnp.zeros(n, jnp.uint32), shd),
+        jax.device_put(jnp.ones(n, bool), shd),
+    )
+    assert len(recorded) == 6, recorded
+    assert sum(d.itemsize for d in recorded) == sh.KEY_ROW_BYTES
+
+
+# ---------------------------------------------------------------------------
+# sort_global: automatic doubled-capacity retry on overflow.
+# ---------------------------------------------------------------------------
+
+
+def test_sort_global_capacity_retry():
+    """All-equal keys concentrate every row on one destination device:
+    the first pass overflows, the automatic doubled-capacity retry
+    lands, and the result is still a correct stable sort."""
+    from hadoop_bam_tpu.parallel import shuffle as sh
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ds = sh.DistributedSort(
+        mesh, rows_per_device=8, capacity_per_pair=4, samples_per_device=4
+    )
+    keys = np.full(48, 42, dtype=np.int64)
+    before = _counters()
+    skeys, perm, ovf = ds.sort_global(keys)
+    after = _counters()
+    assert _delta(before, after, "mh.shuffle.capacity_retry") == 1
+    assert ovf == 0
+    assert np.array_equal(skeys, np.sort(keys))
+    assert sorted(perm.tolist()) == list(range(48))
+
+
+def test_sort_global_retry_overflow_still_raises():
+    from hadoop_bam_tpu.parallel import shuffle as sh
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ds = sh.DistributedSort(
+        mesh, rows_per_device=8, capacity_per_pair=2, samples_per_device=4
+    )
+    with pytest.raises(RuntimeError, match="doubled-capacity retry"):
+        ds.sort_global(np.full(60, 7, dtype=np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Conf resolution.
+# ---------------------------------------------------------------------------
+
+
+def test_shuffle_conf_resolution(monkeypatch):
+    from hadoop_bam_tpu.conf import (
+        SHUFFLE_COMPRESS,
+        SHUFFLE_FETCH_THREADS,
+        SHUFFLE_MEMBER_BYTES,
+        Configuration,
+    )
+    from hadoop_bam_tpu.ops.flate import DEV_MAX_PAYLOAD
+    from hadoop_bam_tpu.parallel import multihost as mh
+
+    for var in (
+        "HBAM_SHUFFLE_COMPRESS",
+        "HBAM_SHUFFLE_FETCH_THREADS",
+        "HBAM_SHUFFLE_MEMBER_BYTES",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    # Compression defaults on; conf key and env both select the raw plane.
+    assert mh._resolve_shuffle_compress(None) is True
+    assert (
+        mh._resolve_shuffle_compress(
+            Configuration({SHUFFLE_COMPRESS: "false"})
+        )
+        is False
+    )
+    monkeypatch.setenv("HBAM_SHUFFLE_COMPRESS", "0")
+    assert mh._resolve_shuffle_compress(None) is False
+    # Conf wins over env.
+    assert (
+        mh._resolve_shuffle_compress(Configuration({SHUFFLE_COMPRESS: "on"}))
+        is True
+    )
+    # Fetch threads: conf → env → 8.
+    assert mh._resolve_fetch_threads(None) == 8
+    monkeypatch.setenv("HBAM_SHUFFLE_FETCH_THREADS", "3")
+    assert mh._resolve_fetch_threads(None) == 3
+    assert (
+        mh._resolve_fetch_threads(Configuration({SHUFFLE_FETCH_THREADS: "5"}))
+        == 5
+    )
+    # Member bytes clamp to the device codec cap.
+    assert mh._resolve_member_bytes(None) == DEV_MAX_PAYLOAD
+    assert (
+        mh._resolve_member_bytes(Configuration({SHUFFLE_MEMBER_BYTES: "2048"}))
+        == 2048
+    )
+    assert (
+        mh._resolve_member_bytes(
+            Configuration({SHUFFLE_MEMBER_BYTES: str(1 << 20)})
+        )
+        == DEV_MAX_PAYLOAD
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-process runs on the 8-device test mesh.
+# ---------------------------------------------------------------------------
+
+
+def test_compressed_plane_byte_identity_and_ratio(
+    bam_small, oracle_small, tmp_path, monkeypatch
+):
+    """Compressed (default) vs raw plane on the in-core path: identical
+    output bytes; wire counters shrink while the raw twins match the
+    raw plane's wire; the ClusterManifest carries the first-class ratio
+    and the resolved fetch-thread count."""
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.parallel import multihost
+
+    monkeypatch.setenv("HBAM_SHUFFLE_FETCH_THREADS", "4")
+    ctx = multihost.initialize()
+    out_c = str(tmp_path / "c.bam")
+    td = str(tmp_path / "mesh-trace")
+    before = _counters()
+    multihost.sort_bam_multihost(
+        [bam_small], out_c, ctx=ctx, split_size=1 << 17, level=1,
+        mesh_trace=True, mesh_trace_dir=td,
+    )
+    after = _counters()
+    d1 = native.decompress_all(open(out_c, "rb").read())
+    d2 = native.decompress_all(open(oracle_small, "rb").read())
+    assert np.array_equal(d1, d2), "compressed plane changed the output"
+
+    wire = _delta(before, after, "mh.shuffle.sent.0")
+    raw = _delta(before, after, "mh.shuffle.sent_raw.0")
+    assert 0 < wire < raw and raw == 8_000 * 200
+    assert _delta(before, after, "mh.shuffle.recv.0") == wire
+    assert _delta(before, after, "mh.shuffle.recv_raw.0") == raw
+
+    cm = multihost.LAST_CLUSTER_MANIFEST
+    assert cm and not cm["degraded"] and cm["edges_balanced"]
+    assert cm["shuffle_bytes"] == wire
+    assert cm["shuffle_raw_bytes"] == raw
+    assert cm["shuffle_ratio"] == pytest.approx(raw / wire, rel=1e-3)
+    assert cm["shuffle_ratio"] > 3.0  # the ≥3x acceptance bar
+    h0 = cm["hosts"][0]
+    assert h0["shuffle_compressed"] is True
+    assert h0["fetch_threads"] == 4
+    assert h0["shuffle_sent_raw_bytes"] == h0["shuffle_recv_raw_bytes"]
+    # Deflate/inflate ride the trace as stages nested in write/fetch —
+    # overlapped with the data plane, not serialized after it.
+    with open(os.path.join(td, "trace-h000.json")) as f:
+        evs = json.load(f)["traceEvents"]
+    stages = {e["name"] for e in evs if e.get("cat") == "stage"}
+    assert {"mh.byte_shuffle.deflate", "mh.byte_shuffle.inflate"} <= stages
+    fetch = next(
+        e for e in evs
+        if e["name"] == "mh.byte_shuffle.fetch" and e.get("ph") == "X"
+    )
+    f0, f1 = fetch["ts"], fetch["ts"] + fetch["dur"]
+    infl = [
+        e for e in evs
+        if e["name"] == "mh.byte_shuffle.inflate" and e.get("ph") == "X"
+    ]
+    assert infl and all(
+        f0 <= e["ts"] and e["ts"] + e["dur"] <= f1 + 1 for e in infl
+    ), "inflate must overlap the fetch stage, not follow it"
+
+
+def test_budget_mode_compressed_spill(bam_small, oracle_small, tmp_path):
+    """Out-of-core: the spill runs ARE compressed members; receivers
+    inflate per window, the wire matrix balances in the compressed
+    domain (boundary members deduplicated), and the output is
+    byte-identical to the raw-plane in-core oracle (the budget path's
+    standing byte-identity contract)."""
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.parallel import multihost
+
+    ctx = multihost.initialize()
+    budget = 3 << 20
+    out_c = str(tmp_path / "bc.bam")
+    td = str(tmp_path / "mesh-trace")
+    before = _counters()
+    multihost.sort_bam_multihost(
+        [bam_small], out_c, ctx=ctx, split_size=1 << 17, level=1,
+        memory_budget=budget, mesh_trace=True, mesh_trace_dir=td,
+    )
+    after = _counters()
+    d1 = native.decompress_all(open(out_c, "rb").read())
+    d2 = native.decompress_all(open(oracle_small, "rb").read())
+    assert np.array_equal(d1, d2), "budget compressed plane changed output"
+    wire = _delta(before, after, "mh.shuffle.sent.0")
+    raw = _delta(before, after, "mh.shuffle.sent_raw.0")
+    assert 0 < wire < raw
+    # Receiver-side wire accounting equals the sender's analytic member
+    # cover — the balance assert in the compressed domain.
+    assert _delta(before, after, "mh.shuffle.recv.0") == wire
+    assert _delta(before, after, "mh.shuffle.recv_raw.0") == raw
+    cm = multihost.LAST_CLUSTER_MANIFEST
+    assert cm["edges_balanced"] and not cm["degraded"]
+    assert cm["shuffle_ratio"] and cm["shuffle_ratio"] > 3.0
+    assert 0 < multihost.LAST_STATS["peak_bytes"] <= budget
+
+
+def test_per_member_tierdown_mid_shuffle(tmp_path, monkeypatch):
+    """Device deflate on the shuffle sender (interpret-mode lanes,
+    ≤3 KiB members per the test-budget note) with one member forced
+    down to host zlib by the PR 7 fault seam: the mixed-tier member
+    stream stays byte-exact end to end."""
+    from hadoop_bam_tpu import faults, native
+    from hadoop_bam_tpu.parallel import multihost
+
+    # ~60 records ≈ 12 KB raw → 6 members of ≤2 KiB: inside the ≤3 KiB
+    # interpret-mode budget and the same pow2 lane bucket the always-on
+    # deflate-lanes tests compile (shared jit geometry).
+    src = str(tmp_path / "in.bam")
+    synth_bam(src, 60)
+    ctx = multihost.initialize()
+    oracle = str(tmp_path / "oracle.bam")
+    monkeypatch.setenv("HBAM_SHUFFLE_COMPRESS", "0")
+    multihost.sort_bam_multihost(
+        [src], oracle, ctx=ctx, split_size=1 << 16, level=1
+    )
+    monkeypatch.delenv("HBAM_SHUFFLE_COMPRESS")
+    monkeypatch.setenv("HBAM_DEFLATE_LANES", "1")
+    monkeypatch.setenv("HBAM_SHUFFLE_MEMBER_BYTES", "2048")
+    out = str(tmp_path / "lanes.bam")
+    before = _counters()
+    faults.arm("flate.deflate.tierdown:members=1,n=1")
+    try:
+        multihost.sort_bam_multihost(
+            [src], out, ctx=ctx, split_size=1 << 16, level=1
+        )
+    finally:
+        faults.disarm()
+    after = _counters()
+    # The device seam engaged and exactly one member was forced down.
+    assert _delta(before, after, "device_stream.deflates") > 0
+    assert (
+        _delta(before, after, "faults.fired.flate.deflate.tierdown") == 1
+    )
+    d1 = native.decompress_all(open(out, "rb").read())
+    d2 = native.decompress_all(open(oracle, "rb").read())
+    assert np.array_equal(d1, d2), "tier-down member broke byte identity"
+
+
+def test_member_corruption_strict_raises_salvage_quarantines(
+    bam_small, oracle_small, tmp_path
+):
+    """The ``mh.corrupt`` drill: a member corrupted in flight fails a
+    strict sort loudly; under ``errors="salvage"`` exactly that member
+    is quarantined (``salvage.*`` counters) and every surviving record
+    is byte-exact and in oracle order."""
+    from hadoop_bam_tpu import faults
+    from hadoop_bam_tpu.parallel import multihost
+    from hadoop_bam_tpu.spec.bgzf import BgzfError
+
+    ctx = multihost.initialize()
+    faults.arm("mh.corrupt:members=0,n=1")
+    try:
+        with pytest.raises(BgzfError):
+            multihost.sort_bam_multihost(
+                [bam_small], str(tmp_path / "strict.bam"), ctx=ctx,
+                split_size=1 << 17, level=1,
+            )
+    finally:
+        faults.disarm()
+    out_s = str(tmp_path / "salvage.bam")
+    before = _counters()
+    faults.arm("mh.corrupt:members=0,n=1")
+    try:
+        multihost.sort_bam_multihost(
+            [bam_small], out_s, ctx=ctx, split_size=1 << 17, level=1,
+            errors="salvage",
+        )
+    finally:
+        faults.disarm()
+    after = _counters()
+    assert _delta(before, after, "salvage.members_quarantined") == 1
+    dropped = _delta(before, after, "salvage.records_dropped")
+    assert dropped > 0
+    # Survivors exact: same header, and the salvage records are a
+    # subsequence of the oracle's with exactly `dropped` missing.
+    hdr_o, recs_o = _records_of(oracle_small)
+    hdr_s, recs_s = _records_of(out_s)
+    assert hdr_s == hdr_o
+    assert len(recs_s) == len(recs_o) - dropped
+    it = iter(recs_o)
+    assert all(r in it for r in recs_s), "survivors not oracle-ordered"
+
+
+# ---------------------------------------------------------------------------
+# The 2-process spawned drill: FS + HTTP planes, compressed vs raw.
+# ---------------------------------------------------------------------------
+
+_DRILL_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; outdir = sys.argv[5]; trace_dir = sys.argv[6]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu.conf import Configuration, SHUFFLE_COMPRESS
+from hadoop_bam_tpu.parallel import multihost
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+kw = dict(ctx=ctx, split_size=1 << 16, level=1)
+n1 = multihost.sort_bam_multihost(
+    [src], os.path.join(outdir, "c_fs.bam"), byte_plane="fs", **kw)
+n2 = multihost.sort_bam_multihost(
+    [src], os.path.join(outdir, "c_http.bam"), byte_plane="http",
+    mesh_trace=True, mesh_trace_dir=trace_dir, **kw)
+raw_conf = Configuration({{SHUFFLE_COMPRESS: "false"}})
+n3 = multihost.sort_bam_multihost(
+    [src], os.path.join(outdir, "r_http.bam"), byte_plane="http",
+    conf=raw_conf, mesh_trace=True,
+    mesh_trace_dir=trace_dir + "-raw", **kw)
+print(f"MH_SHUF_OK pid={{pid}} n={{n1}},{{n2}},{{n3}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_compressed_vs_raw_planes(
+    bam_small, oracle_small, tmp_path, mesh_report_mod
+):
+    """The acceptance drill: 2 real processes sort the same corpus over
+    the compressed FS plane, the compressed HTTP plane and the raw HTTP
+    plane — all byte-identical to the single-process oracle, the
+    compressed wire matrix balanced with per-edge ratio > 1, and fewer
+    cross-host wire bytes than the raw plane shipped."""
+    src = bam_small
+    outdir = str(tmp_path)
+    trace_dir = str(tmp_path / "mesh-trace")
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    env.pop("HBAM_SHUFFLE_COMPRESS", None)
+    worker = _DRILL_WORKER.format(repo=str(REPO))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             src, outdir, trace_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"MH_SHUF_OK pid={pid} n=8000,8000,8000" in o, o[-2000:]
+
+    from hadoop_bam_tpu import native
+
+    ref = native.decompress_all(open(oracle_small, "rb").read())
+    for name in ("c_fs.bam", "c_http.bam", "r_http.bam"):
+        got = native.decompress_all(
+            open(os.path.join(outdir, name), "rb").read()
+        )
+        assert np.array_equal(got, ref), f"{name} differs from oracle"
+
+    rep = mesh_report_mod.mesh_report(trace_dir)
+    rep_raw = mesh_report_mod.mesh_report(trace_dir + "-raw")
+    mx, mx_raw = rep["matrix"], rep_raw["matrix"]
+    assert mx["balanced"], mx["mismatches"]
+    assert mx_raw["balanced"], mx_raw["mismatches"]
+    assert mx["records"] == mx_raw["records"] == 8_000
+    # The wire domain shrank; the raw twins agree across planes.
+    assert mx["shuffle_ratio"] > 3.0
+    assert mx["edges_ratio_below_1"] == []
+    assert mx["shuffle_raw_bytes"] == mx_raw["shuffle_bytes"]
+    assert (
+        0
+        < mx["shuffle_bytes_cross_host"]
+        < mx_raw["shuffle_bytes_cross_host"]
+    )
+    assert (
+        mx["shuffle_bytes_per_record"]
+        < mx_raw["shuffle_bytes_per_record"] / 3
+    )
+    cm = rep["cluster_manifest"]
+    assert cm and not cm["degraded"] and cm["edges_balanced"]
+    assert cm["shuffle_ratio"] == pytest.approx(
+        mx["shuffle_ratio"], rel=1e-3
+    )
+    assert all(h["shuffle_compressed"] for h in cm["hosts"])
+    raw_cm = rep_raw["cluster_manifest"]
+    assert raw_cm["shuffle_ratio"] == pytest.approx(1.0)

@@ -113,21 +113,25 @@ def test_1m_presorted(ds_1m):
     np.testing.assert_array_equal(skeys, keys)
 
 
-def test_1m_duplicate_heavy_overflow_then_full_capacity(mesh):
+def test_1m_duplicate_heavy_overflow_then_capacity_retry(mesh):
     """Pathological tie mass: 4 distinct keys over 1M rows.  Ties route to
     one device per key (correctness requires it), so the default 1.6x
-    headroom MUST overflow — detected, not dropped — and the full-capacity
-    retry (the sort_bam fallback, pipeline.py) must then succeed."""
+    headroom MUST overflow — detected, not dropped — and the automatic
+    doubled-capacity retry (PR 15: counted as
+    ``mh.shuffle.capacity_retry``, one extra round-trip instead of a
+    failed cluster sort) must then succeed with a stable result."""
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
     rows = -(-_M // 8)
     rng = np.random.default_rng(13)
     keys = (
         rng.integers(0, 4, _M, dtype=np.int64) << 32
     ) | 0x1234  # 4 distinct values
     ds = DistributedSort(mesh, rows_per_device=rows)
-    with pytest.raises(RuntimeError, match="capacity exceeded"):
-        ds.sort_global(keys)
-    ds_full = DistributedSort(mesh, rows_per_device=rows, capacity_per_pair=rows)
-    skeys, perm, ovf = ds_full.sort_global(keys)
+    before = METRICS.report()["counters"].get("mh.shuffle.capacity_retry", 0)
+    skeys, perm, ovf = ds.sort_global(keys)
+    after = METRICS.report()["counters"].get("mh.shuffle.capacity_retry", 0)
+    assert after - before == 1, "default headroom should overflow once"
     assert ovf == 0
     np.testing.assert_array_equal(skeys, np.sort(keys))
     # Stability: equal keys come out in input order.

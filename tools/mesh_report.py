@@ -257,11 +257,16 @@ def straggler_table(events: List[dict]) -> Optional[dict]:
 def byte_matrix(manifests: List[dict]) -> Optional[dict]:
     """N×N sent/recv matrices with the per-edge balance assert.
 
-    ``sent[s][q]`` is host *s*'s sender-side measurement of the bytes it
-    shipped to *q* (the diagonal is the host's own share — a local move);
-    ``recv[q][s]`` is *q*'s independent receiver-side measurement of the
-    same edge.  Any disagreement is lost or duplicated shuffle data and
-    lands in ``mismatches``."""
+    ``sent[s][q]`` is host *s*'s sender-side measurement of the WIRE
+    bytes it shipped to *q* (compressed bytes on the compressed plane;
+    the diagonal is the host's own share — a local move); ``recv[q][s]``
+    is *q*'s independent receiver-side measurement of the same edge.
+    Any disagreement is lost or duplicated shuffle data and lands in
+    ``mismatches``.  ``sent_raw`` is the pre-compression twin: per-edge
+    ``ratio[s][q] = raw/wire`` makes the compression a first-class
+    measurement, and an edge whose ratio dropped below 1.0 (compression
+    *grew* the wire bytes — the store-mode fallback should have fired)
+    is flagged in ``edges_ratio_below_1``."""
     if not manifests:
         return None
     n = max(
@@ -271,8 +276,11 @@ def byte_matrix(manifests: List[dict]) -> Optional[dict]:
     by_host = {int(h.get("host", 0)): h for h in manifests}
     sent = [[0] * n for _ in range(n)]
     recv = [[0] * n for _ in range(n)]
+    sent_raw = [[0] * n for _ in range(n)]
+    ratio = [[None] * n for _ in range(n)]
     keys_sent = [[0] * n for _ in range(n)]
     mismatches: List[dict] = []
+    low_ratio: List[dict] = []
     for s in range(n):
         hs = by_host.get(s, {})
         for q in range(n):
@@ -283,6 +291,9 @@ def byte_matrix(manifests: List[dict]) -> Optional[dict]:
             recv[q][s] = int(
                 (hq.get("shuffle_recv_bytes") or {}).get(str(s), 0)
             )
+            sent_raw[s][q] = int(
+                (hs.get("shuffle_sent_raw_bytes") or {}).get(str(q), 0)
+            )
             keys_sent[s][q] = int(
                 (hs.get("keys_sent_bytes") or {}).get(str(q), 0)
             )
@@ -291,25 +302,41 @@ def byte_matrix(manifests: List[dict]) -> Optional[dict]:
                     {"edge": f"{s}->{q}", "sent": sent[s][q],
                      "recv": recv[q][s]}
                 )
+            if sent[s][q] > 0 and sent_raw[s][q] > 0:
+                r = round(sent_raw[s][q] / sent[s][q], 4)
+                ratio[s][q] = r
+                if r < 1.0:
+                    low_ratio.append({"edge": f"{s}->{q}", "ratio": r})
     records = sum(int(h.get("records_local", 0)) for h in manifests)
     out_counts = [
         c for h in manifests for c in (h.get("records_out") or [])
     ]
     mean = (sum(out_counts) / len(out_counts)) if out_counts else 0.0
     total = sum(sum(row) for row in sent)
+    total_raw = sum(sum(row) for row in sent_raw)
     off_diag = total - sum(sent[i][i] for i in range(n))
     return {
         "num_hosts": n,
         "sent": sent,
         "recv": recv,
+        "sent_raw": sent_raw,
+        "ratio": ratio,
         "keys_sent": keys_sent,
         "balanced": not mismatches,
         "mismatches": mismatches,
+        "edges_ratio_below_1": low_ratio,
         "shuffle_bytes": total,
+        "shuffle_raw_bytes": total_raw,
+        "shuffle_ratio": round(total_raw / total, 4)
+        if total and total_raw
+        else None,
         "shuffle_bytes_cross_host": off_diag,
         "records": records,
         "shuffle_bytes_per_record": round(total / records, 3)
         if records
+        else 0.0,
+        "shuffle_raw_bytes_per_record": round(total_raw / records, 3)
+        if records and total_raw
         else 0.0,
         "skew_ratio": round(max(out_counts) / mean, 4)
         if mean > 0
@@ -341,15 +368,30 @@ def mesh_report(trace_dir: str) -> dict:
     return rep
 
 
-def _fmt_matrix(rows: List[List[int]], label: str) -> List[str]:
+def _fmt_matrix(
+    rows: List[List[int]],
+    label: str,
+    raw_rows: Optional[List[List[int]]] = None,
+) -> List[str]:
+    """Render an N×N byte matrix; with ``raw_rows`` given, append a
+    per-source-host compression-ratio column (row raw bytes / row wire
+    bytes)."""
     n = len(rows)
     head = f"{label:<10}" + "".join(f"{'->' + str(q):>14}" for q in range(n))
+    if raw_rows is not None:
+        head += f"{'ratio':>10}"
     lines = [head]
     for s in range(n):
-        lines.append(
-            f"{'host ' + str(s):<10}"
-            + "".join(f"{rows[s][q]:>14,}" for q in range(n))
+        line = f"{'host ' + str(s):<10}" + "".join(
+            f"{rows[s][q]:>14,}" for q in range(n)
         )
+        if raw_rows is not None:
+            wire = sum(rows[s])
+            raw = sum(raw_rows[s])
+            line += (
+                f"{(raw / wire):>9.2f}x" if wire and raw else f"{'-':>10}"
+            )
+        lines.append(line)
     return lines
 
 
@@ -405,7 +447,13 @@ def format_report(rep: dict) -> str:
     mx = rep.get("matrix")
     if mx:
         lines.append("")
-        lines.extend(_fmt_matrix(mx["sent"], "sent B"))
+        has_ratio = mx.get("shuffle_ratio") is not None
+        lines.extend(
+            _fmt_matrix(
+                mx["sent"], "wire B",
+                mx["sent_raw"] if has_ratio else None,
+            )
+        )
         verdict = (
             "balanced (sent==recv per edge)"
             if mx["balanced"]
@@ -413,12 +461,26 @@ def format_report(rep: dict) -> str:
         )
         lines.append(f"shuffle byte matrix: {verdict}")
         lines.append(
-            f"shuffle bytes: {mx['shuffle_bytes']:,} total "
+            f"shuffle bytes: {mx['shuffle_bytes']:,} on the wire "
             f"({mx['shuffle_bytes_cross_host']:,} cross-host), "
             f"{mx['shuffle_bytes_per_record']} B/record over "
             f"{mx['records']:,} records; partition skew "
             f"{mx['skew_ratio']}x (max/mean records per shard)"
         )
+        if has_ratio:
+            lines.append(
+                f"compression: {mx['shuffle_ratio']}x "
+                f"({mx['shuffle_raw_bytes']:,} raw B → "
+                f"{mx['shuffle_bytes']:,} wire B; "
+                f"{mx['shuffle_raw_bytes_per_record']} → "
+                f"{mx['shuffle_bytes_per_record']} B/record)"
+            )
+        for bad in mx.get("edges_ratio_below_1", []):
+            lines.append(
+                f"warning: edge {bad['edge']} ratio {bad['ratio']}x < 1.0 "
+                "— compression grew the wire bytes; the store-mode "
+                "fallback should have fired"
+            )
     cm = rep.get("cluster_manifest")
     if cm is not None:
         lines.append("")
