@@ -709,13 +709,14 @@ def _overload_bench(tmp: str) -> dict:
 
 
 _MULTICHIP_WORKER = r"""
-import os, sys
+import os, sys, time
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 src = sys.argv[4]; out = sys.argv[5]; trace_dir = sys.argv[6]
 out_raw = sys.argv[7]; trace_dir_raw = sys.argv[8]
+out_qn = sys.argv[9]; trace_dir_qn = sys.argv[10]
 sys.path.insert(0, {repo!r})
 from hadoop_bam_tpu.conf import Configuration, SHUFFLE_COMPRESS
 from hadoop_bam_tpu.parallel import multihost
@@ -723,16 +724,28 @@ ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
                            process_id=pid)
 # Compressed plane (the default) then the raw plane, back to back on the
 # same mesh: the ratio headline and its must-not-regress raw baseline
-# come from one round.
+# come from one round.  A third leg queryname-sorts the same corpus —
+# the distributed rank pass and the skew-healing rescue loop ride the
+# identical mesh, so both orderings report records/s from one round.
+t0 = time.perf_counter()
 n = multihost.sort_bam_multihost([src], out, ctx=ctx, split_size=1 << 19,
                                  level=1, mesh_trace=True,
                                  mesh_trace_dir=trace_dir)
+t_coord = time.perf_counter() - t0
 conf_raw = Configuration({{SHUFFLE_COMPRESS: "false"}})
 n2 = multihost.sort_bam_multihost([src], out_raw, ctx=ctx, conf=conf_raw,
                                   split_size=1 << 19, level=1,
                                   mesh_trace=True,
                                   mesh_trace_dir=trace_dir_raw)
-print(f"MH_BENCH_OK pid={{pid}} n={{n}} n2={{n2}}", flush=True)
+t0 = time.perf_counter()
+n3 = multihost.sort_bam_multihost([src], out_qn, ctx=ctx,
+                                  split_size=1 << 19, level=1,
+                                  mesh_trace=True,
+                                  mesh_trace_dir=trace_dir_qn,
+                                  sort_order="queryname")
+t_qn = time.perf_counter() - t0
+print(f"MH_BENCH_OK pid={{pid}} n={{n}} n2={{n2}} n3={{n3}} "
+      f"t_coord={{t_coord:.3f}} t_qn={{t_qn:.3f}}", flush=True)
 """
 
 
@@ -754,7 +767,14 @@ def _multichip_bench(tmp: str) -> dict:
     two outputs must be byte-identical (``mh_planes_identical``); the
     compressed run's folded ClusterManifest rides the round verbatim so
     finalize_round can degrade the round when any host degraded or the
-    byte matrix failed to balance."""
+    byte matrix failed to balance.
+
+    A third leg queryname-sorts the same corpus on the same mesh (the
+    distributed rank pass) and reports ``mh_qn_records_per_sec`` beside
+    ``mh_sort_records_per_sec``; if its rescue loop repartitioned, the
+    round carries ``mh_repartition_ratio_before``/``_after`` (both, per
+    the BENCH_NOTES rule), and any speculation ships its
+    ``wasted_bytes`` beside the win."""
     import socket
     import subprocess
 
@@ -763,8 +783,10 @@ def _multichip_bench(tmp: str) -> dict:
     synth_bam(src, n)
     out = os.path.join(tmp, "multichip_sorted.bam")
     out_raw = os.path.join(tmp, "multichip_sorted_raw.bam")
+    out_qn = os.path.join(tmp, "multichip_sorted_qn.bam")
     trace_dir = os.path.join(tmp, "multichip_trace")
     trace_dir_raw = os.path.join(tmp, "multichip_trace_raw")
+    trace_dir_qn = os.path.join(tmp, "multichip_trace_qn")
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -776,7 +798,8 @@ def _multichip_bench(tmp: str) -> dict:
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", worker, str(pid), "2", str(port),
-             src, out, trace_dir, out_raw, trace_dir_raw],
+             src, out, trace_dir, out_raw, trace_dir_raw,
+             out_qn, trace_dir_qn],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=repo,
         )
@@ -806,11 +829,50 @@ def _multichip_bench(tmp: str) -> dict:
     spec.loader.exec_module(mr)
     rep = mr.mesh_report(trace_dir)
     rep_raw = mr.mesh_report(trace_dir_raw)
+    rep_qn = mr.mesh_report(trace_dir_qn)
     mx = rep["matrix"]
     mx_raw = rep_raw["matrix"]
     st = rep["straggler_table"]
     with open(out, "rb") as f1, open(out_raw, "rb") as f2:
         identical = f1.read() == f2.read()
+    # Wall-clock per ordering from the slowest worker (the mesh round
+    # finishes when its last host does); the queryname leg's folded
+    # manifest carries any repartition the rescue loop performed — a
+    # repartitioned round must report BOTH ratios (BENCH_NOTES rule).
+    import re as _re
+
+    t_coord = max(
+        float(m.group(1))
+        for m in (_re.search(r"t_coord=([0-9.]+)", o) for o in outs)
+        if m
+    )
+    t_qn = max(
+        float(m.group(1))
+        for m in (_re.search(r"t_qn=([0-9.]+)", o) for o in outs)
+        if m
+    )
+    qn_extra = {}
+    repart = (rep_qn.get("cluster_manifest") or {}).get("repartition") or {}
+    if repart.get("triggered"):
+        qn_extra["mh_repartition_triggered"] = int(repart["triggered"])
+        qn_extra["mh_repartition_sample_keys"] = int(
+            repart.get("sample_keys", 0)
+        )
+        if "ratio_before" in repart:
+            qn_extra["mh_repartition_ratio_before"] = round(
+                float(repart["ratio_before"]), 3
+            )
+        if "ratio_after" in repart:
+            qn_extra["mh_repartition_ratio_after"] = round(
+                float(repart["ratio_after"]), 3
+            )
+    spec = (rep_qn.get("cluster_manifest") or {}).get("speculation") or {}
+    if spec.get("launched"):
+        qn_extra["mh_speculate_launched"] = int(spec["launched"])
+        qn_extra["mh_speculate_won_parts"] = int(spec.get("won_parts", 0))
+        qn_extra["mh_speculate_wasted_bytes"] = int(
+            spec.get("wasted_bytes", 0)
+        )
     return {
         "mh_hosts": rep["num_hosts"],
         "mh_records": mx["records"],
@@ -825,7 +887,11 @@ def _multichip_bench(tmp: str) -> dict:
         "mh_skew_ratio": mx["skew_ratio"],
         "mh_straggler_overhead_pct": st["straggler_overhead_pct"],
         "mh_critical_path_host": st["critical_path_host"],
+        "mh_sort_records_per_sec": round(mx["records"] / t_coord, 1),
+        "mh_qn_records_per_sec": round(mx["records"] / t_qn, 1),
+        "mh_qn_matrix_balanced": rep_qn["matrix"]["balanced"],
         "mh_cluster_manifest": rep["cluster_manifest"],
+        **qn_extra,
     }
 
 

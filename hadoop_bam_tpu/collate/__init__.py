@@ -39,6 +39,8 @@ from .fixmate import (
 from .host import (
     QuerynameStats,
     collation_counts,
+    global_name_ranks,
+    group_representatives,
     natural_compare,
     natural_sort_key,
     queryname_perm,
@@ -74,6 +76,8 @@ __all__ = [
     "compute_fixmate_edits",
     "concat_collation",
     "fixmate_oracle",
+    "global_name_ranks",
+    "group_representatives",
     "mc_tag_of",
     "name_hash_pair",
     "natural_compare",

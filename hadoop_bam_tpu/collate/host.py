@@ -240,6 +240,36 @@ def queryname_perm(
     return perm, QuerynameStats(n, col.n_groups, n_coll)
 
 
+def group_representatives(
+    cols: Dict[str, np.ndarray], col: Collation
+) -> list:
+    """One representative name (bytes) per verified bucket, indexed by
+    group id.  After :func:`verify_and_repair` every bucket is
+    name-homogeneous, so the first collated row speaks for the group —
+    this is the per-host half of the distributed rank pass: hosts
+    allgather only these representatives (one short name per *group*,
+    not per record) and rank the union with the natural comparator."""
+    bounds = col.bucket_bounds()
+    return [
+        _name_bytes(cols, int(col.order[int(bounds[g])]))
+        for g in range(col.n_groups)
+    ]
+
+
+def global_name_ranks(rep_lists) -> Dict[bytes, int]:
+    """Fold per-host representative lists into one dense global rank
+    table: the union of distinct names in samtools natural order.  Every
+    host computes this over the same allgathered lists, so ranks agree
+    mesh-wide without a coordinator.  Cross-host hash collisions cost
+    nothing here — ranking keys on actual name bytes, two hosts whose
+    *different* names share a 64-bit hash simply get two ranks."""
+    union = set()
+    for reps in rep_lists:
+        union.update(reps)
+    ordered = sorted(union, key=natural_sort_key)
+    return {name: r for r, name in enumerate(ordered)}
+
+
 def collation_counts(
     cols: Dict[str, np.ndarray], col: Collation
 ) -> Dict[str, int]:

@@ -222,6 +222,21 @@ SHUFFLE_FETCH_THREADS = "hadoopbam.shuffle.fetch-threads"
 # MESH_TRACE_DIR defaults to "<out_path>.mesh-trace".
 MESH_TRACE = "hadoopbam.mesh.trace"
 MESH_TRACE_DIR = "hadoopbam.mesh.trace-dir"
+# Skew healing (parallel/multihost.py).  SKEW_BOUND: when the post-route
+# output-row ratio max/mean exceeds this, the round refreshes its range
+# partitioner from a per-host key reservoir (REPARTITION_SAMPLES keys
+# per host, allgathered, re-cut at balanced quantiles) and re-routes —
+# at most one refresh per round, counted as mh.repartition.*.  0
+# disables the refresh.  SPECULATE_FACTOR: a host whose parts stage
+# exceeds this multiple of the median peer duration at the
+# parts-written barrier gets its stage re-executed by the fastest
+# finished peer from the byte-plane locator; first finisher wins, the
+# loser's parts are discarded by generation tag (mh.speculate.*).
+# 0/unset disables speculation (the default — it trades redundant work
+# for tail latency, Hadoop's mapreduce.map.speculative stance).
+MESH_SKEW_BOUND = "hadoopbam.mesh.skew-bound"
+MESH_SPECULATE_FACTOR = "hadoopbam.mesh.speculate-factor"
+MESH_REPARTITION_SAMPLES = "hadoopbam.mesh.repartition-samples"
 # Timeline tracer ring capacity (events) for ``--trace`` runs
 # (utils/tracing.Tracer): the per-event buffer is bounded — on overflow
 # the OLDEST events drop (counted in the export's ``dropped_events``)

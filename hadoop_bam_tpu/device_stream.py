@@ -380,6 +380,10 @@ class DeviceStream:
         the double-buffer ordering drills pin this)."""
         d = max(1, int(depth)) if depth is not None else self.depth
         METRICS.set_gauge("pipeline.read_depth", d)
+        METRICS.set_gauge("pipeline.auto_rtt_ms", self.policy.auto_rtt_ms)
+        METRICS.set_gauge(
+            "pipeline.effective_rtt_ms", self.policy.effective_rtt_ms
+        )
         if self.armed:
             self._count("splits", len(splits))
 

@@ -64,6 +64,12 @@ Directive reference:
 ``exec.die``         ``os._exit(137)`` — SIGKILL's exit, mid-attempt (the
                      deterministic ``kill -9``); ``items``, ``attempts``,
                      ``n``.
+``mh.speculate.lose``  delay the speculative re-execution of a straggling
+                     host's parts stage just before its first-wins
+                     promotion, forcing the speculative copy to lose the
+                     race and be discarded cleanly (counted
+                     ``mh.speculate.wasted_bytes``); ``ms`` (default
+                     500), ``n``.
 ``serve.drop``       close the connection without replying; ``op``
                      (request-op filter), ``n``.
 ``serve.stall``      sleep ``ms`` before replying; ``op``, ``n``.
@@ -103,6 +109,7 @@ _SITES = frozenset(
         "flate.deflate.tierdown",
         "flate.corrupt",
         "mh.corrupt",
+        "mh.speculate.lose",
         "exec.crash",
         "exec.torn",
         "exec.delay",
@@ -311,6 +318,16 @@ class FaultPlan:
         — not luck — catches it at inflate time (strict raises; salvage
         quarantines exactly that member)."""
         return self._fire("mh.corrupt", member=member) is not None
+
+    def mh_speculate_lose(self) -> None:
+        """The speculation-race seam: stall the speculative copy of a
+        straggler's parts stage just before its first-wins promotion so
+        the original wins the ``os.link`` race and the speculative
+        output is discarded — the loser path exercised deterministically
+        instead of by timing luck."""
+        d = self._fire("mh.speculate.lose")
+        if d is not None:
+            time.sleep(d.int_param("ms", 500) / 1e3)
 
     def exec_attempt(self, item: int, attempt: int, tmp_path: str) -> None:
         """The executor seam: latency, torn tmp files, crashes, or hard

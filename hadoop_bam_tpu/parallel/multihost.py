@@ -247,6 +247,66 @@ def _resolve_fetch_threads(conf) -> int:
     return v if v > 0 else 8
 
 
+def _resolve_skew_bound(conf) -> float:
+    """Adaptive-repartition trigger: ``hadoopbam.mesh.skew-bound`` →
+    HBAM_MESH_SKEW_BOUND → 1.5.  A routed round whose per-device
+    record-count max/mean exceeds this refreshes the range partitioner
+    once from a key reservoir; ``<= 0`` disables the refresh."""
+    if conf is not None:
+        from ..conf import MESH_SKEW_BOUND
+
+        got = conf.get(MESH_SKEW_BOUND)
+        if got is not None:
+            try:
+                return float(got)
+            except ValueError:
+                pass
+    env = os.environ.get("HBAM_MESH_SKEW_BOUND", "")
+    try:
+        return float(env) if env else 1.5
+    except ValueError:
+        return 1.5
+
+
+def _resolve_speculate_factor(conf) -> float:
+    """Speculative re-execution trigger: ``hadoopbam.mesh.speculate-factor``
+    → HBAM_MESH_SPECULATE_FACTOR → 0 (disabled).  A straggling host's
+    parts stage is re-executed by a finished peer once the stage has run
+    longer than factor × the median finished-peer duration."""
+    if conf is not None:
+        from ..conf import MESH_SPECULATE_FACTOR
+
+        got = conf.get(MESH_SPECULATE_FACTOR)
+        if got is not None:
+            try:
+                return float(got)
+            except ValueError:
+                pass
+    env = os.environ.get("HBAM_MESH_SPECULATE_FACTOR", "")
+    try:
+        return float(env) if env else 0.0
+    except ValueError:
+        return 0.0
+
+
+def _resolve_repartition_samples(conf) -> int:
+    """Per-host key reservoir size for the repartition refresh:
+    ``hadoopbam.mesh.repartition-samples`` →
+    HBAM_MESH_REPARTITION_SAMPLES → 4096."""
+    v = 0
+    if conf is not None:
+        from ..conf import MESH_REPARTITION_SAMPLES
+
+        v = conf.get_int(MESH_REPARTITION_SAMPLES, 0)
+    if v <= 0:
+        env = os.environ.get("HBAM_MESH_REPARTITION_SAMPLES", "")
+        try:
+            v = int(env) if env else 0
+        except ValueError:
+            v = 0
+    return v if v > 0 else 4096
+
+
 def _deflate_member_stream(
     raw, dstream, level: int, member_bytes: int
 ) -> Tuple[bytes, np.ndarray]:
@@ -695,7 +755,8 @@ class _ByteFetcher:
     def __init__(self, sources: List, ctx: MultihostContext,
                  rows_per_device: int, compress: bool = False,
                  dstream=None, fetch_threads: int = 8,
-                 errors: Optional[str] = None):
+                 errors: Optional[str] = None,
+                 dest_pid: Optional[int] = None):
         import io as _io
         from concurrent.futures import ThreadPoolExecutor
 
@@ -704,11 +765,17 @@ class _ByteFetcher:
         self.rows = rows_per_device
         self.ctx = ctx
         P_ = ctx.num_processes
+        # Speculative re-execution fetches ANOTHER host's share
+        # (``dest_pid``): those bytes are redundant copies, accounted
+        # under ``mh.speculate.fetch_bytes`` — never the recv matrix,
+        # which must keep balancing against what senders measured.
+        dest = ctx.process_id if dest_pid is None else dest_pid
+        speculative = dest != ctx.process_id
         #: Per source: quarantined raw intervals (salvage mode only).
         self.bad: List[List[Tuple[int, int]]] = [[] for _ in range(P_)]
 
         def fetch_one(s: int):
-            name = _bytes_name(s, ctx.process_id)
+            name = _bytes_name(s, dest)
             ext = ".bgzf" if compress else ".bin"
             if isinstance(sources[s], tuple):
                 url, token = sources[s]
@@ -738,10 +805,15 @@ class _ByteFetcher:
                 mtab = np.load(p + _MTAB_SUFFIX) if compress else None
             # Receiver side of the shuffle byte matrix, measured from the
             # bytes that actually arrived (not inferred from the sender).
-            METRICS.count(f"mh.shuffle.recv.{s}", int(len(wire_buf)))
-            TRACER.counter(
-                "mh.shuffle.recv", {str(s): float(len(wire_buf))}
-            )
+            if speculative:
+                METRICS.count(
+                    "mh.speculate.fetch_bytes", int(len(wire_buf))
+                )
+            else:
+                METRICS.count(f"mh.shuffle.recv.{s}", int(len(wire_buf)))
+                TRACER.counter(
+                    "mh.shuffle.recv", {str(s): float(len(wire_buf))}
+                )
             if compress:
                 with span("mh.byte_shuffle.inflate", category="stage"):
                     raw, bad = _inflate_member_stream(
@@ -750,7 +822,8 @@ class _ByteFetcher:
                 self.bad[s] = bad
             else:
                 raw = wire_buf
-            METRICS.count(f"mh.shuffle.recv_raw.{s}", int(len(raw)))
+            if not speculative:
+                METRICS.count(f"mh.shuffle.recv_raw.{s}", int(len(raw)))
             if len(offs) and int(offs[-1]) != len(raw):
                 raise RuntimeError(
                     f"byte shuffle sidecar desync from process {s}: "
@@ -1212,6 +1285,320 @@ def _budget_byte_plane(
 # ---------------------------------------------------------------------------
 
 
+def _distributed_name_ranks(
+    ctx: MultihostContext, parts: List[dict]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The distributed half of the collation engine's rank pass.
+
+    Each host collates its own splits by name hash, verifies every
+    bucket against actual name bytes (:func:`collate.verify_and_repair`
+    — no decision rests on hash equality), then allgathers only the
+    per-group *representative names* — one short name per group, never
+    per record.  Every host ranks the union with the samtools natural
+    comparator over the same allgathered lists, so the dense global rank
+    table agrees mesh-wide without a coordinator, and cross-host hash
+    collisions cost nothing: two hosts whose different names share a
+    64-bit hash simply contribute two distinct names to the union.
+
+    Returns per-local-record (read order) ``(grank, tiebreak)``:
+    ``grank`` the record name's global natural-order rank (the shuffle's
+    primary word — routing on it colocates whole name groups) and
+    ``tiebreak`` the engine's content tie-break word
+    ``(flag << 32) | (pos + 1)`` (the secondary word; global read
+    ordinal breaks remaining ties, matching the single-host lexsort).
+    """
+    from ..collate import (
+        collate_by_name, concat_collation, verify_and_repair,
+    )
+
+    cols = concat_collation(parts)
+    n = len(cols["qh1"])
+    col = collate_by_name(cols, candidates=np.zeros(n, np.int32))
+    col, _ = verify_and_repair(col, cols)
+    grank, _n_names = _global_name_rank_pass(ctx, cols, col)
+    tiebreak = (
+        (cols["flag"].astype(np.int64) << 32)
+        | (cols["pos"].astype(np.int64) + 1)
+    )
+    return grank, tiebreak
+
+
+def _global_name_rank_pass(
+    ctx: MultihostContext, cols: dict, col
+) -> Tuple[np.ndarray, int]:
+    """Allgather per-group representative names, rank the union, and
+    return (per-record global rank in read order, global distinct-name
+    count).  Collective: every host must call it, including hosts with
+    zero local records."""
+    from ..collate import global_name_ranks, group_representatives
+
+    n = len(cols["qh1"])
+    reps = group_representatives(cols, col) if n else []
+    blob = (
+        np.frombuffer(b"".join(reps), np.uint8)
+        if reps else np.empty(0, np.uint8)
+    )
+    lens = np.array([len(r) for r in reps], np.int64)
+    # Two allgathers of padded buffers (sizes first so every host pads
+    # to the same global maximum — allgather shapes must agree).
+    sizes = ctx.allgather_array(
+        np.array([len(reps), len(blob)], np.int64)
+    )
+    max_g = int(sizes[:, 0].max())
+    max_b = int(sizes[:, 1].max())
+    lens_pad = np.zeros(max(1, max_g), np.int64)
+    lens_pad[: len(lens)] = lens
+    blob_pad = np.zeros(max(1, max_b), np.uint8)
+    blob_pad[: len(blob)] = blob
+    all_lens = ctx.allgather_array(lens_pad)
+    all_blobs = ctx.allgather_array(blob_pad)
+    rep_lists = []
+    for p in range(ctx.num_processes):
+        g = int(sizes[p, 0])
+        offs = np.concatenate(
+            [[0], np.cumsum(all_lens[p][:g])]
+        ).astype(np.int64)
+        buf = all_blobs[p].tobytes()
+        rep_lists.append(
+            [buf[int(offs[i]) : int(offs[i + 1])] for i in range(g)]
+        )
+    rank = global_name_ranks(rep_lists)
+    METRICS.count("mh.rank.names", len(rank))
+    grank = np.zeros(n, np.int64)
+    if n:
+        rank_of_group = np.array([rank[r] for r in reps], np.int64)
+        grank[col.order] = rank_of_group[col.group]
+    return grank, len(rank)
+
+
+def _reservoir_splitters(
+    ctx: MultihostContext,
+    keys: np.ndarray,
+    n_reservoir: int,
+    n_devices: int,
+    rng: np.random.Generator,
+) -> Tuple[Optional[Tuple[np.ndarray, np.ndarray]], int]:
+    """Re-elect range splitters from a per-host key reservoir.
+
+    The in-shuffle election samples a handful of keys per device; a
+    pathological (zipfian, clustered) key distribution can make those
+    cuts land badly.  This is the rescue path: every host contributes up
+    to ``n_reservoir`` uniformly-sampled keys, the allgathered pool is
+    sorted, and new splitters are cut at the balanced quantiles — the
+    best cut any sample of this size supports.  Returns the splitters as
+    ``(hi, lo)`` int32/uint32 word arrays (the form
+    :class:`~.shuffle.DistributedSort` pins as jit constants) plus the
+    pool size, or ``(None, 0)`` for an empty mesh."""
+    from ..ops.keys import split_keys_np
+
+    n = len(keys)
+    take = int(min(n, n_reservoir))
+    samp = (
+        rng.choice(keys, size=take, replace=False)
+        if 0 < take < n else keys[:take].copy()
+    )
+    buf = np.full(max(1, n_reservoir), np.iinfo(np.int64).max, np.int64)
+    buf[:take] = samp
+    counts = ctx.allgather_counts(take)
+    allb = ctx.allgather_array(buf)
+    pool = np.concatenate(
+        [allb[p, : int(counts[p])] for p in range(len(counts))]
+    )
+    if pool.size == 0:
+        return None, 0
+    pool.sort()
+    cut = np.clip(
+        np.arange(1, n_devices, dtype=np.int64) * len(pool) // n_devices,
+        0, len(pool) - 1,
+    )
+    sp_hi, sp_lo = split_keys_np(pool[cut])
+    return (sp_hi, sp_lo), int(pool.size)
+
+
+# --- Speculative stage re-execution: the shared-directory control plane.
+# Route sidecars publish each owned part's post-route locator (which
+# (src_dev, src_row) feed it); done markers publish per-host stage
+# durations.  Both live in the parts directory — already the one
+# directory every host and the merge can reach.
+
+
+def _route_sidecar(td: str, g_dev: int) -> str:
+    return os.path.join(td, f"_route-d{g_dev:05d}.npy")
+
+
+def _write_route_sidecar(
+    td: str, g_dev: int, sd: np.ndarray, sr: np.ndarray
+) -> None:
+    tmp = _route_sidecar(td, g_dev) + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, np.stack(
+            [sd.astype(np.int64), sr.astype(np.int64)]
+        ))
+    os.replace(tmp, _route_sidecar(td, g_dev))
+
+
+def _done_marker(td: str, pid: int) -> str:
+    return os.path.join(td, f"_done-h{pid:03d}.json")
+
+
+def _write_done_marker(td: str, pid: int, dur_s: float) -> None:
+    tmp = _done_marker(td, pid) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": pid, "dur_s": dur_s}, f)
+    os.replace(tmp, _done_marker(td, pid))
+
+
+def _try_read_json(path: str) -> Optional[dict]:
+    """Tolerant read for poll loops: a marker that is absent, torn, or
+    mid-rename reads as None, never an exception."""
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
+def _promote_part(
+    td: str, writer_pid: int, g_dev: int, write_fn, first_wins: bool
+) -> Tuple[bool, int]:
+    """Write one part through a generation-tagged tmp and promote it.
+
+    Disarmed (``first_wins=False``): the existing atomic
+    tmp-then-replace.  Armed: the tmp name carries the writer's process
+    id (the generation tag) and promotion is ``os.link`` — the
+    filesystem's compare-and-swap, first writer wins, every later copy
+    of the same part gets ``FileExistsError`` and is discarded.  Returns
+    ``(won, part_bytes)``; a discarded copy's size is the speculation
+    waste the manifests must confess."""
+    final = os.path.join(td, f"part-r-{g_dev:05d}")
+    if not first_wins:
+        tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
+        with open(tmp, "wb") as f:
+            write_fn(f)
+        os.replace(tmp, final)
+        return True, 0
+    tmp = os.path.join(td, f"_tmp-h{writer_pid:03d}.part-r-{g_dev:05d}")
+    with open(tmp, "wb") as f:
+        write_fn(f)
+    size = int(os.path.getsize(tmp))
+    try:
+        os.link(tmp, final)
+        won = True
+    except FileExistsError:
+        won = False
+    os.unlink(tmp)
+    return won, size
+
+
+def _speculate_stage(
+    ctx: MultihostContext, td: str, sources: List, rows: int,
+    compress: bool, dstream, fetch_threads: int,
+    errors: Optional[str], target: int, level: int,
+    RecordBatch, write_part_fast, plan,
+) -> dict:
+    """Re-execute the straggling ``target`` host's gather+write stage.
+
+    The byte plane already holds everything needed: every sender wrote
+    runs addressed to ``target`` before the ``byte_shuffle_written``
+    barrier, and the straggler published its route sidecars before its
+    own (slow) writes.  The copy fetches with ``dest_pid=target``
+    (accounted as ``mh.speculate.fetch_bytes``, never the recv matrix),
+    writes generation-tagged parts, and races the original through
+    :func:`_promote_part` — whoever links first wins, byte-identical
+    either way because the part bytes are a pure function of the route."""
+    L = ctx.local_device_count
+    with span("mh.speculate", category="stage"):
+        METRICS.count("mh.speculate.launched", 1)
+        fetcher = _ByteFetcher(
+            sources, ctx, rows, compress=compress, dstream=dstream,
+            fetch_threads=fetch_threads, errors=errors, dest_pid=target,
+        )
+        won_parts = 0
+        wasted = 0
+        for g_dev in range(target * L, (target + 1) * L):
+            try:
+                with open(_route_sidecar(td, g_dev), "rb") as f:
+                    route = np.load(f)
+            except (OSError, ValueError):
+                continue  # locator never published; nothing to re-run
+            data, rec_off, rec_len = fetcher.gather(
+                route[0].astype(np.int32), route[1].astype(np.int32)
+            )
+            batch = RecordBatch(
+                soa={"rec_off": rec_off, "rec_len": rec_len},
+                data=data,
+                keys=np.zeros(len(rec_off), dtype=np.int64),
+            )
+            if plan is not None:
+                plan.mh_speculate_lose()
+            won, size = _promote_part(
+                td, ctx.process_id, g_dev,
+                lambda f, b=batch: write_part_fast(
+                    f, b, order=None, level=level
+                ),
+                first_wins=True,
+            )
+            if won:
+                won_parts += 1
+                METRICS.count("mh.speculate.won", 1)
+            else:
+                wasted += size
+                METRICS.count("mh.speculate.wasted_bytes", size)
+    return {
+        "launched": 1,
+        "target": target,
+        "won_parts": won_parts,
+        "wasted_bytes": wasted,
+    }
+
+
+def _maybe_speculate(
+    ctx: MultihostContext, td: str, sources: List, rows: int,
+    compress: bool, dstream, fetch_threads: int,
+    errors: Optional[str], factor: float, my_dur: float, level: int,
+    RecordBatch, write_part_fast, plan,
+) -> dict:
+    """The post-stage poll loop every armed host runs after writing its
+    own parts: read peers' done markers; once the critical-path host has
+    exceeded ``factor`` × the median finished-stage duration, the
+    lowest-pid *finished* host (one designated speculator — no thundering
+    herd) re-executes the straggler's stage.  The loop drains when every
+    marker is present — exactly the wait the ``parts_written`` barrier
+    would impose anyway, so speculation costs idle time, not new
+    synchronization."""
+    P_ = ctx.num_processes
+    t0 = time.perf_counter()
+    info: dict = {}
+    speculated: set = set()
+    while True:
+        done: dict = {}
+        for p in range(P_):
+            blob = _try_read_json(_done_marker(td, p))
+            if blob is not None:
+                done[p] = float(blob.get("dur_s", 0.0))
+        missing = [p for p in range(P_) if p not in done]
+        if not missing:
+            return info
+        cand = [p for p in missing if p not in speculated]
+        if cand and min(done) == ctx.process_id:
+            durs = sorted(done.values())
+            med = max(durs[len(durs) // 2], 1e-3)
+            elapsed = my_dur + (time.perf_counter() - t0)
+            if elapsed > factor * med:
+                t = cand[0]
+                speculated.add(t)
+                got = _speculate_stage(
+                    ctx, td, sources, rows, compress, dstream,
+                    fetch_threads, errors, t, level,
+                    RecordBatch, write_part_fast, plan,
+                )
+                info = {
+                    k: info.get(k, 0) + v if k != "target" else v
+                    for k, v in got.items()
+                }
+        time.sleep(0.05)
+
+
 def _shard_name(pid: int) -> str:
     return f"trace-h{pid:03d}.json"
 
@@ -1278,6 +1665,13 @@ class _MeshObservability:
         self._peer_manifests: dict = {}
         self._mesh_meta: dict = {}
         self._before = None
+        #: Skew-healing provenance, set by the driver before publish():
+        #: the repartition block (triggered/sample_keys/ratio_before/
+        #: ratio_after) and the speculation block (launched/won/
+        #: wasted_bytes/target) land verbatim in the host manifest and
+        #: fold into the ClusterManifest.
+        self.repartition: dict = {}
+        self.speculation: dict = {}
 
     # -- arming ------------------------------------------------------------
 
@@ -1363,6 +1757,8 @@ class _MeshObservability:
                 if k.startswith("mh.http.")
             },
             "anchor_us": self.anchor_us,
+            "repartition": dict(self.repartition),
+            "speculation": dict(self.speculation),
             "run_manifest": run_manifest(
                 backend="multihost", conf=self.conf, counters=counters
             ).as_dict(),
@@ -1514,11 +1910,26 @@ def sort_bam_multihost(
     mesh_trace: Optional[bool] = None,
     mesh_trace_dir: Optional[str] = None,
     errors: Optional[str] = None,
+    sort_order: str = "coordinate",
 ) -> int:
-    """Coordinate-sort BAM(s) across every process of the JAX runtime
+    """Sort BAM(s) across every process of the JAX runtime
     (full docs on the implementation below; resources — shuffle data
     servers, local spill directories — are owned by an ExitStack so every
     failure path tears them down).
+
+    ``sort_order`` is ``"coordinate"`` (default) or ``"queryname"``.
+    Queryname runs the collation engine's rank pass *distributed*: each
+    host collates its own splits by name hash, verifies buckets against
+    actual name bytes, and allgathers only the per-group representative
+    names; every host then ranks the union with the samtools natural
+    comparator, so the global rank table agrees mesh-wide without a
+    coordinator and cross-host hash collisions cost nothing (ranking is
+    on name bytes, never on hashes).  Records route by (rank, flag, pos)
+    through the same key/byte planes as coordinate — the output is
+    byte-identical to single-host ``sort_bam(...,
+    sort_order="queryname")``.  Queryname is in-core only
+    (``memory_budget`` must be None: spill-run cut tables need read-time
+    keys, and queryname ranks exist only after the rank pass).
 
     ``mesh_trace`` (default: ``hadoopbam.mesh.trace`` conf key /
     HBAM_MESH_TRACE env, off) arms the mesh observability plane: every
@@ -1540,7 +1951,7 @@ def sort_bam_multihost(
         return _sort_bam_multihost_impl(
             in_paths, out_path, ctx, conf, split_size, level,
             samples_per_device, memory_budget, byte_plane, stack,
-            mesh_trace, mesh_trace_dir, errors,
+            mesh_trace, mesh_trace_dir, errors, sort_order,
         )
 
 
@@ -1558,8 +1969,9 @@ def _sort_bam_multihost_impl(
     mesh_trace: Optional[bool] = None,
     mesh_trace_dir: Optional[str] = None,
     errors: Optional[str] = None,
+    sort_order: str = "coordinate",
 ) -> int:
-    """Coordinate-sort BAM(s) across every process of the JAX runtime.
+    """Sort BAM(s) across every process of the JAX runtime.
 
     All paths (input, output, and the shuffle directory derived from the
     output path) must be on a filesystem visible to every process — the
@@ -1601,6 +2013,17 @@ def _sort_bam_multihost_impl(
         ctx = initialize()
     if byte_plane not in ("fs", "http"):
         raise ValueError(f"byte_plane must be 'fs' or 'http': {byte_plane!r}")
+    if sort_order not in ("coordinate", "queryname"):
+        raise ValueError(
+            f"sort_order must be 'coordinate' or 'queryname': {sort_order!r}"
+        )
+    queryname = sort_order == "queryname"
+    if queryname and memory_budget is not None:
+        raise ValueError(
+            "sort_order='queryname' is in-core on the mesh: the spill "
+            "plane's monotone-key cut tables need keys at read time, and "
+            "queryname ranks exist only after the distributed rank pass"
+        )
     if errors is None and conf is not None:
         from ..conf import ERRORS_MODE
 
@@ -1611,6 +2034,12 @@ def _sort_bam_multihost_impl(
     compress_shuffle = _resolve_shuffle_compress(conf)
     member_bytes = _resolve_member_bytes(conf)
     fetch_threads = _resolve_fetch_threads(conf)
+    # Skew healing (this PR): the post-route balance bound that triggers
+    # the one-shot range repartition, the straggler factor that arms
+    # speculative stage re-execution, and the repartition reservoir size.
+    skew_bound = _resolve_skew_bound(conf)
+    spec_factor = _resolve_speculate_factor(conf)
+    n_reservoir = _resolve_repartition_samples(conf)
     from ..device_stream import DeviceStream
 
     dstream = DeviceStream(conf=conf, name="mh.shuffle")
@@ -1630,7 +2059,7 @@ def _sort_bam_multihost_impl(
         # (same clamp rule as the single-host external sort).
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
     fmt = BamInputFormat(conf)
-    header = read_header(in_paths[0]).with_sort_order("coordinate")
+    header = read_header(in_paths[0]).with_sort_order(sort_order)
     with span("mh.plan", category="stage"):
         splits = fmt.get_splits(in_paths, split_size=split_size)
     mine = ctx.owned(splits)
@@ -1666,15 +2095,67 @@ def _sort_bam_multihost_impl(
 
     peak_bytes = 0
     if memory_budget is None:
+        qn_fields = None
+        if queryname:
+            from ..collate import collation_columns
+            from ..io.bam import SORT_FIELDS
+
+            qn_fields = tuple(
+                dict.fromkeys(SORT_FIELDS + ("l_read_name",))
+            )
+        collate_cols: List[dict] = []
         with span("mh.read", category="stage"):
             batches = []
             for j, s in enumerate(mine):
                 if _plan is not None:
                     _plan.exec_attempt(ctx.process_id, j, _torn)
                 with trace_ctx(split=ctx.process_id + j * ctx.num_processes):
-                    batches.append(fmt.read_split(s))
+                    if queryname:
+                        # Decode the name-collation columns now (hashes,
+                        # flag/pos, the name blob) — the rank pass below
+                        # works on these, never on whole records.
+                        b = fmt.read_split(
+                            s, fields=qn_fields, with_keys=False
+                        )
+                        collate_cols.append(collation_columns(b.data, b.soa))
+                        b.soa = {
+                            "rec_off": b.soa["rec_off"],
+                            "rec_len": b.soa["rec_len"],
+                        }
+                        batches.append(b)
+                    else:
+                        batches.append(fmt.read_split(s))
             own_counts = [b.n_records for b in batches]
-            local = _concat_batches(batches)
+            if queryname:
+                # The trimmed batches carry only record extents (keys and
+                # the full SOA were never decoded) — concat those.
+                base = np.cumsum(
+                    [0] + [len(b.data) for b in batches[:-1]]
+                ).astype(np.int64)
+                local = RecordBatch(
+                    soa={
+                        "rec_off": (
+                            np.concatenate([
+                                b.soa["rec_off"] + base[i]
+                                for i, b in enumerate(batches)
+                            ])
+                            if batches else np.empty(0, np.int64)
+                        ),
+                        "rec_len": (
+                            np.concatenate(
+                                [b.soa["rec_len"] for b in batches]
+                            )
+                            if batches else np.empty(0, np.int64)
+                        ),
+                    },
+                    data=(
+                        np.concatenate([b.data for b in batches])
+                        if batches else np.empty(0, np.uint8)
+                    ),
+                    keys=np.empty(int(sum(own_counts)), np.int64),
+                )
+            else:
+                local = _concat_batches(batches)
             del batches
         n_local = local.n_records
     else:
@@ -1743,7 +2224,16 @@ def _sort_bam_multihost_impl(
             if own_counts
             else np.empty(0, np.int32)
         )
-        keys_local = local.keys
+        if queryname:
+            # The distributed rank pass: keys do not exist at read time
+            # for queryname — they ARE the global name ranks.
+            with span("mh.rank", category="stage"):
+                keys_local, qn_tiebreak = _distributed_name_ranks(
+                    ctx, collate_cols
+                )
+            del collate_cols
+        else:
+            keys_local = local.keys
     else:
         # Run r is split-ordinal-base + its sort permutation (the run is
         # the split's records in key order, so ordinal = base + perm).
@@ -1790,6 +2280,16 @@ def _sort_bam_multihost_impl(
     lo_l[slots] = k_lo
     val_l[slots] = True
     org_l[slots] = orig_local
+    if queryname:
+        # Secondary key word: the engine's (flag, pos+1) tie-break rides
+        # the shuffle as a second (hi, lo) column pair; the global read
+        # ordinal (org) breaks remaining ties, so the device-side sort
+        # reproduces the single-host lexsort exactly.
+        hi2_l = np.full(L * rows, 0x7FFFFFFF, np.int32)
+        lo2_l = np.full(L * rows, 0xFFFFFFFF, np.uint32)
+        k2_hi, k2_lo = split_keys_np(qn_tiebreak)
+        hi2_l[slots] = k2_hi
+        lo2_l[slots] = k2_lo
     # record index -> its local slot (for the byte plane)
     row_of_record = slots.astype(np.int64)
 
@@ -1800,29 +2300,6 @@ def _sort_bam_multihost_impl(
             sharding, arr, (D * rows,) + arr.shape[1:]
         )
 
-    overflow = -1
-    cap = None
-    with span("mh.key_shuffle", category="stage"):
-        while True:
-            ds = DistributedSort(
-                ctx.mesh,
-                rows_per_device=rows,
-                capacity_per_pair=cap,
-                samples_per_device=samples_per_device,
-            )
-            res = ds(
-                gshard(hi_l), gshard(lo_l), gshard(val_l), gshard(org_l)
-            )
-            overflow = int(res.overflow)
-            if overflow == 0:
-                break
-            if cap == rows:
-                raise RuntimeError(
-                    "shuffle overflow even at full capacity"
-                )
-            cap = min(rows, ds.capacity * 2)
-    METRICS.count("mh.records", n_total)
-
     # Sender-side routing table: destination device of each local record.
     # Addressable-shard order is not guaranteed — order by global offset.
     def _local_view(arr, per_shard: int) -> List[np.ndarray]:
@@ -1832,6 +2309,90 @@ def _sort_bam_multihost_impl(
         views = [np.asarray(s.data) for s in got]
         assert all(len(v) == per_shard for v in views), "shard shape drift"
         return views
+
+    kw = 2 if queryname else 1
+    dev_of_slot = np.arange(L * rows, dtype=np.int64) // rows
+    overflow = -1
+    cap = None
+    splitters = None
+    repartitioned = False
+    cap_resolved = False
+    repart_info: dict = {}
+    with span("mh.key_shuffle", category="stage"):
+        while True:
+            ds = DistributedSort(
+                ctx.mesh,
+                rows_per_device=rows,
+                capacity_per_pair=cap,
+                samples_per_device=samples_per_device,
+                key_words=kw,
+                splitters=splitters,
+            )
+            res = (
+                ds(
+                    gshard(hi_l), gshard(lo_l), gshard(val_l),
+                    gshard(org_l), hi2=gshard(hi2_l), lo2=gshard(lo2_l),
+                )
+                if queryname
+                else ds(
+                    gshard(hi_l), gshard(lo_l), gshard(val_l),
+                    gshard(org_l),
+                )
+            )
+            overflow = int(res.overflow)
+            # Post-route census, allgathered so every host sees the same
+            # numbers and branches identically: records per destination
+            # device (what the skew bound judges) and the largest single
+            # (src_dev, dst_dev) bucket (the capacity a retry actually
+            # needs — measured, not guessed).
+            dest_l = np.concatenate(_local_view(res.dest, rows))
+            pair = np.zeros((L, D), dtype=np.int64)
+            np.add.at(pair, (dev_of_slot[val_l], dest_l[val_l]), 1)
+            stats = np.concatenate([pair.sum(axis=0), [pair.max()]])
+            all_stats = ctx.allgather_array(stats)  # [P, D+1]
+            per_dev = all_stats[:, :D].sum(axis=0)
+            need = int(all_stats[:, D].max())
+            mean = float(per_dev.mean())
+            ratio = float(per_dev.max()) / mean if mean > 0 else 0.0
+            if repartitioned and "ratio_after" not in repart_info:
+                repart_info["ratio_after"] = ratio
+                METRICS.set_gauge("mh.repartition.ratio_after", ratio)
+            skewed = skew_bound > 0 and ratio > skew_bound
+            if overflow == 0 and (not skewed or repartitioned):
+                # Balanced — or already refreshed once: one repartition
+                # per round, the bound is advisory after that.
+                break
+            if not repartitioned and skew_bound > 0:
+                # Rescue #1 — adaptive range repartition: refresh the
+                # partitioner from a real key reservoir and re-route.
+                # Preferred over a capacity bump because it removes the
+                # imbalance instead of buying the skewed cut more room.
+                repartitioned = True
+                splitters, n_pool = _reservoir_splitters(
+                    ctx, keys_local, n_reservoir, D, rng
+                )
+                repart_info.update(
+                    triggered=1, sample_keys=n_pool, ratio_before=ratio
+                )
+                METRICS.count("mh.repartition.triggered", 1)
+                METRICS.count("mh.repartition.sample_keys", n_pool)
+                METRICS.set_gauge("mh.repartition.ratio_before", ratio)
+                continue
+            if overflow > 0 and not cap_resolved:
+                # Rescue #2 — one capacity retry, sized exactly from the
+                # measured worst bucket so rescues cannot compound.
+                cap_resolved = True
+                cap = max(16, min(rows, need))
+                METRICS.count("mh.shuffle.capacity_retry", 1)
+                continue
+            if overflow > 0:
+                raise RuntimeError(
+                    "shuffle overflow persists after repartition and "
+                    "the measured-capacity retry"
+                )
+            break  # skewed but repartition disabled (skew-bound <= 0)
+    obs.repartition = repart_info
+    METRICS.count("mh.records", n_total)
 
     # The byte plane labels global rows as pid*L*rows + slot, which is
     # only correct if this process's devices occupy the contiguous mesh
@@ -1853,27 +2414,32 @@ def _sort_bam_multihost_impl(
     dest_of_record = dest_l[row_of_record]
 
     # Key-plane byte accounting: routed rows per destination process ×
-    # KEY_ROW_BYTES (the six all_to_all columns).  The sender counts
-    # from its own routing table; the receiver-side column comes from
-    # the allgathered row-count matrix (both sides route identically by
-    # construction — the byte plane below is the independently-measured
-    # matrix the balance assert actually bites on).
+    # the sort's per-row key width (``ds.key_row_bytes`` — the six
+    # all_to_all columns, eight when the queryname tie-break word rides
+    # along).  The sender counts from its own routing table; the
+    # receiver-side column comes from the allgathered row-count matrix
+    # (both sides route identically by construction — the byte plane
+    # below is the independently-measured matrix the balance assert
+    # actually bites on).
     key_rows = np.bincount(
         process_of_device(dest_of_record, L), minlength=P_
     ).astype(np.int64)
     key_matrix = ctx.allgather_array(key_rows)  # [P, P] rows sent s->q
     for q in range(P_):
         METRICS.count(
-            f"mh.keys.sent.{q}", int(key_rows[q]) * KEY_ROW_BYTES
+            f"mh.keys.sent.{q}", int(key_rows[q]) * ds.key_row_bytes
         )
     for s in range(P_):
         METRICS.count(
             f"mh.keys.recv.{s}",
-            int(key_matrix[s][ctx.process_id]) * KEY_ROW_BYTES,
+            int(key_matrix[s][ctx.process_id]) * ds.key_row_bytes,
         )
     TRACER.counter(
         "mh.keys.sent",
-        {str(q): float(key_rows[q] * KEY_ROW_BYTES) for q in range(P_)},
+        {
+            str(q): float(key_rows[q] * ds.key_row_bytes)
+            for q in range(P_)
+        },
     )
 
     # td / shuffle_dir were derived from out_path at function entry (the
@@ -1910,13 +2476,14 @@ def _sort_bam_multihost_impl(
 
         # Receiver: each local device's sorted rows → one part file each
         # (the ExitStack owns server/spill teardown on every outcome).
+        # With the speculate factor armed this stage is re-executable: the
+        # route sidecars published below are the locators a finished peer
+        # needs to re-run a straggler's gather from the byte plane alone.
+        speculate = spec_factor > 0.0
         out_counts: List[int] = []
+        spec_info: dict = {}
+        t_parts0 = time.perf_counter()
         with span("mh.byte_shuffle.fetch", category="stage"):
-            fetcher = _ByteFetcher(
-                sources, ctx, rows, compress=compress_shuffle,
-                dstream=dstream, fetch_threads=fetch_threads,
-                errors=errors,
-            )
             cap_rows = res.hi.shape[0] // D
             v_sh = _local_view(res.valid, cap_rows)
             sd_sh = _local_view(res.src_dev, cap_rows)
@@ -1926,7 +2493,24 @@ def _sort_bam_multihost_impl(
                 (s.index[0].start or 0) // cap_rows
                 for s in res.valid.addressable_shards
             )
+            if speculate:
+                for k, g_dev in enumerate(g_devs):
+                    v = v_sh[k]
+                    _write_route_sidecar(
+                        td, g_dev, sd_sh[k][v], sr_sh[k][v]
+                    )
+            fetcher = _ByteFetcher(
+                sources, ctx, rows, compress=compress_shuffle,
+                dstream=dstream, fetch_threads=fetch_threads,
+                errors=errors,
+            )
             for k, g_dev in enumerate(g_devs):
+                # The parts-stage injection point, offset +1000 so one
+                # directive grammar drives read-stage and parts-stage
+                # drills separately (exec.delay:items=1,attempts=1000-…
+                # slows exactly host 1's writes — the speculation drill).
+                if _plan is not None:
+                    _plan.exec_attempt(ctx.process_id, 1000 + k, _torn)
                 v = v_sh[k]
                 sd = sd_sh[k][v]
                 sr = sr_sh[k][v]
@@ -1940,12 +2524,38 @@ def _sort_bam_multihost_impl(
                     keys=keys,
                 )
                 out_counts.append(int(len(rec_off)))
-                tmp = os.path.join(td, f"_temporary.part-r-{g_dev:05d}")
-                with open(tmp, "wb") as f:
-                    write_part_fast(f, batch, order=None, level=level)
-                os.replace(
-                    tmp, os.path.join(td, f"part-r-{g_dev:05d}")
+                won, size = _promote_part(
+                    td, ctx.process_id, g_dev,
+                    lambda f, b=batch: write_part_fast(
+                        f, b, order=None, level=level
+                    ),
+                    first_wins=speculate,
                 )
+                if not won:
+                    # A speculative copy beat this write to the link: the
+                    # part on disk is byte-identical (same route, same
+                    # writer), this copy is the loser the manifest counts.
+                    spec_info["lost_parts"] = (
+                        spec_info.get("lost_parts", 0) + 1
+                    )
+                    spec_info["wasted_bytes"] = (
+                        spec_info.get("wasted_bytes", 0) + size
+                    )
+                    METRICS.count("mh.speculate.wasted_bytes", size)
+        if speculate:
+            my_dur = time.perf_counter() - t_parts0
+            _write_done_marker(td, ctx.process_id, my_dur)
+            got = _maybe_speculate(
+                ctx, td, sources, rows, compress_shuffle, dstream,
+                fetch_threads, errors, spec_factor, my_dur, level,
+                RecordBatch, write_part_fast, _plan,
+            )
+            for k, v in got.items():
+                if k == "target":
+                    spec_info[k] = v
+                else:
+                    spec_info[k] = spec_info.get(k, 0) + v
+        obs.speculation = spec_info
         ctx.barrier("parts_written")
         cleanup_dir = write_dir if byte_plane == "http" else None
     else:
@@ -1998,3 +2608,310 @@ def _sort_bam_multihost_impl(
     obs.finalize(peak_bytes, n_local, out_counts, skew_ratio)
     ctx.barrier("merged")
     return n_total
+
+
+def fixmate_bam_multihost(
+    in_paths: Sequence[str] | str,
+    out_path: str,
+    ctx: Optional[MultihostContext] = None,
+    conf=None,
+    split_size: int = 32 << 20,
+    level: int = 6,
+    errors: Optional[str] = None,
+):
+    """Fixmate across every process of the JAX runtime — the collation
+    engine's pairing run mesh-wide, output byte-identical to single-host
+    :func:`pipeline.fixmate_bam` on the same input.
+
+    Unlike the sort drivers, fixmate preserves record order, so no
+    key/byte shuffle runs at all.  What *is* distributed is the pairing
+    decision:
+
+    1. every host reads its round-robin splits and collates them by name
+       hash (verified against actual name bytes, as always);
+    2. the distributed rank pass (:func:`_global_name_rank_pass`)
+       allgathers only per-group representative names and gives every
+       record a dense global name rank;
+    3. per-rank candidate counts are allgathered — a rank with one local
+       candidate and two global candidates is a **half-open pair**: the
+       mate lives on another host.  Exactly those candidates' mate-facing
+       columns (flag/refid/pos/span + the CIGAR blob for MC tags, ~tens
+       of bytes each) are exchanged, never whole records;
+    4. each host extends its local columns with the remote mates as
+       *virtual rows*, wires the mate index across the boundary (pairs
+       with >2 global candidates are broken, matching the single-host
+       engine on the union), and runs the unchanged vectorized edit pass
+       (:func:`collate.compute_fixmate_edits`) — virtual rows get edits
+       too, but only local rows are ever applied;
+    5. parts are written per owned split in plan order and process 0
+       merges under the *input* header (fixmate changes neither order
+       nor grouping).
+
+    Returns a :class:`pipeline.FixmateStats` with mesh-global counts
+    (identical on every process); straddling pairs are counted once, by
+    the host owning the lower-ordinal record."""
+    from ..collate import (
+        Collation,
+        FIXMATE_FIELDS,
+        apply_fixmate,
+        collate_by_name,
+        collation_columns,
+        compute_fixmate_edits,
+        concat_collation,
+        verify_and_repair,
+    )
+    from ..io.bam import BamInputFormat, read_header, write_part_fast
+    from ..io.merger import merge_bam_parts
+    from ..pipeline import FixmateStats
+    from ..spec.bam import FLAG_PAIRED
+
+    if isinstance(in_paths, str):
+        in_paths = [in_paths]
+    if ctx is None:
+        ctx = initialize()
+    if errors is None and conf is not None:
+        from ..conf import ERRORS_MODE
+
+        errors = conf.get(ERRORS_MODE)
+    fmt = BamInputFormat(conf)
+    header = read_header(in_paths[0])  # fixmate: header claims nothing new
+    with span("mh.plan", category="stage"):
+        splits = fmt.get_splits(in_paths, split_size=split_size)
+    mine = ctx.owned(splits)
+    P_ = ctx.num_processes
+    _plan = faults.ACTIVE
+    out_dir_pre = os.path.dirname(os.path.abspath(out_path)) or "."
+    _torn = os.path.join(
+        out_dir_pre, f"_mh_torn_{ctx.process_id:03d}.tmp"
+    )
+    read_fields = tuple(dict.fromkeys(FIXMATE_FIELDS))
+
+    batches: List = []
+    cols_parts: List[dict] = []
+    with span("mh.read", category="stage"):
+        for j, s in enumerate(mine):
+            if _plan is not None:
+                _plan.exec_attempt(ctx.process_id, j, _torn)
+            with trace_ctx(split=ctx.process_id + j * P_):
+                b = fmt.read_split(
+                    s, fields=read_fields, with_keys=False, errors=errors
+                )
+            cols_parts.append(
+                collation_columns(b.data, b.soa, with_cigars=True)
+            )
+            batches.append(b)
+    own_counts = [b.n_records for b in batches]
+    row_bases = np.concatenate(
+        [[0], np.cumsum(own_counts)]
+    ).astype(np.int64)
+    n = int(row_bases[-1])
+
+    # Global ordinals (same padded allgather as the sort driver): the
+    # deterministic tie-breaker for straddling-pair ownership.
+    max_owned = max(1, -(-len(splits) // P_))
+    cm = np.zeros(max_owned, dtype=np.int64)
+    cm[: len(own_counts)] = own_counts
+    M = ctx.allgather_array(cm)
+    counts_by_split = np.zeros(max(1, len(splits)), dtype=np.int64)
+    for k in range(len(splits)):
+        counts_by_split[k] = M[k % P_][k // P_]
+    split_base = np.concatenate(
+        [[0], np.cumsum(counts_by_split)]
+    ).astype(np.int64)
+    n_total = int(split_base[len(splits)])
+    org_local = (
+        np.concatenate(
+            [
+                split_base[ctx.process_id + j * P_] + np.arange(c)
+                for j, c in enumerate(own_counts)
+            ]
+        ).astype(np.int64)
+        if own_counts
+        else np.empty(0, np.int64)
+    )
+    METRICS.count("mh.fixmate.records", n_total)
+
+    with span("mh.rank", category="stage"):
+        cols = concat_collation(cols_parts)
+        cols_parts = []
+        col = collate_by_name(cols)
+        col, _ = verify_and_repair(col, cols)
+        rk, n_names = _global_name_rank_pass(ctx, cols, col)
+
+    with span("mh.fixmate.pair", category="stage"):
+        # Per-rank candidate census: local counts, then the allgathered
+        # global view every pairing decision below agrees on.
+        cand_mask = cols["cand"] != 0
+        local_cand = np.bincount(
+            rk[cand_mask], minlength=max(1, n_names)
+        ).astype(np.int64)
+        global_cand = ctx.allgather_array(local_cand).sum(axis=0)
+
+        # Local pairs survive only if the pair is globally exact (two
+        # candidates anywhere) — a third candidate on another host makes
+        # the name anomalous, exactly as a third local candidate would.
+        mate_loc = col.mate.astype(np.int64).copy()
+        lp = np.flatnonzero(mate_loc >= 0)
+        if len(lp):
+            broken = global_cand[rk[lp]] != 2
+            mate_loc[lp[broken]] = -1
+
+        # Half-open pairs: one candidate here, two globally — exchange
+        # the mate-facing columns (never whole records).
+        half_rows = np.flatnonzero(
+            cand_mask & (local_cand[rk] == 1) & (global_cand[rk] == 2)
+        )
+        n_half = len(half_rows)
+        METRICS.count("mh.fixmate.half_open", n_half)
+        tab = np.zeros((n_half, 7), np.int64)
+        if n_half:
+            tab[:, 0] = rk[half_rows]
+            tab[:, 1] = org_local[half_rows]
+            tab[:, 2] = cols["flag"][half_rows]
+            tab[:, 3] = cols["refid"][half_rows]
+            tab[:, 4] = cols["pos"][half_rows]
+            tab[:, 5] = cols["span"][half_rows]
+            tab[:, 6] = cols["n_cig"][half_rows]
+        chunks = [
+            cols["cigs"][
+                int(cols["cig_off"][r]) :
+                int(cols["cig_off"][r]) + 4 * int(cols["n_cig"][r])
+            ]
+            for r in half_rows
+        ]
+        blob = (
+            np.concatenate(chunks) if chunks else np.empty(0, np.uint8)
+        )
+        sizes = ctx.allgather_array(
+            np.array([n_half, len(blob)], np.int64)
+        )
+        mg = int(sizes[:, 0].max())
+        mb = int(sizes[:, 1].max())
+        tab_pad = np.zeros((max(1, mg), 7), np.int64)
+        tab_pad[:n_half] = tab
+        blob_pad = np.zeros(max(1, mb), np.uint8)
+        blob_pad[: len(blob)] = blob
+        all_tab = ctx.allgather_array(tab_pad)
+        all_blob = ctx.allgather_array(blob_pad)
+
+        # Virtual rows: every remote half-open candidate whose rank
+        # matches one of ours (global count 2 ⇒ exactly one match).
+        rank_to_row = {int(rk[r]): int(r) for r in half_rows}
+        v_local: List[int] = []
+        v_tab: List[np.ndarray] = []
+        v_cig: List[np.ndarray] = []
+        straddle_owned = 0
+        for p in range(P_):
+            if p == ctx.process_id:
+                continue
+            g = int(sizes[p, 0])
+            tp = all_tab[p][:g]
+            offs = np.concatenate(
+                [[0], np.cumsum(4 * tp[:, 6])]
+            ).astype(np.int64)
+            for i in range(g):
+                r = rank_to_row.get(int(tp[i, 0]))
+                if r is None:
+                    continue
+                v_local.append(r)
+                v_tab.append(tp[i])
+                v_cig.append(
+                    all_blob[p][int(offs[i]) : int(offs[i + 1])]
+                )
+                if int(org_local[r]) < int(tp[i, 1]):
+                    straddle_owned += 1
+        n_virt = len(v_local)
+        METRICS.count("mh.fixmate.virtual_mates", n_virt)
+
+        vt = (
+            np.stack(v_tab)
+            if n_virt else np.zeros((0, 7), np.int64)
+        )
+        v_cig_blob = (
+            np.concatenate(v_cig) if v_cig else np.empty(0, np.uint8)
+        )
+        v_cig_off = (
+            np.concatenate([[0], np.cumsum(4 * vt[:, 6])[:-1]])
+            if n_virt else np.empty(0, np.int64)
+        ).astype(np.int64) + len(cols["cigs"])
+        cols_ext = {
+            "flag": np.concatenate([cols["flag"], vt[:, 2]]).astype(
+                cols["flag"].dtype
+            ),
+            "refid": np.concatenate([cols["refid"], vt[:, 3]]).astype(
+                np.int32
+            ),
+            "pos": np.concatenate([cols["pos"], vt[:, 4]]).astype(
+                np.int32
+            ),
+            "span": np.concatenate([cols["span"], vt[:, 5]]).astype(
+                np.int32
+            ),
+            "cand": np.concatenate(
+                [cols["cand"], np.ones(n_virt, cols["cand"].dtype)]
+            ),
+            "n_cig": np.concatenate([cols["n_cig"], vt[:, 6]]).astype(
+                np.int32
+            ),
+            "cig_off": np.concatenate(
+                [cols["cig_off"], v_cig_off]
+            ).astype(np.int64),
+            "cigs": np.concatenate([cols["cigs"], v_cig_blob]),
+        }
+        mate_ext = np.concatenate(
+            [mate_loc, np.full(n_virt, -1, np.int64)]
+        ).astype(np.int32)
+        for k, r in enumerate(v_local):
+            mate_ext[r] = n + k
+            mate_ext[n + k] = r
+        n_ext = n + n_virt
+        col_ext = Collation(
+            order=np.arange(n_ext, dtype=np.int64),
+            group=np.zeros(n_ext, np.int32),
+            n_groups=0,
+            mate=mate_ext,
+            n_pairs=int((mate_ext >= 0).sum()) // 2,
+        )
+
+    with span("mh.fixmate.edits", category="stage"):
+        edits = compute_fixmate_edits(cols_ext, col_ext)
+
+    # Mesh-global stats (identical everywhere): straddling pairs counted
+    # by the lower-ordinal owner; singletons/orphans are host-local facts.
+    own_pairs = int((mate_loc >= 0).sum()) // 2 + straddle_owned
+    singles = int(((cols["flag"] & FLAG_PAIRED) == 0).sum())
+    orphans = int((cand_mask & (mate_ext[:n] < 0)).sum())
+    totals = ctx.allgather_array(
+        np.array([own_pairs, singles, orphans], np.int64)
+    ).sum(axis=0)
+
+    td = os.path.join(
+        out_dir_pre, f"_mh_{os.path.basename(out_path)}.parts"
+    )
+    if ctx.process_id == 0:
+        os.makedirs(td, exist_ok=True)
+    ctx.barrier("fixmate_mkdirs")
+    os.makedirs(td, exist_ok=True)
+    with span("mh.fixmate.write", category="stage"):
+        for j, b in enumerate(batches):
+            gsi = ctx.process_id + j * P_
+            patched = apply_fixmate(b, edits, int(row_bases[j]))
+            tmp = os.path.join(td, f"_temporary.part-r-{gsi:05d}")
+            with open(tmp, "wb") as f:
+                write_part_fast(f, patched, order=None, level=level)
+            os.replace(tmp, os.path.join(td, f"part-r-{gsi:05d}"))
+    ctx.barrier("fixmate_parts_written")
+    if ctx.process_id == 0:
+        with span("mh.merge", category="stage"):
+            nio.write_success(td)
+            merge_bam_parts(td, out_path, header)
+            nio.delete_recursive(td)
+    ctx.barrier("fixmate_merged")
+    return FixmateStats(
+        n_records=n_total,
+        n_splits=len(splits),
+        n_pairs=int(totals[0]),
+        n_singletons=int(totals[1]),
+        n_orphans=int(totals[2]),
+        backend="collate-fixmate[mesh]",
+    )

@@ -53,8 +53,13 @@ _LO_PAD = np.uint32(0xFFFFFFFF)
 #: ``mh.keys.recv.<src>``) multiplies routed-row counts by this; the
 #: padding slots of the fixed ``[D, capacity]`` send buffers also cross
 #: the wire but carry no record, so they are deliberately excluded — the
-#: matrix reports payload, capacity headroom is a tuning knob.
+#: matrix reports payload, capacity headroom is a tuning knob.  A
+#: two-word sort (``key_words=2`` — queryname's (rank, flag|pos) pair)
+#: ships two extra buffers (hi2 int32 + lo2 uint32); use the instance's
+#: ``key_row_bytes`` for accounting, which is this constant for the
+#: default single-word path.
 KEY_ROW_BYTES = 21
+_WORD2_BYTES = 8  # hi2 int32 + lo2 uint32 per routed row when key_words=2
 
 
 class ShuffleResult(NamedTuple):
@@ -78,7 +83,11 @@ class DistributedSort:
         rows_per_device: int,
         capacity_per_pair: Optional[int] = None,
         samples_per_device: int = 64,
+        key_words: int = 1,
+        splitters: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ):
+        if key_words not in (1, 2):
+            raise ValueError(f"key_words must be 1 or 2, got {key_words}")
         self.mesh = mesh
         self.n_devices = mesh.devices.size
         self.rows = rows_per_device
@@ -88,6 +97,29 @@ class DistributedSort:
             16, int(np.ceil(rows_per_device / d * 1.6))
         )
         self.samples = samples_per_device
+        self.key_words = key_words
+        #: Wire bytes per routed row across the all_to_all buffers (see
+        #: KEY_ROW_BYTES); two-word keys ship 8 more per row.
+        self.key_row_bytes = KEY_ROW_BYTES + (
+            _WORD2_BYTES if key_words == 2 else 0
+        )
+        # Explicit range splitters on the primary word, np arrays of
+        # shape [D-1].  When given, the per-round sample election is
+        # skipped entirely — this is the adaptive-repartition hook: the
+        # host re-cuts balanced quantiles from a key reservoir and hands
+        # the mesh a corrected partitioner.
+        if splitters is not None:
+            sp_hi, sp_lo = splitters
+            sp_hi = np.asarray(sp_hi, np.int32)
+            sp_lo = np.asarray(sp_lo, np.uint32)
+            if sp_hi.shape != (d - 1,) or sp_lo.shape != (d - 1,):
+                raise ValueError(
+                    f"splitters must be [{d - 1}] arrays, got "
+                    f"{sp_hi.shape}/{sp_lo.shape}"
+                )
+            self.splitters = (sp_hi, sp_lo)
+        else:
+            self.splitters = None
         self._step = self._build()
 
     # -- the SPMD program ---------------------------------------------------
@@ -96,8 +128,10 @@ class DistributedSort:
         d = self.n_devices
         rows, cap, S = self.rows, self.capacity, self.samples
         axis = DATA_AXIS
+        wide = self.key_words == 2
+        fixed = self.splitters
 
-        def local(hi, lo, valid, orig):
+        def impl(hi, lo, valid, orig, hi2, lo2):
             # [rows] per device.  ``orig`` is the caller's global input
             # ordinal — the tie-breaking third sort key, so equal keys come
             # out in input order exactly like a stable single-chip sort
@@ -105,29 +139,39 @@ class DistributedSort:
             # merge-sort is stable in (key, input) order).
             dev = lax.axis_index(axis).astype(jnp.int32)
 
-            # 1. local sort (invalid rows sink) + sample election.  Samples
-            # from padding-only devices carry a validity flag so they cannot
-            # poison the splitters.
-            inv = (~valid).astype(jnp.uint8)
-            _, hi_s, lo_s = lax.sort((inv, hi, lo), num_keys=3)
-            nvalid = jnp.sum(valid).astype(jnp.int32)
-            pos = (jnp.arange(S, dtype=jnp.int32) * jnp.maximum(nvalid, 1)) // S
-            samp_ok = jnp.broadcast_to(nvalid > 0, (S,))
-            samp_hi = jnp.where(samp_ok, hi_s[pos], _HI_PAD)
-            samp_lo = jnp.where(samp_ok, lo_s[pos], _LO_PAD)
-            all_hi = lax.all_gather(samp_hi, axis, tiled=True)  # [D*S]
-            all_lo = lax.all_gather(samp_lo, axis, tiled=True)
-            all_ok = lax.all_gather(samp_ok, axis, tiled=True)
-            g_inv = (~all_ok).astype(jnp.uint8)
-            _, g_hi, g_lo = lax.sort((g_inv, all_hi, all_lo), num_keys=3)
-            n_ok = jnp.sum(all_ok).astype(jnp.int32)
-            # Quantile cuts over the *valid* sample prefix only.
-            cut = jnp.clip(
-                (jnp.arange(1, d, dtype=jnp.int32) * n_ok) // d,
-                0,
-                d * S - 1,
-            )
-            sp_hi, sp_lo = g_hi[cut], g_lo[cut]  # [D-1] splitters
+            if fixed is not None:
+                # Host-supplied splitters (adaptive repartition): the
+                # election is skipped; these become jit constants.
+                sp_hi = jnp.asarray(fixed[0])
+                sp_lo = jnp.asarray(fixed[1])
+            else:
+                # 1. local sort (invalid rows sink) + sample election.
+                # Samples from padding-only devices carry a validity flag
+                # so they cannot poison the splitters.  Always on the
+                # primary word: ranges are cut on word1, word2 only
+                # breaks ties locally after routing.
+                inv = (~valid).astype(jnp.uint8)
+                _, hi_s, lo_s = lax.sort((inv, hi, lo), num_keys=3)
+                nvalid = jnp.sum(valid).astype(jnp.int32)
+                pos = (
+                    jnp.arange(S, dtype=jnp.int32) * jnp.maximum(nvalid, 1)
+                ) // S
+                samp_ok = jnp.broadcast_to(nvalid > 0, (S,))
+                samp_hi = jnp.where(samp_ok, hi_s[pos], _HI_PAD)
+                samp_lo = jnp.where(samp_ok, lo_s[pos], _LO_PAD)
+                all_hi = lax.all_gather(samp_hi, axis, tiled=True)  # [D*S]
+                all_lo = lax.all_gather(samp_lo, axis, tiled=True)
+                all_ok = lax.all_gather(samp_ok, axis, tiled=True)
+                g_inv = (~all_ok).astype(jnp.uint8)
+                _, g_hi, g_lo = lax.sort((g_inv, all_hi, all_lo), num_keys=3)
+                n_ok = jnp.sum(all_ok).astype(jnp.int32)
+                # Quantile cuts over the *valid* sample prefix only.
+                cut = jnp.clip(
+                    (jnp.arange(1, d, dtype=jnp.int32) * n_ok) // d,
+                    0,
+                    d * S - 1,
+                )
+                sp_hi, sp_lo = g_hi[cut], g_lo[cut]  # [D-1] splitters
 
             # 2. destination bucket: count of splitters <= key ("right"
             # side keeps ties together on the lower device).
@@ -175,21 +219,53 @@ class DistributedSort:
             r_row = exchange(b_row)
             r_org = exchange(b_org)
 
-            # 5. local sort of the received rows; ``orig`` is the third
+            # 5. local sort of the received rows; ``orig`` is the last
             # key, so tie order equals input order deterministically.
             r_inv = (~r_val).astype(jnp.uint8)
-            _, s_hi, s_lo, _, s_val, s_dev, s_row = lax.sort(
-                (r_inv, r_hi, r_lo, r_org, r_val, r_dev, r_row), num_keys=4
-            )
+            if wide:
+                r_hi2 = exchange(scatter(hi2, _HI_PAD))
+                r_lo2 = exchange(scatter(lo2, _LO_PAD))
+                _, s_hi, s_lo, _, _, _, s_val, s_dev, s_row = lax.sort(
+                    (
+                        r_inv,
+                        r_hi,
+                        r_lo,
+                        r_hi2,
+                        r_lo2,
+                        r_org,
+                        r_val,
+                        r_dev,
+                        r_row,
+                    ),
+                    num_keys=6,
+                )
+            else:
+                _, s_hi, s_lo, _, s_val, s_dev, s_row = lax.sort(
+                    (r_inv, r_hi, r_lo, r_org, r_val, r_dev, r_row),
+                    num_keys=4,
+                )
             total_overflow = lax.psum(overflow, axis)
             dest_out = jnp.where(valid, dest, -1)
             return s_hi, s_lo, s_val, s_dev, s_row, total_overflow, dest_out
+
+        if wide:
+
+            def local(hi, lo, hi2, lo2, valid, orig):
+                return impl(hi, lo, valid, orig, hi2, lo2)
+
+            n_in = 6
+        else:
+
+            def local(hi, lo, valid, orig):
+                return impl(hi, lo, valid, orig, None, None)
+
+            n_in = 4
 
         spec = P(DATA_AXIS)
         fn = shard_map(
             local,
             mesh=self.mesh,
-            in_specs=(spec, spec, spec, spec),
+            in_specs=(spec,) * n_in,
             out_specs=(spec, spec, spec, spec, spec, P(), spec),
         )
         return jax.jit(fn)
@@ -205,18 +281,33 @@ class DistributedSort:
         lo: jax.Array,
         valid: jax.Array,
         orig: Optional[jax.Array] = None,
+        hi2: Optional[jax.Array] = None,
+        lo2: Optional[jax.Array] = None,
     ) -> ShuffleResult:
         """Inputs are [D*rows] arrays (sharded or host-resident).
 
         ``orig`` (int32 global input ordinals) makes tie order
-        deterministic (input order); omitted → arbitrary tie order."""
+        deterministic (input order); omitted → arbitrary tie order.
+        ``hi2``/``lo2`` carry the secondary key word and are required
+        iff the sorter was built with ``key_words=2`` (routing stays on
+        the primary word; the secondary word orders rows after
+        arrival)."""
         if orig is None:
             orig = jnp.zeros(hi.shape, jnp.int32)
             if hasattr(hi, "sharding"):
                 orig = jax.device_put(orig, hi.sharding)
-        s_hi, s_lo, s_val, s_dev, s_row, ovf, dest = self._step(
-            hi, lo, valid, orig
-        )
+        if self.key_words == 2:
+            if hi2 is None or lo2 is None:
+                raise ValueError("key_words=2 sorter requires hi2 and lo2")
+            s_hi, s_lo, s_val, s_dev, s_row, ovf, dest = self._step(
+                hi, lo, hi2, lo2, valid, orig
+            )
+        else:
+            if hi2 is not None or lo2 is not None:
+                raise ValueError("hi2/lo2 given but sorter has key_words=1")
+            s_hi, s_lo, s_val, s_dev, s_row, ovf, dest = self._step(
+                hi, lo, valid, orig
+            )
         return ShuffleResult(s_hi, s_lo, s_val, s_dev, s_row, ovf, dest)
 
     def sort_global(

@@ -273,8 +273,10 @@ def sort_bam(
     if queryname:
         if mesh is not None or distributed is not None:
             raise ValueError(
-                "sort_order='queryname' is single-host (the collation "
-                "engine's rank pass is not mesh-distributed yet)"
+                "sort_order='queryname' with a mesh goes through "
+                "parallel.multihost.sort_bam_multihost(sort_order="
+                "'queryname') — its distributed rank pass replaces "
+                "this driver's single-host collation"
             )
         if mark_duplicates:
             raise ValueError(

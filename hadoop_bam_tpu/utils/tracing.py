@@ -950,6 +950,13 @@ def run_manifest(
     depth_g = METRICS.gauges().get("pipeline.read_depth")
     if depth_g:
         modes["read_depth"] = int(depth_g)
+    # The auto-rtt gate's inputs alongside the depth that relaxed it:
+    # ``effective_rtt_ms = read_depth × auto_rtt_ms`` when pipelined
+    # (PR 13), so a round's tier decisions carry their gate provenance.
+    for rtt_key in ("pipeline.auto_rtt_ms", "pipeline.effective_rtt_ms"):
+        rtt_g = METRICS.gauges().get(rtt_key)
+        if rtt_g is not None:
+            modes[rtt_key.split(".", 1)[1]] = float(rtt_g)
     platform = None
     jax = sys.modules.get("jax")
     if jax is not None:
@@ -1039,6 +1046,8 @@ class ClusterManifest:
         records: int = 0,
         shuffle_raw_bytes: int = 0,
         shuffle_ratio: Optional[float] = None,
+        repartition: Optional[dict] = None,
+        speculation: Optional[dict] = None,
     ) -> None:
         self.hosts = hosts
         self.byte_plane = byte_plane
@@ -1051,6 +1060,8 @@ class ClusterManifest:
         self.records = records
         self.shuffle_raw_bytes = shuffle_raw_bytes
         self.shuffle_ratio = shuffle_ratio
+        self.repartition = repartition
+        self.speculation = speculation
 
     def as_dict(self) -> dict:
         return {
@@ -1064,6 +1075,12 @@ class ClusterManifest:
             "shuffle_ratio": self.shuffle_ratio,
             "keys_bytes": self.keys_bytes,
             "records": self.records,
+            "repartition": (
+                dict(self.repartition) if self.repartition else None
+            ),
+            "speculation": (
+                dict(self.speculation) if self.speculation else None
+            ),
             "degraded": self.degraded,
             "reasons": list(self.reasons),
         }
@@ -1133,6 +1150,40 @@ def cluster_manifest(
     )
     records = sum(int(h.get("records_local", 0)) for h in hosts)
     skews = [h["skew_ratio"] for h in hosts if h.get("skew_ratio")]
+    # Skew healing (PR 16): the repartition decision is collective (every
+    # host allgathers the same census and branches identically), so any
+    # non-empty block speaks for the round; speculation blocks differ per
+    # host (the speculator reports launches/wins, the straggler its lost
+    # parts) and fold into one event list + cluster totals.
+    repartition = next(
+        (dict(h["repartition"]) for h in hosts if h.get("repartition")),
+        None,
+    )
+    spec_events: List[dict] = []
+    spec_launched = spec_won = spec_wasted = 0
+    for h in hosts:
+        sp = h.get("speculation") or {}
+        if not sp:
+            continue
+        spec_launched += int(sp.get("launched", 0))
+        spec_won += int(sp.get("won_parts", 0))
+        spec_wasted += int(sp.get("wasted_bytes", 0))
+        if sp.get("launched"):
+            spec_events.append({
+                "by": h.get("host"),
+                "target": sp.get("target"),
+                "won_parts": int(sp.get("won_parts", 0)),
+            })
+    speculation = (
+        {
+            "launched": spec_launched,
+            "won_parts": spec_won,
+            "wasted_bytes": spec_wasted,
+            "events": spec_events,
+        }
+        if (spec_launched or spec_wasted)
+        else None
+    )
     return ClusterManifest(
         hosts=hosts,
         byte_plane=byte_plane
@@ -1146,6 +1197,8 @@ def cluster_manifest(
         records=records,
         shuffle_raw_bytes=shuffle_raw_bytes,
         shuffle_ratio=shuffle_ratio,
+        repartition=repartition,
+        speculation=speculation,
     )
 
 

@@ -1,0 +1,496 @@
+"""Skew-healing mesh sort (ISSUE 16): distributed collation ranks,
+adaptive range repartition, speculative stage re-execution.
+
+Coverage layers:
+
+- **wide key-plane lint**: the queryname shuffle's 29-byte exchange row
+  recomputed from the dtypes that actually cross ``lax.all_to_all`` —
+  the two-word twin of the coordinate plane's ``KEY_ROW_BYTES`` lint;
+- **reservoir splitters unit**: the repartition refresh cuts balanced
+  quantiles out of a zipfian key pool (the exact splitters the rescue
+  path pins as jit constants);
+- **in-process mesh runs** (8 virtual devices): queryname and fixmate
+  over the mesh byte-identical to the single-host pipeline oracles
+  (the distributed rank pass is collision-immune by construction — it
+  ranks actual name bytes); a zipfian corpus under a deliberately
+  starved in-shuffle election triggers EXACTLY one adaptive
+  repartition whose refreshed cuts measurably heal the skew
+  (``ratio_after < ratio_before``), folded into the ClusterManifest
+  and rendered by tools/mesh_report.py;
+- the **2-process spawned drill**: ``exec.delay`` makes host 1 a real
+  straggler at the parts stage; host 0 speculatively re-executes the
+  stage from the byte-plane locators and wins the first-wins promotion
+  race (output byte-identical, the straggler's late copies discarded
+  as ``mh.speculate.wasted_bytes``); then ``mh.speculate.lose`` stalls
+  the speculative copy just before promotion so it loses the same race
+  cleanly — the straggler keeps every part and the waste lands on the
+  speculator.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+from bench import synth_bam  # noqa: E402
+
+
+def _load_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh_report_mod():
+    return _load_module(REPO / "tools" / "mesh_report.py", "mesh_skew_mr")
+
+
+@pytest.fixture(scope="module")
+def bam_paired(tmp_path_factory):
+    """Pairable corpus: consecutive rows share a name with FIRST/SECOND
+    flags — the queryname rank pass and fixmate both group by it."""
+    p = str(tmp_path_factory.mktemp("mesh_skew") / "paired.bam")
+    synth_bam(p, 4_000, paired=True)
+    return p
+
+
+def _synth_zipf_bam(path: str, n: int) -> None:
+    """``synth_bam`` with zipfian positions: ``pos = L * u**6`` piles
+    half the mass into ~1.6% of the coordinate range (single refid).
+    Equally-spaced order statistics still cut it fine — the drill
+    starves the election (``samples_per_device=2``) so the *sample*, not
+    the distribution, is what fails, exactly the pathology the key
+    reservoir heals."""
+    import struct as _struct
+
+    synth_bam(path, n)
+    # Rewrite refid/pos/bin in the decompressed stream, then recompress:
+    # cheaper than re-deriving the whole builder here.
+    from hadoop_bam_tpu import native
+    from hadoop_bam_tpu.spec import bgzf
+    import bench as _bench
+
+    raw = bytearray(native.decompress_all(open(path, "rb").read()).tobytes())
+    l_text = _struct.unpack_from("<I", raw, 4)[0]
+    pos0 = 8 + l_text
+    n_ref = _struct.unpack_from("<I", raw, pos0)[0]
+    pos0 += 4
+    for _ in range(n_ref):
+        l_name = _struct.unpack_from("<I", raw, pos0)[0]
+        pos0 += 4 + l_name + 4
+    rng = np.random.default_rng(11)
+    zpos = (190_000_000 * rng.random(n) ** 6).astype(np.int64)
+    p, i = pos0, 0
+    while p < len(raw):
+        sz = _struct.unpack_from("<I", raw, p)[0]
+        _struct.pack_into("<i", raw, p + 4, 0)  # refid
+        _struct.pack_into("<i", raw, p + 8, int(zpos[i]))
+        b = int(
+            _bench._reg2bin_np(zpos[i : i + 1], zpos[i : i + 1] + 100)[0]
+        )
+        _struct.pack_into("<H", raw, p + 14, b)
+        p += 4 + sz
+        i += 1
+    assert i == n
+    hdr = raw[:pos0]
+    import io
+
+    with open(path, "wb") as f:
+        buf = io.BytesIO()
+        w = bgzf.BgzfWriter(buf, level=1, append_terminator=False)
+        w.write(bytes(hdr))
+        w.close()
+        f.write(buf.getvalue())
+        f.write(native.deflate_blocks(np.frombuffer(bytes(raw[pos0:]), np.uint8), level=1))
+        f.write(bgzf.TERMINATOR)
+
+
+def _counters():
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    return dict(METRICS.report()["counters"])
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _decompressed(bam_path: str) -> bytes:
+    from hadoop_bam_tpu import native
+
+    return native.decompress_all(open(bam_path, "rb").read()).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Key-plane lint: the wide (queryname) exchange row.
+# ---------------------------------------------------------------------------
+
+
+def test_wide_key_row_bytes_matches_exchange_dtypes(monkeypatch):
+    """The 29-byte queryname exchange row recomputed from the dtypes
+    that ACTUALLY cross ``lax.all_to_all``: the second key word adds two
+    buffers (int32 + uint32) on top of the narrow plane's six, and
+    ``ds.key_row_bytes`` — the instance-level constant the byte matrix
+    accounts with — must equal their sum."""
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.parallel import shuffle as sh
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    recorded = []
+    orig = jax.lax.all_to_all
+
+    def spy(x, *a, **k):
+        recorded.append(x.dtype)
+        return orig(x, *a, **k)
+
+    monkeypatch.setattr(jax.lax, "all_to_all", spy)
+    mesh = make_mesh()
+    ds = sh.DistributedSort(
+        mesh, rows_per_device=4, samples_per_device=4, key_words=2
+    )
+    n = mesh.devices.size * 4
+    shd = ds.sharding()
+    ds(
+        jax.device_put(jnp.zeros(n, jnp.int32), shd),
+        jax.device_put(jnp.zeros(n, jnp.uint32), shd),
+        jax.device_put(jnp.ones(n, bool), shd),
+        hi2=jax.device_put(jnp.zeros(n, jnp.int32), shd),
+        lo2=jax.device_put(jnp.zeros(n, jnp.uint32), shd),
+    )
+    assert len(recorded) == 8, recorded
+    assert sum(d.itemsize for d in recorded) == ds.key_row_bytes == 29
+    # The narrow instance still accounts with the module constant.
+    narrow = sh.DistributedSort(mesh, rows_per_device=4)
+    assert narrow.key_row_bytes == sh.KEY_ROW_BYTES == 21
+
+
+def test_reservoir_splitters_balance_zipf_pool():
+    """The rescue path's splitters are the balanced quantiles of the
+    allgathered reservoir: on a zipfian pool every inter-cut slab holds
+    ~|pool|/D keys (exact to reservoir granularity)."""
+    from hadoop_bam_tpu.ops.keys import split_keys_np
+    from hadoop_bam_tpu.parallel import multihost
+
+    ctx = multihost.initialize()
+    rng = np.random.default_rng(3)
+    keys = (190_000_000 * rng.random(20_000) ** 6).astype(np.int64)
+    sp, n_pool = multihost._reservoir_splitters(ctx, keys, 4096, 8, rng)
+    assert sp is not None and n_pool == 4096
+    sp_hi, sp_lo = sp
+    assert len(sp_hi) == len(sp_lo) == 7
+    # Route the FULL key set through the elected cuts (the same
+    # ">= splitter counts up" rule the device plane applies).
+    k_hi, k_lo = split_keys_np(keys)
+    ge = (k_hi[:, None] > sp_hi[None, :]) | (
+        (k_hi[:, None] == sp_hi[None, :]) & (k_lo[:, None] >= sp_lo[None, :])
+    )
+    dest = ge.sum(axis=1)
+    counts = np.bincount(dest, minlength=8)
+    assert counts.max() / counts.mean() < 1.25, counts
+
+
+def test_queryname_rejects_memory_budget():
+    from hadoop_bam_tpu.parallel import multihost
+
+    ctx = multihost.initialize()
+    with pytest.raises(ValueError, match="in-core"):
+        multihost.sort_bam_multihost(
+            ["x.bam"], "y.bam", ctx=ctx, memory_budget=1 << 20,
+            sort_order="queryname",
+        )
+
+
+# ---------------------------------------------------------------------------
+# In-process mesh: queryname + fixmate byte identity vs the single-host
+# pipeline oracles.
+# ---------------------------------------------------------------------------
+
+
+def test_queryname_mesh_matches_pipeline_oracle(bam_paired, tmp_path):
+    """``sort_bam_multihost(sort_order='queryname')`` through the
+    distributed rank pass is byte-identical (decompressed) to the
+    single-host ``pipeline.sort_bam`` queryname path, and stamps
+    ``SO:queryname``."""
+    from hadoop_bam_tpu import pipeline
+    from hadoop_bam_tpu.parallel import multihost
+
+    oracle = str(tmp_path / "qn_oracle.bam")
+    out = str(tmp_path / "qn_mesh.bam")
+    pipeline.sort_bam(
+        [bam_paired], oracle, sort_order="queryname",
+        split_size=1 << 16, level=1,
+    )
+    ctx = multihost.initialize()
+    before = _counters()
+    n = multihost.sort_bam_multihost(
+        [bam_paired], out, ctx=ctx, split_size=1 << 16, level=1,
+        sort_order="queryname",
+    )
+    after = _counters()
+    assert n == 4_000
+    got = _decompressed(out)
+    assert got == _decompressed(oracle)
+    assert b"SO:queryname" in got[: 4 << 10]
+    # One rank per distinct name crossed the rank pass (paired corpus:
+    # two records share each name).
+    assert _delta(before, after, "mh.rank.names") == 2_000
+
+
+def test_fixmate_mesh_matches_pipeline_oracle(bam_paired, tmp_path):
+    """``fixmate_bam_multihost`` — collate + rank + cross-host mate
+    exchange — is byte-identical to the single-host
+    ``pipeline.fixmate_bam`` and reports the same pair census."""
+    from hadoop_bam_tpu import pipeline
+    from hadoop_bam_tpu.parallel import multihost
+
+    oracle = str(tmp_path / "fm_oracle.bam")
+    out = str(tmp_path / "fm_mesh.bam")
+    st1 = pipeline.fixmate_bam(
+        [bam_paired], oracle, split_size=1 << 16, level=1
+    )
+    ctx = multihost.initialize()
+    st2 = multihost.fixmate_bam_multihost(
+        [bam_paired], out, ctx=ctx, split_size=1 << 16, level=1
+    )
+    assert _decompressed(out) == _decompressed(oracle)
+    assert (st2.n_pairs, st2.n_singletons, st2.n_orphans) == (
+        st1.n_pairs, st1.n_singletons, st1.n_orphans,
+    )
+    assert st2.backend == "collate-fixmate[mesh]"
+
+
+# ---------------------------------------------------------------------------
+# Adaptive range repartition: the zipfian drill.
+# ---------------------------------------------------------------------------
+
+
+def test_zipf_repartition_heals_skew(tmp_path, mesh_report_mod):
+    """A zipfian corpus under a starved election (2 samples/device)
+    routes skewed; the rescue loop refreshes the partitioner from the
+    key reservoir EXACTLY once, and the refreshed cuts measurably heal
+    the round: ``ratio_after < ratio_before``.  The repartition block
+    rides the ClusterManifest and the report renders it."""
+    from hadoop_bam_tpu.parallel import multihost
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    src = str(tmp_path / "zipf.bam")
+    _synth_zipf_bam(src, 6_000)
+    out = str(tmp_path / "zipf_sorted.bam")
+    trace_dir = str(tmp_path / "zipf-trace")
+    ctx = multihost.initialize()
+    before = _counters()
+    n = multihost.sort_bam_multihost(
+        [src], out, ctx=ctx, split_size=1 << 16, level=1,
+        samples_per_device=2, mesh_trace=True, mesh_trace_dir=trace_dir,
+    )
+    after = _counters()
+    assert n == 6_000
+    assert _delta(before, after, "mh.repartition.triggered") == 1
+    assert _delta(before, after, "mh.repartition.sample_keys") > 0
+    # Interplay rule: one rescue of each kind per round, and here the
+    # repartition alone healed the round — no capacity bump compounded.
+    assert _delta(before, after, "mh.shuffle.capacity_retry") == 0
+    g = METRICS.gauges()
+    rb = g.get("mh.repartition.ratio_before")
+    ra = g.get("mh.repartition.ratio_after")
+    assert rb is not None and ra is not None
+    assert rb > 1.5  # it really was skewed past the bound
+    assert ra < rb  # and the refresh really healed it
+    # Output correctness is not negotiable under the rescue path.
+    from hadoop_bam_tpu import pipeline
+
+    oracle = str(tmp_path / "zipf_oracle.bam")
+    pipeline.sort_bam([src], oracle, split_size=1 << 16, level=1)
+    assert _decompressed(out) == _decompressed(oracle)
+    # Manifest fold + report rendering.
+    rep = mesh_report_mod.mesh_report(trace_dir)
+    repart = (rep["cluster_manifest"] or {}).get("repartition")
+    assert repart and repart["triggered"] == 1
+    assert repart["ratio_after"] < repart["ratio_before"]
+    assert repart["sample_keys"] == _delta(
+        before, after, "mh.repartition.sample_keys"
+    )
+    text = mesh_report_mod.format_report(rep)
+    assert "skew healing" in text
+    assert "repartition" in text
+
+
+# ---------------------------------------------------------------------------
+# Speculative re-execution: the 2-process straggler drills.
+# ---------------------------------------------------------------------------
+
+
+_SPEC_WORKER = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+src = sys.argv[4]; outdir = sys.argv[5]; zipf_src = sys.argv[6]
+sys.path.insert(0, {repo!r})
+from hadoop_bam_tpu import faults
+from hadoop_bam_tpu.conf import Configuration, MESH_SPECULATE_FACTOR
+from hadoop_bam_tpu.parallel import multihost
+from hadoop_bam_tpu.utils.tracing import METRICS
+ctx = multihost.initialize(f"127.0.0.1:{{port}}", num_processes=nproc,
+                           process_id=pid)
+conf = Configuration({{MESH_SPECULATE_FACTOR: "3.0"}})
+kw = dict(ctx=ctx, conf=conf, split_size=1 << 16, level=1)
+
+def counters():
+    return dict(METRICS.report()["counters"])
+
+def run(tag, plan=None, paths=None, **extra):
+    faults.ACTIVE = faults.FaultPlan.parse(plan) if plan else None
+    c0 = counters()
+    n = multihost.sort_bam_multihost(
+        paths or [src], os.path.join(outdir, tag + ".bam"), **kw, **extra)
+    faults.ACTIVE = None
+    c1 = counters()
+    d = {{k: c1.get(k, 0) - c0.get(k, 0) for k in
+         ("mh.speculate.launched", "mh.speculate.won",
+          "mh.speculate.wasted_bytes", "mh.repartition.triggered")}}
+    d["n"] = n
+    d.update({{k: v for k, v in METRICS.gauges().items()
+              if k.startswith("mh.repartition.")}})
+    print("LEG " + tag + " pid=%d " % pid + json.dumps(d), flush=True)
+
+# Queryname over two real hosts: the distributed rank pass end to end.
+run("qn", sort_order="queryname")
+# Zipfian corpus + starved election: exactly one adaptive repartition.
+run("zipf", paths=[zipf_src], samples_per_device=2)
+# Win: host 1 drags its parts stage (1.5 s per part); host 0 finishes,
+# speculates host 1's stage from the byte-plane locators and wins the
+# first-wins promotion race for at least one part.
+run("win", plan="seed=3;exec.delay:items=1,attempts=1000-1999,ms=1500,n=*")
+# Lose: same straggler, but the speculative copy stalls 4 s just before
+# each promotion — the original wins every race and the speculative
+# bytes are discarded cleanly on the SPECULATOR's side.
+run("lose", plan="seed=3;exec.delay:items=1,attempts=1000-1999,ms=700,n=*;"
+                 "mh.speculate.lose:ms=4000,n=*")
+print(f"SPEC_DRILL_OK pid={{pid}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_skew_healing_drills(bam_paired, tmp_path):
+    """Two spawned hosts, four legs on one mesh.  Leg "qn": the
+    distributed rank pass over two real processes, byte-identical to
+    the single-host queryname oracle.  Leg "zipf": the zipfian corpus
+    under a starved election triggers exactly one repartition on both
+    hosts with ``ratio_after < ratio_before``.  Leg "win": host 1
+    straggles (``exec.delay``), host 0 speculatively re-executes its
+    parts stage and wins ≥1 promotion; the straggler's late copies are
+    the waste.  Leg "lose": ``mh.speculate.lose`` stalls the
+    speculative copy before promotion so the straggler keeps every part
+    and the waste lands on the speculator.  Every output byte-identical
+    to its undelayed single-host oracle."""
+    src = bam_paired
+    outdir = str(tmp_path)
+    zipf_src = str(tmp_path / "zipf2p.bam")
+    _synth_zipf_bam(zipf_src, 4_000)
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HBAM_SHUFFLE_HOST"] = "127.0.0.1"
+    worker = _SPEC_WORKER.format(repo=str(REPO))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid), "2", str(port),
+             src, outdir, zipf_src],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid}:\n{o[-3000:]}"
+        assert f"SPEC_DRILL_OK pid={pid}" in o, o[-2000:]
+
+    def leg(tag, pid):
+        m = re.search(
+            rf"LEG {tag} pid={pid} (\{{.*\}})", outs[pid]
+        )
+        assert m, f"missing LEG {tag} line for pid {pid}:\n{outs[pid][-2000:]}"
+        return json.loads(m.group(1))
+
+    from hadoop_bam_tpu import pipeline
+
+    # Queryname leg: two real hosts, rank pass end to end.
+    assert leg("qn", 0)["n"] == leg("qn", 1)["n"] == 4_000
+    qn_oracle = str(tmp_path / "qn2p_oracle.bam")
+    pipeline.sort_bam(
+        [src], qn_oracle, sort_order="queryname",
+        split_size=1 << 16, level=1,
+    )
+    assert _decompressed(os.path.join(outdir, "qn.bam")) == _decompressed(
+        qn_oracle
+    )
+
+    # Zipf leg: exactly one repartition, agreed on by both hosts (the
+    # census is allgathered — the decision is collective), measurably
+    # healing the routing.
+    for pid in range(2):
+        z = leg("zipf", pid)
+        assert z["mh.repartition.triggered"] == 1, z
+        assert z["mh.repartition.ratio_before"] > 1.5, z
+        assert (
+            z["mh.repartition.ratio_after"]
+            < z["mh.repartition.ratio_before"]
+        ), z
+    zipf_oracle = str(tmp_path / "zipf2p_oracle.bam")
+    pipeline.sort_bam([zipf_src], zipf_oracle, split_size=1 << 16, level=1)
+    assert _decompressed(os.path.join(outdir, "zipf.bam")) == _decompressed(
+        zipf_oracle
+    )
+
+    # Win leg: the speculator (host 0) launched once and won parts; the
+    # straggler (host 1) paid the wasted bytes for its late copies.
+    win0, win1 = leg("win", 0), leg("win", 1)
+    assert win0["mh.speculate.launched"] == 1
+    assert win0["mh.speculate.won"] >= 1
+    assert win1["mh.speculate.wasted_bytes"] > 0
+    assert win1["mh.speculate.launched"] == 0
+    # Lose leg: speculation launched but every promotion race lost —
+    # the waste lands on the SPECULATOR, the straggler keeps its parts.
+    lose0, lose1 = leg("lose", 0), leg("lose", 1)
+    assert lose0["mh.speculate.launched"] == 1
+    assert lose0["mh.speculate.won"] == 0
+    assert lose0["mh.speculate.wasted_bytes"] > 0
+    assert lose1["mh.speculate.wasted_bytes"] == 0
+
+    # First-finisher-wins is invisible in the bytes: both legs match the
+    # undelayed single-process oracle exactly.
+    oracle = str(tmp_path / "spec_oracle.bam")
+    pipeline.sort_bam([src], oracle, split_size=1 << 16, level=1)
+    ref = _decompressed(oracle)
+    assert _decompressed(os.path.join(outdir, "win.bam")) == ref
+    assert _decompressed(os.path.join(outdir, "lose.bam")) == ref

@@ -577,3 +577,28 @@ def test_metric_names_are_dotted_lowercase():
             if not pat.match(name):
                 bad.append(f"{f.relative_to(REPO)}: {m.group(2)!r}")
     assert not bad, "non-conforming metric names:\n" + "\n".join(bad)
+
+
+def test_skew_healing_metric_literals_present():
+    """The skew-healing namespaces exist as literals in the package —
+    renaming ``mh.repartition.*`` / ``mh.speculate.*`` without updating
+    their drills (tests/test_mesh_skew.py reads these exact names)
+    fails here, next to the lint that checks their shape."""
+    names = set()
+    for f in sorted((REPO / "hadoop_bam_tpu").rglob("*.py")):
+        for m in _NAME_CALL.finditer(f.read_text()):
+            names.add(m.group(2))
+    for want in (
+        "mh.rank.names",
+        "mh.repartition.triggered",
+        "mh.repartition.sample_keys",
+        "mh.repartition.ratio_before",
+        "mh.repartition.ratio_after",
+        "mh.speculate.launched",
+        "mh.speculate.won",
+        "mh.speculate.wasted_bytes",
+        "mh.speculate.fetch_bytes",
+        "pipeline.auto_rtt_ms",
+        "pipeline.effective_rtt_ms",
+    ):
+        assert want in names, f"metric literal {want!r} missing"

@@ -397,6 +397,9 @@ def _fmt_matrix(
 
 def format_report(rep: dict) -> str:
     lines: List[str] = []
+    cm_early = rep.get("cluster_manifest") or {}
+    spec = cm_early.get("speculation") or {}
+    repart = cm_early.get("repartition") or {}
     st = rep.get("straggler_table")
     if st:
         lines.append(
@@ -444,6 +447,16 @@ def format_report(rep: dict) -> str:
             f"straggler overhead {st['straggler_overhead_pct']:.2f}% of "
             "cluster host-time"
         )
+        # Blame annotation: if a speculative copy won the straggler's
+        # parts stage, the table should say so next to the blame.
+        for ev in spec.get("events", []):
+            if ev.get("target") == s["host"] and ev.get("won_parts"):
+                lines.append(
+                    f"  healed: host {ev['by']} speculatively re-executed "
+                    f"host {ev['target']}'s parts stage and won "
+                    f"{ev['won_parts']} part(s) — the round did not wait "
+                    "for the straggler's writes"
+                )
     mx = rep.get("matrix")
     if mx:
         lines.append("")
@@ -498,6 +511,28 @@ def format_report(rep: dict) -> str:
                     for h in cm.get("hosts", [])
                 )
                 + ")"
+            )
+    if repart or spec:
+        lines.append("")
+        lines.append("skew healing:")
+        if repart:
+            lines.append(
+                "  repartition: triggered once from a "
+                f"{int(repart.get('sample_keys', 0)):,}-key reservoir; "
+                f"post-route skew {repart.get('ratio_before')}x -> "
+                f"{repart.get('ratio_after')}x"
+            )
+        for ev in spec.get("events", []):
+            lines.append(
+                f"  speculation: host {ev.get('by')} re-executed host "
+                f"{ev.get('target')}'s parts stage, won "
+                f"{int(ev.get('won_parts', 0))} part(s)"
+            )
+        if spec:
+            lines.append(
+                "  speculation waste: "
+                f"{int(spec.get('wasted_bytes', 0)):,} B of losing part "
+                "writes discarded by the generation tag"
             )
     if rep.get("dropped_events"):
         lines.append(
