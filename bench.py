@@ -414,6 +414,17 @@ def _measure(platform: str) -> dict:
         out.update(_robustness_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["robustness_bench_error"] = str(e)[:120]
+    # CRAM on the lanes (both platforms): the archive format's decode
+    # pace next to the BAM numbers — marginal rANS decode MB/s through
+    # the tier the round actually runs on, sort records/s over a CRAM
+    # twin of the corpus (byte-identity gated against the BAM twin's
+    # sorted output), the input-size ratio the format buys, and the
+    # lanes-tier hit rate when armed.  Same round provenance as every
+    # other number: a degraded round never updates a headline.
+    try:
+        out.update(_cram_bench(tmp, platform))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["cram_bench_error"] = str(e)[:120]
     return out
 
 
@@ -1031,6 +1042,132 @@ def _codec_tier_hit_rates(n_members: int = 8) -> dict:
         }
     )
     return res
+
+
+def _cram_bench(tmp: str, platform: str) -> dict:
+    """The CRAM leg: decode pace, sort pace, and size ratio of the
+    archive format vs its BAM twin.
+
+    ``cram_rans_MBps`` is a marginal two-point fit (decode 4 then 16
+    full slices, slope of bytes over time — fixed launch/dispatch cost
+    cancels, same protocol as the DEFLATE probes) through the tier the
+    round runs on: the Pallas lanes kernel on a TPU round, the NumPy
+    lockstep host tier on a CPU round (``cram_rans_tier`` records
+    which).  ``cram_sort_records_per_sec`` times ``sort_bam`` over a
+    rANS-coded CRAM twin of a synthetic corpus and is *gated* on the
+    output being byte-identical to the sorted BAM twin — a wrong-bytes
+    round raises into ``cram_bench_error`` instead of reporting a pace.
+    On armed rounds ``cram_rans_tier_hit_rate`` is the counter-delta
+    fraction of slices the lanes tier took (per-slice tier-downs land
+    in the denominator, so silent erosion of device coverage shows up
+    here before it shows up in the pace)."""
+    from hadoop_bam_tpu.ops.pallas.deflate_lanes import _bam_like_corpus
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.spec import bam as _bam
+    from hadoop_bam_tpu.spec import cram as _cram
+    from hadoop_bam_tpu.spec import cram_codecs as _cc
+    from hadoop_bam_tpu.utils.tracing import METRICS
+
+    use_lanes = platform == "tpu"
+    out = {"cram_rans_tier": "lanes" if use_lanes else "host"}
+
+    # Marginal decode MB/s, same two-point protocol as the DEFLATE
+    # probes: fixed lane count, two live slice lengths — both tiers are
+    # lockstep (wall tracks the wave count, i.e. the max slice size,
+    # not the batch width), so the slope over decoded bytes is the
+    # engine pace with launch/dispatch cost cancelled.  Order-0 slices
+    # of a BAM-like corpus: a single frequency table, so the lanes tier
+    # never context-caps — the probe measures pace, not tier mix.
+    # The host fallback tier is wave-serial on one core — probe it at
+    # half scale so CPU rounds (and the backend-guard bench child) pay
+    # seconds, not half a minute; the slope protocol is scale-free.
+    if use_lanes:
+        n_lanes, b_small, b_big = 16, 32 << 10, 64 << 10
+    else:
+        n_lanes, b_small, b_big = 8, 16 << 10, 32 << 10
+    data = _bam_like_corpus(1, n_lanes * b_big).tobytes()
+
+    def _slices(sz: int):
+        return [data[i * sz : (i + 1) * sz] for i in range(n_lanes)]
+
+    def _decode(sz: int) -> float:
+        raws = _slices(sz)
+        encs = [_cc.rans_encode(s, order=0) for s in raws]
+        if use_lanes:
+            from hadoop_bam_tpu.ops.pallas import rans_lanes as _rl
+
+            run = lambda: _rl.rans_lanes(encs, interpret=False)[0]
+        else:
+            run = lambda: _cc.rans_decode_batch(encs)
+        assert run() == raws, "cram rans decode wrong"  # warm + gate
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t1, t2 = _decode(b_small), _decode(b_big)
+    if t2 > t1:
+        out["cram_rans_MBps"] = round(
+            n_lanes * (b_big - b_small) / (t2 - t1) / 1e6, 1
+        )
+
+    # Sort pace over a CRAM twin, gated on byte-identity with the BAM
+    # twin's sorted output.  TPU rounds arm the lanes tier for the CRAM
+    # leg (env gate, restored after) and report its slice hit rate.
+    # Default twin size tracks the round's corpus (the CRAM writer is a
+    # pure-Python series encoder — at full scale it would dominate the
+    # leg's wall without measuring anything).
+    n = int(
+        os.environ.get(
+            "HBAM_BENCH_CRAM_RECORDS",
+            str(min(20000, max(2000, N_RECORDS // 10))),
+        )
+    )
+    src = os.path.join(tmp, "cram_twin.bam")
+    synth_bam(src, n)
+    hdr, recs = _bam.read_bam(src)
+    pc = os.path.join(tmp, "bench.cram")
+    with open(pc, "wb") as f:
+        _cram.write_cram(
+            f, hdr, recs, records_per_container=4096, codec="rans"
+        )
+    out["cram_vs_bam_input_ratio"] = round(
+        os.path.getsize(pc) / os.path.getsize(src), 4
+    )
+    ob = os.path.join(tmp, "cram_twin_sorted.bam")
+    oc = os.path.join(tmp, "cram_sorted.bam")
+    sort_bam(src, ob, split_size=SPLIT_SIZE)
+    prev = os.environ.get("HBAM_RANS_LANES")
+    try:
+        if use_lanes:
+            os.environ["HBAM_RANS_LANES"] = "1"
+        before = dict(METRICS._counters)
+        t0 = time.perf_counter()
+        sort_bam(pc, oc, split_size=SPLIT_SIZE)
+        dt = time.perf_counter() - t0
+        after = dict(METRICS._counters)
+    finally:
+        if prev is None:
+            os.environ.pop("HBAM_RANS_LANES", None)
+        else:
+            os.environ["HBAM_RANS_LANES"] = prev
+    with open(ob, "rb") as f1, open(oc, "rb") as f2:
+        assert f1.read() == f2.read(), "cram sort not byte-identical"
+    out["cram_sort_records_per_sec"] = round(n / dt, 1)
+    if use_lanes:
+        lanes = after.get("cram.rans.lanes_slices", 0) - before.get(
+            "cram.rans.lanes_slices", 0
+        )
+        host = after.get("cram.rans.host_slices", 0) - before.get(
+            "cram.rans.host_slices", 0
+        )
+        if lanes + host:
+            out["cram_rans_tier_hit_rate"] = round(
+                lanes / (lanes + host), 4
+            )
+    return out
 
 
 def finalize_round(result: dict, want: str, probed, error) -> dict:

@@ -70,6 +70,13 @@ DEFLATE_LANES = "hadoopbam.deflate.lanes"
 # local-latency auto rule (ops.flate.device_write_enabled); parts whose
 # batch lacks residency tier down to the host gather per part.
 WRITE_DEVICE = "hadoopbam.write.device"
+# Lockstep-lane Pallas rANS 4x8 tier (ops/pallas/rans_lanes.py): the
+# device decoder for CRAM's entropy codec, the third codec family beside
+# inflate/deflate.  Same semantics: "true"/"false" force, unset defers to
+# the local-latency auto rule (ops.flate.rans_lanes_tier_enabled); slices
+# that trip a size/VMEM/context/format gate tier down per-slice to the
+# NumPy host decoder and the Python oracle (spec/cram_codecs.py).
+CRAM_RANS_LANES = "hadoopbam.cram.rans-lanes"
 # Split-read pipelining depth (pipeline._read_splits_pipelined /
 # DeviceStream.read_splits): how many splits are in flight at once in the
 # read-ahead pool — split k+1's file read + inflate (h2d upload + device

@@ -110,17 +110,24 @@ class StreamPolicy:
         depth: int,
         auto_rtt_ms: float,
         effective_rtt_ms: float,
+        use_rans_lanes: bool = False,
     ) -> None:
         self.inflate_lanes = inflate_lanes
         self.deflate_lanes = deflate_lanes
         self.device_write = device_write
+        self.use_rans_lanes = use_rans_lanes
         self.depth = depth
         self.auto_rtt_ms = auto_rtt_ms
         self.effective_rtt_ms = effective_rtt_ms
 
     @property
     def armed(self) -> bool:
-        return self.inflate_lanes or self.deflate_lanes or self.device_write
+        return (
+            self.inflate_lanes
+            or self.deflate_lanes
+            or self.device_write
+            or self.use_rans_lanes
+        )
 
     @classmethod
     def resolve(cls, conf=None, depth: Optional[int] = None) -> "StreamPolicy":
@@ -138,6 +145,9 @@ class StreamPolicy:
             depth=d,
             auto_rtt_ms=base,
             effective_rtt_ms=eff,
+            use_rans_lanes=flate.rans_lanes_tier_enabled(
+                conf, max_rtt_ms=eff
+            ),
         )
 
 
@@ -319,6 +329,25 @@ class DeviceStream:
         if return_device:
             return out, offs, None
         return out, offs
+
+    def decompress_cram_blocks(self, blocks, errors: str = "strict"):
+        """Decode a batch of CRAM compressed blocks ``(method, payload,
+        raw_size)`` through the stream's rANS tier policy — the third
+        codec family's seam, behind ``spec.cram.decode_container``.  An
+        armed stream routes rANS 4x8 blocks through the lockstep lanes
+        (per-slice tier-down to the NumPy host decoder, counted under
+        ``cram.rans.*``); a disarmed stream is the plain host batch and
+        moves zero ``device_stream.*`` / ``cram.rans.*`` counters."""
+        from .spec import cram_codecs
+
+        if self.policy.use_rans_lanes:
+            self._count("cram_decodes")
+        return cram_codecs.decompress_batch(
+            blocks,
+            errors=errors,
+            conf=self.conf,
+            use_lanes=self.policy.use_rans_lanes,
+        )
 
     def deflate_stream(
         self, payload, level: int = 1, block_payload: Optional[int] = None

@@ -93,10 +93,28 @@ class AnySamInputFormat:
             self._cram_fmt = CramInputFormat(self.conf)
         return self._cram_fmt
 
-    def read_split(self, split: AnySplit) -> RecordBatch:
+    def read_split(self, split: AnySplit, **kw) -> RecordBatch:
+        """Per-format dispatch with the DeviceStream read-drive kwargs
+        (``fields``/``with_keys``/``errors``/``stream``/...) passed
+        through, so an AnySam format drops into
+        ``DeviceStream.read_splits`` exactly like a BamInputFormat —
+        the seam that lets ``pipeline.sort_bam`` take ``.cram`` input."""
         if isinstance(split, FileVirtualSplit):
-            return self._bam.read_split(split)
+            return self._bam.read_split(split, **kw)
         fmt = self.get_format(split.path)
         if fmt == "sam":
-            return self._sam.read_split(split)
-        return self._cram().read_split(split)
+            # The text reader has no codec tiers or projection.
+            return self._sam.read_split(split, data=kw.get("data"))
+        return self._cram().read_split(split, **kw)
+
+    def read_header(self, path: str):
+        """Header via the per-format reader (BAM/SAM via
+        ``io.bam.read_header``'s sniffing twin, CRAM via the file-header
+        container)."""
+        if self.get_format(path) == "cram":
+            from .cram import read_cram_header
+
+            return read_cram_header(path)
+        from .bam import read_header
+
+        return read_header(path)

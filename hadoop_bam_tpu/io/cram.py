@@ -120,16 +120,37 @@ class CramInputFormat:
             if split.start <= c.offset < split.end
         )
 
-    def read_split(self, split: ByteSplit, data: Optional[bytes] = None):
+    def read_split(
+        self,
+        split: ByteSplit,
+        data: Optional[bytes] = None,
+        with_keys: bool = True,
+        threads: Optional[int] = None,
+        fields: Optional[object] = None,
+        device_inflate: Optional[bool] = None,
+        inflate_fn=None,
+        errors: Optional[str] = None,
+        stream=None,
+    ):
         """Decode every record of the split's containers into the standard
         RecordBatch (same device pipeline as BAM/SAM).
 
         Without a preloaded buffer the read is split-local: the CRAM major
         version comes from the 26-byte file definition and only the
         split's own container-aligned byte window is fetched — a split
-        costs O(split), not O(file)."""
+        costs O(split), not O(file).
+
+        ``stream`` (a DeviceStream) routes block decompression through
+        its rANS-lanes tier policy; ``errors="salvage"`` quarantines
+        undecodable slices instead of raising.  The BAM-signature kwargs
+        (``fields``/``with_keys``/``threads``/``device_inflate``/
+        ``inflate_fn``) are accepted so this reader drops into
+        ``DeviceStream.read_splits`` unchanged; CRAM decode always
+        reconstructs full records, so they are no-ops here."""
+        del with_keys, threads, fields, device_inflate, inflate_fn
         from .sam import _records_to_batch
 
+        errors = errors or "strict"
         ref = self._ref_getter()
         records: List[bam.BamRecord] = []
         if data is None:
@@ -142,7 +163,10 @@ class CramInputFormat:
             while pos < len(window):
                 ch = cram.parse_container_header(window, pos, major)
                 records.extend(
-                    cram.decode_container(window, ch, major, ref)
+                    cram.decode_container(
+                        window, ch, major, ref,
+                        stream=stream, errors=errors,
+                    )
                 )
                 pos = ch.next_offset
             return _records_to_batch(records)
@@ -150,7 +174,11 @@ class CramInputFormat:
         for ch in cram.iter_containers(data):
             if ch.offset < split.start or ch.offset >= split.end:
                 continue
-            records.extend(cram.decode_container(data, ch, major, ref))
+            records.extend(
+                cram.decode_container(
+                    data, ch, major, ref, stream=stream, errors=errors
+                )
+            )
         return _records_to_batch(records)
 
     def read_header(self, path: str) -> bam.BamHeader:

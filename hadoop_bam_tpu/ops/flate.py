@@ -1192,6 +1192,37 @@ def deflate_lanes_tier_enabled(
     )
 
 
+def rans_lanes_tier_enabled(
+    conf=None, max_rtt_ms: Optional[float] = None
+) -> bool:
+    """Should CRAM rANS 4x8 decode route through the lockstep-lane
+    Pallas tier (ops/pallas/rans_lanes.py)?
+
+    The third codec family's gate, same shape as
+    :func:`lanes_tier_enabled`: resolution order is the
+    ``HBAM_RANS_LANES`` env var (0/1 force) → the
+    ``hadoopbam.cram.rans-lanes`` conf key → the shared local-latency
+    auto rule (``utils.backend.local_tpu_ready`` under
+    :func:`device_auto_rtt_ms`, with the same pipelined-mode
+    ``max_rtt_ms`` relaxation).  Slices the device tier declines or
+    flags tier down per-slice — never per-launch — to the NumPy host
+    decoder and the Python oracle in ``spec.cram_codecs``.
+    """
+    env = os.environ.get("HBAM_RANS_LANES")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    if conf is not None:
+        from ..conf import CRAM_RANS_LANES
+
+        if CRAM_RANS_LANES in conf:
+            return conf.get_boolean(CRAM_RANS_LANES)
+    from ..utils.backend import local_tpu_ready
+
+    return local_tpu_ready(
+        max_rtt_ms if max_rtt_ms is not None else device_auto_rtt_ms(conf)
+    )
+
+
 def device_write_enabled(
     conf=None, max_rtt_ms: Optional[float] = None
 ) -> bool:

@@ -68,6 +68,27 @@ from .io.bam import (
 from .io.merger import merge_bam_parts
 from .ops.sort import sort_keys
 from .parallel.executor import ElasticExecutor, bgzf_part_valid
+
+
+def _input_format(conf, in_paths):
+    """BamInputFormat for all-``.bam`` inputs (the hot default path,
+    unchanged), the AnySam dispatcher when any input is CRAM/SAM — the
+    front door that lets ``sort_bam`` and fixmate take ``.cram`` input
+    through the same DeviceStream read drive (CRAM block decode rides
+    the stream's rANS-lanes tier policy)."""
+    from .io.anysam import AnySamInputFormat, infer_from_file_path
+
+    if all(infer_from_file_path(p) == "bam" for p in in_paths):
+        return BamInputFormat(conf)
+    return AnySamInputFormat(conf)
+
+
+def _read_any_header(fmt, path):
+    """Header via the format's own reader when it has one (the AnySam
+    dispatcher routes CRAM to the file-header container), the BAM/BGZF
+    reader otherwise."""
+    rh = getattr(fmt, "read_header", None)
+    return rh(path) if rh is not None else read_header(path)
 from .parallel.mesh import make_mesh
 from .parallel.shuffle import DistributedSort
 from .spec import bam
@@ -245,7 +266,7 @@ def sort_bam(
         )
     if isinstance(in_paths, str):
         in_paths = [in_paths]
-    fmt = BamInputFormat(conf)
+    fmt = _input_format(conf, in_paths)
     if conf is not None:
         write_splitting_bai = write_splitting_bai or conf.get_boolean(
             BAM_WRITE_SPLITTING_BAI
@@ -299,7 +320,7 @@ def sort_bam(
     if resource_cache is not None:
         header = resource_cache.header(in_paths[0])[0]
     else:
-        header = read_header(in_paths[0])
+        header = _read_any_header(fmt, in_paths[0])
     # The header claims the order actually written (satellite fix: this
     # used to stamp "coordinate" unconditionally on every write path).
     header = header.with_sort_order(sort_order)
@@ -382,7 +403,13 @@ def sort_bam(
             )
     use_device_parse = (
         use_device
-        and all(s.interval_chunks is None for s in splits)
+        # CRAM/SAM ByteSplits have no BGZF chunk plan and no device
+        # inflate residency, so the device-parse chain never applies.
+        and all(
+            getattr(s, "interval_chunks", None) is None
+            and hasattr(s, "vstart")
+            for s in splits
+        )
         and (
             device_parse
             if device_parse is not None
@@ -807,7 +834,7 @@ def fixmate_bam(
         verify_and_repair,
     )
 
-    fmt = BamInputFormat(conf)
+    fmt = _input_format(conf, in_paths)
     if conf is not None:
         write_splitting_bai = write_splitting_bai or conf.get_boolean(
             BAM_WRITE_SPLITTING_BAI
@@ -823,7 +850,7 @@ def fixmate_bam(
     exec_backoff = (
         conf.get_int(EXECUTOR_BACKOFF_MS, 50) if conf else 50
     ) / 1e3
-    header = read_header(in_paths[0])
+    header = _read_any_header(fmt, in_paths[0])
     if memory_budget is not None:
         split_size = max(64 << 10, min(split_size, memory_budget // 16))
     with span("fixmate.plan"):

@@ -202,10 +202,18 @@ class ResourceCache:
         return file_identity(path)
 
     def header(self, path: str):
-        """(BamHeader, first-record virtual offset) for a BAM path."""
+        """(BamHeader, first-record virtual offset) for a BAM path —
+        or a CRAM path, whose header comes from the file-header
+        container (virtual offset 0: CRAM addressing is container-based,
+        not BGZF-virtual)."""
+        from ..io.anysam import infer_from_file_path
         from ..io.bam import read_header_voffset
 
         def load(p: str):
+            if infer_from_file_path(p) == "cram":
+                from ..io.cram import read_cram_header
+
+                return read_cram_header(p), 0
             return read_header_voffset(p)
 
         def size(v) -> int:

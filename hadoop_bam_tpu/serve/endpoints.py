@@ -166,6 +166,59 @@ class ServeContext:
         return inflate
 
 
+def _endpoint_format(ctx: "ServeContext", path: str):
+    """(kind, reader) for an endpoint path: the plain BamInputFormat for
+    ``.bam`` (the hot path, unchanged), the AnySam dispatcher otherwise —
+    its CRAM reader routes block decode through the daemon's DeviceStream
+    rANS-lanes policy."""
+    from ..io.anysam import AnySamInputFormat, infer_from_file_path
+
+    if infer_from_file_path(path) == "bam":
+        from ..io.bam import BamInputFormat
+
+        return "bam", BamInputFormat(ctx.conf)
+    fmt = AnySamInputFormat(ctx.conf)
+    return fmt.get_format(path), fmt
+
+
+def _split_span(s) -> Tuple[int, int]:
+    """The arena-key byte span of a split: BGZF virtual offsets for a
+    BAM FileVirtualSplit, plain byte offsets for a CRAM/SAM ByteSplit."""
+    if hasattr(s, "vstart"):
+        return s.vstart, s.vend
+    return s.start, s.start + s.length
+
+
+def _view_records_scan(
+    ctx: "ServeContext", fmt, path: str, rid: int, beg0: int, end0: int,
+    deadline: Optional[Deadline],
+) -> List[Tuple[object, np.ndarray]]:
+    """The index-free view path for container formats (CRAM has no
+    ``.bai``): every split scans through the arena — warm windows are
+    read-free exactly like the indexed path — and the same overlap cut
+    picks the rows, so the records (and their order) match the BAM twin
+    byte-for-byte."""
+    ident = ctx.cache.identity(path)
+    picks: List[Tuple[object, np.ndarray]] = []
+    for s in fmt.get_splits([path]):
+        if deadline is not None:
+            deadline.check("endpoint")
+        a, b = _split_span(s)
+        key = ("view", ident, a, b)
+        batch = ctx.arena.get(key)
+        if batch is None:
+            with span("serve.view.read"):
+                batch = fmt.read_split(
+                    s, with_keys=False, fields=VIEW_FIELDS,
+                    stream=ctx.stream,
+                )
+            ctx.arena.hold(key, batch)
+        rows = _overlap_rows(batch, rid, beg0, end0)
+        if len(rows):
+            picks.append((batch, rows))
+    return picks
+
+
 def _pow2_rows(n: int) -> int:
     from .warmup import OVERLAP_PAD_MIN, pow2_at_least
 
@@ -245,6 +298,12 @@ def view_records(
         ) from None
     beg0 = iv.start - 1  # 1-based inclusive → 0-based half-open
     end0 = min(iv.end, MAX_END)
+    kind, any_fmt = _endpoint_format(ctx, path)
+    if kind != "bam":
+        picks = _view_records_scan(
+            ctx, any_fmt, path, rid, beg0, end0, deadline
+        )
+        return hdr, picks
     bai = ctx.cache.bai(path)
     chunks = bai.query(rid, beg0, end0)
     if rctx is not None:
@@ -377,15 +436,14 @@ def flagstat(
     with span("serve.flagstat"):
         hdr, _ = ctx.cache.header(path)
         ident = ctx.cache.identity(path)
-        from ..io.bam import BamInputFormat
-
-        fmt = BamInputFormat(ctx.conf)
+        kind, fmt = _endpoint_format(ctx, path)
         counts = {k: 0 for k in FLAGSTAT_KEYS}
         rctx = current_request()
         for s in fmt.get_splits([path]):
             if deadline is not None:
                 deadline.check("endpoint")
-            key = ("flagstat", ident, s.vstart, s.vend)
+            a, b = _split_span(s)
+            key = ("flagstat", ident, a, b)
             batch = ctx.arena.get(key)
             if batch is None:
                 t_read = time.perf_counter()
@@ -393,7 +451,10 @@ def flagstat(
                     s,
                     with_keys=False,
                     fields=FLAGSTAT_FIELDS,
-                    inflate_fn=ctx._inflate_fn(),
+                    inflate_fn=(
+                        ctx._inflate_fn() if kind == "bam" else None
+                    ),
+                    stream=ctx.stream,
                 )
                 ctx.arena.hold(key, batch)
                 if rctx is not None:

@@ -217,6 +217,20 @@ CT_CORE = 5
 
 
 @dataclass
+class BlockFrame:
+    """One block's parsed frame with the payload still compressed — the
+    split that lets ``decode_container`` batch every block of a
+    container through one ``cram_codecs.decompress_batch`` call (the
+    rANS-lanes seam) instead of inflating inline one at a time."""
+
+    method: int
+    content_type: int
+    content_id: int
+    payload: bytes
+    raw_size: int
+
+
+@dataclass
 class Block:
     method: int
     content_type: int
@@ -224,9 +238,9 @@ class Block:
     raw: bytes  # uncompressed payload
 
     @staticmethod
-    def read(data: bytes, pos: int, major: int) -> Tuple["Block", int]:
-        from . import cram_codecs
-
+    def read_frame(
+        data: bytes, pos: int, major: int
+    ) -> Tuple[BlockFrame, int]:
         method = data[pos]
         ctype = data[pos + 1]
         pos += 2
@@ -239,12 +253,25 @@ class Block:
         pos += csize
         if major >= 3:
             pos += 4  # crc32
-        raw = cram_codecs.decompress(method, payload, rsize)
-        if len(raw) != rsize:
+        return BlockFrame(method, ctype, cid, payload, rsize), pos
+
+    @staticmethod
+    def finish(frame: BlockFrame, raw: bytes) -> "Block":
+        if len(raw) != frame.raw_size:
             raise CramError(
-                f"block inflates to {len(raw)}, declared {rsize}"
+                f"block inflates to {len(raw)}, declared {frame.raw_size}"
             )
-        return Block(method, ctype, cid, raw), pos
+        return Block(frame.method, frame.content_type, frame.content_id, raw)
+
+    @staticmethod
+    def read(data: bytes, pos: int, major: int) -> Tuple["Block", int]:
+        from . import cram_codecs
+
+        frame, pos = Block.read_frame(data, pos, major)
+        raw = cram_codecs.decompress(
+            frame.method, frame.payload, frame.raw_size
+        )
+        return Block.finish(frame, raw), pos
 
     def write(self, major: int, method: Optional[int] = None) -> bytes:
         from . import cram_codecs
@@ -859,46 +886,97 @@ def decode_container(
     ch: ContainerHeader,
     major: int,
     ref_getter: Optional[Callable[[int], bytes]] = None,
+    *,
+    stream=None,
+    errors: str = "strict",
 ) -> List[BamRecord]:
-    """All records of one data container."""
+    """All records of one data container.
+
+    Two passes: the frame walk collects every block of the container
+    still-compressed, then one ``decompress_batch`` call inflates them
+    all through the codec seam — via ``stream`` (a
+    :class:`~hadoop_bam_tpu.device_stream.DeviceStream`, whose policy
+    may arm the rANS lockstep lanes) when given, the host batch
+    otherwise.  ``errors="salvage"`` quarantines a slice whose blocks
+    fail to inflate (``cram.slice.quarantined``) instead of killing the
+    container; a salvaged-away compression header quarantines the whole
+    container."""
+    from . import cram_codecs
     from .cram_codecs import DecodeContext
+    from ..utils.tracing import METRICS, span
 
     if ch.is_eof or ch.n_records == 0:
         return []
     pos = ch.offset + ch.header_size
-    comp_block, pos = Block.read(data, pos, major)
+    end = ch.offset + ch.header_size + ch.length
+    frames: List[BlockFrame] = []
+    while pos < end:
+        fr, pos = Block.read_frame(data, pos, major)
+        frames.append(fr)
+    if not frames:
+        return []
+    triples = [(f.method, f.payload, f.raw_size) for f in frames]
+    if stream is not None:
+        raws = stream.decompress_cram_blocks(triples, errors=errors)
+    else:
+        raws = cram_codecs.decompress_batch(triples, errors=errors)
+
+    def _block(i: int) -> Optional[Block]:
+        if raws[i] is None:
+            return None
+        return Block.finish(frames[i], raws[i])
+
+    comp_block = _block(0)
+    if comp_block is None:
+        METRICS.count("cram.container.quarantined", 1)
+        return []
     if comp_block.content_type != CT_COMPRESSION_HEADER:
         raise CramError("expected compression-header block")
     comp = CompressionHeader.parse(comp_block.raw)
-    end = ch.offset + ch.header_size + ch.length
     out: List[BamRecord] = []
-    while pos < end:
-        sh_block, pos = Block.read(data, pos, major)
-        if sh_block.content_type != CT_SLICE_HEADER:
-            raise CramError("expected slice-header block")
-        sh = SliceHeader.parse(sh_block.raw, major)
-        core = b""
-        external: Dict[int, bytes] = {}
-        for _ in range(sh.n_blocks):
-            blk, pos = Block.read(data, pos, major)
-            if blk.content_type == CT_CORE:
-                core = blk.raw
-            elif blk.content_type == CT_EXTERNAL:
-                external[blk.content_id] = blk.raw
-            else:
-                raise CramError(
-                    f"unexpected block type {blk.content_type} in slice"
-                )
-        rg = ref_getter
-        if sh.embedded_ref_id >= 0 and sh.embedded_ref_id in external:
-            # position the embedded block at the slice start, once
-            padded = b"N" * (sh.start - 1) + external[sh.embedded_ref_id]
+    i = 1
+    with span("cram.stage.series", category="stage"):
+        while i < len(frames):
+            if frames[i].content_type != CT_SLICE_HEADER:
+                raise CramError("expected slice-header block")
+            sh_block = _block(i)
+            n_blocks = (
+                SliceHeader.parse(sh_block.raw, major).n_blocks
+                if sh_block is not None
+                else None
+            )
+            if n_blocks is None:
+                # Slice header lost in salvage: its member count is
+                # unknown, so the rest of the container is unwalkable.
+                METRICS.count("cram.slice.quarantined", 1)
+                break
+            sh = SliceHeader.parse(sh_block.raw, major)
+            first, i = i + 1, i + 1 + n_blocks
+            members = [_block(j) for j in range(first, i)]
+            if any(b is None for b in members):
+                METRICS.count("cram.slice.quarantined", 1)
+                continue
+            core = b""
+            external: Dict[int, bytes] = {}
+            for blk in members:
+                if blk.content_type == CT_CORE:
+                    core = blk.raw
+                elif blk.content_type == CT_EXTERNAL:
+                    external[blk.content_id] = blk.raw
+                else:
+                    raise CramError(
+                        f"unexpected block type {blk.content_type} in slice"
+                    )
+            rg = ref_getter
+            if sh.embedded_ref_id >= 0 and sh.embedded_ref_id in external:
+                # position the embedded block at the slice start, once
+                padded = b"N" * (sh.start - 1) + external[sh.embedded_ref_id]
 
-            def rg(_refid, _p=padded):  # noqa: ANN001
-                return _p
+                def rg(_refid, _p=padded):  # noqa: ANN001
+                    return _p
 
-        ctx = DecodeContext(core, external)
-        out.extend(_decode_slice_records(major, comp, sh, ctx, rg))
+            ctx = DecodeContext(core, external)
+            out.extend(_decode_slice_records(major, comp, sh, ctx, rg))
     return out
 
 
@@ -916,6 +994,9 @@ def read_cram_header_text(data: bytes) -> str:
 def read_cram(
     path_or_bytes,
     ref_getter: Optional[Callable[[int], bytes]] = None,
+    *,
+    stream=None,
+    errors: str = "strict",
 ):
     """(BamHeader, records) for a whole CRAM file."""
     data = (
@@ -929,7 +1010,11 @@ def read_cram(
     header = header_from_text(read_cram_header_text(data))
     out: List[BamRecord] = []
     for ch in iter_containers(data)[1:]:
-        out.extend(decode_container(data, ch, major, ref_getter))
+        out.extend(
+            decode_container(
+                data, ch, major, ref_getter, stream=stream, errors=errors
+            )
+        )
     return header, out
 
 
@@ -1052,7 +1137,10 @@ def _build_compression_header(
 
 
 def encode_container(
-    records: Sequence[BamRecord], record_counter: int, major: int = 3
+    records: Sequence[BamRecord],
+    record_counter: int,
+    major: int = 3,
+    codec: str = "gzip",
 ) -> bytes:
     """One container holding one multi-ref slice with the given records.
 
@@ -1060,6 +1148,10 @@ def encode_container(
     '='/'X' runs collapse to 'M' (the distinction is reference-derived, not
     stored), and flag-unmapped records store no features, so any CIGAR they
     carry reads back as '*'.
+
+    ``codec`` picks the external-block compression: ``"gzip"`` (the
+    default, htsjdk's stance) or ``"rans"`` (rANS 4x8 — the streams the
+    lockstep-lane decoder eats, used by tests and the bench CRAM twin).
     """
     # tag dictionary
     td: List[List[Tuple[bytes, int]]] = []
@@ -1185,8 +1277,9 @@ def encode_container(
         embedded_ref_id=-1,
         md5=b"\x00" * 16,
     )
-    from .cram_codecs import METHOD_GZIP, METHOD_RAW
+    from .cram_codecs import METHOD_GZIP, METHOD_RANS, METHOD_RAW
 
+    ext_method = METHOD_RANS if codec == "rans" else METHOD_GZIP
     blocks = bytearray()
     comp_raw = _build_compression_header(td, tag_keys)
     blocks += Block(METHOD_RAW, CT_COMPRESSION_HEADER, 0, comp_raw).write(
@@ -1203,7 +1296,7 @@ def encode_container(
     for cid in sorted(s.streams):
         slice_blocks += Block(
             METHOD_RAW, CT_EXTERNAL, cid, bytes(s.streams[cid])
-        ).write(major, METHOD_GZIP)
+        ).write(major, ext_method)
     blocks += slice_blocks
 
     hdr = bytearray()
@@ -1250,16 +1343,18 @@ def write_cram(
     records: Sequence[BamRecord],
     records_per_container: int = 10000,
     append_eof: bool = True,
+    codec: str = "gzip",
 ) -> None:
     """Complete CRAM 3.0 file: file definition, header container, data
     containers, EOF marker (suppressible for headerless parts, the
-    CRAMRecordWriter.java:98-101 semantics)."""
+    CRAMRecordWriter.java:98-101 semantics).  ``codec="rans"`` writes
+    the external series rANS-coded (see :func:`encode_container`)."""
     stream.write(MAGIC + bytes([3, 0]) + b"\x00" * 20)
     stream.write(encode_file_header_container(header.text, 3))
     counter = 0
     for i in range(0, len(records), records_per_container):
         chunk = records[i : i + records_per_container]
-        stream.write(encode_container(chunk, counter, 3))
+        stream.write(encode_container(chunk, counter, 3, codec=codec))
         counter += len(chunk)
     if append_eof:
         stream.write(EOF_V3)

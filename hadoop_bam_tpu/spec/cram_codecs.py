@@ -17,7 +17,9 @@ import bz2
 import gzip
 import lzma
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from .cram import CramError, read_itf8
 
@@ -56,6 +58,14 @@ METHOD_LZMA = 3
 METHOD_RANS = 4
 
 
+class CramUnsupportedCodec(CramError):
+    """A block names a compression method this reader does not implement
+    (CRAM 3.1 rans-Nx16 / adaptive-arith / fqzcomp / name-tok, or an
+    unknown id).  Distinguished from :class:`CramError` so the
+    ``errors="salvage"`` policy can quarantine the block instead of
+    killing the job (see :func:`decompress_batch`)."""
+
+
 def decompress(method: int, data: bytes, raw_size: int) -> bytes:
     if method == METHOD_RAW:
         return data
@@ -67,7 +77,9 @@ def decompress(method: int, data: bytes, raw_size: int) -> bytes:
         return lzma.decompress(data)
     if method == METHOD_RANS:
         return rans_decode(data, raw_size)
-    raise CramError(f"unsupported CRAM block compression method {method}")
+    raise CramUnsupportedCodec(
+        f"unsupported CRAM block compression method {method}"
+    )
 
 
 def compress(method: int, data: bytes) -> bytes:
@@ -79,7 +91,14 @@ def compress(method: int, data: bytes) -> bytes:
         return bz2.compress(data)
     if method == METHOD_LZMA:
         return lzma.compress(data)
-    raise CramError(f"unsupported write compression method {method}")
+    if method == METHOD_RANS:
+        # The writer is host-side; pay both orders and keep the smaller
+        # (order-1's per-context tables win on sequence/quality series,
+        # order-0 on short or near-uniform ones).
+        o0 = rans_encode(data, order=0)
+        o1 = rans_encode(data, order=1)
+        return o1 if len(o1) < len(o0) else o0
+    raise CramUnsupportedCodec(f"unsupported write compression method {method}")
 
 
 # ---------------------------------------------------------------------------
@@ -136,16 +155,15 @@ def _cum(F: List[int]) -> Tuple[List[int], bytes]:
 
 
 def rans_decode(data: bytes, raw_size: int) -> bytes:
+    """Decode one rANS 4x8 stream (NumPy lockstep tier, scalar-oracle
+    rescue).  ``raw_size`` is advisory; the stream header's ``n_out``
+    wins, exactly as the original per-byte decoder behaved."""
     if not data:
         if raw_size == 0:
             return b""
         raise CramError("empty rANS stream")
     order = data[0]
-    (n_in,) = struct.unpack_from("<I", data, 1)
     (n_out,) = struct.unpack_from("<I", data, 5)
-    if n_out != raw_size:
-        # trust the stream header; raw_size is advisory
-        pass
     p = 9
     if order == 0:
         return _rans_decode0(data, p, n_out)
@@ -154,7 +172,25 @@ def rans_decode(data: bytes, raw_size: int) -> bytes:
     raise CramError(f"unknown rANS order {order}")
 
 
-def _rans_decode0(data: bytes, p: int, n_out: int) -> bytes:
+def rans_decode_py(data: bytes, raw_size: int) -> bytes:
+    """The original per-byte Python decoder, kept verbatim as the test
+    oracle and the last rescue tier (rANS lanes → NumPy host →
+    this)."""
+    if not data:
+        if raw_size == 0:
+            return b""
+        raise CramError("empty rANS stream")
+    order = data[0]
+    (n_out,) = struct.unpack_from("<I", data, 5)
+    p = 9
+    if order == 0:
+        return _rans_decode0_py(data, p, n_out)
+    if order == 1:
+        return _rans_decode1_py(data, p, n_out)
+    raise CramError(f"unknown rANS order {order}")
+
+
+def _rans_decode0_py(data: bytes, p: int, n_out: int) -> bytes:
     F, p = _read_freq_table0(data, p)
     C, lookup = _cum(F)
     R = list(struct.unpack_from("<4I", data, p))
@@ -173,7 +209,7 @@ def _rans_decode0(data: bytes, p: int, n_out: int) -> bytes:
     return bytes(out)
 
 
-def _rans_decode1(data: bytes, p: int, n_out: int) -> bytes:
+def _rans_decode1_py(data: bytes, p: int, n_out: int) -> bytes:
     # outer table: context symbols with the same RLE layout
     Fs: Dict[int, Tuple[List[int], List[int], bytes]] = {}
     ctx = data[p]
@@ -224,6 +260,543 @@ def _rans_decode1(data: bytes, p: int, n_out: int) -> bytes:
             last[j] = s
         step += 1
     return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# rANS 4x8: stream plans + the NumPy lockstep decoder
+# ---------------------------------------------------------------------------
+#
+# Every tier above the Python oracle — the Pallas lanes kernel
+# (ops/pallas/rans_lanes.py) and the NumPy host fallback below — shares
+# one wave model: a global wave counter ``t`` advances all slices in
+# lockstep, each wave decoding exactly one byte per slice with state
+#
+#   j(t) = t & 3            while t < 4*q4v,
+#        = 3                afterwards (the order-1 remainder tail),
+#
+# where ``q4v = n_out >> 2`` for order-1 and ``ceil(n_out/4)`` for
+# order-0 (so order-0 never enters the tail and j cycles 0..3 forever).
+# Wave order equals output order for order-0; order-1 output position is
+# ``pos(t) = (t&3)*q4 + (t>>2)`` in the quarters and ``pos(t) = t`` in
+# the tail — a pure host-side de-interleave after decode.  Renormalizing
+# reads at most 2 bytes per wave for any stream the encoder invariants
+# allow; a slice needing more (corrupt) flips its ok flag and falls to
+# the oracle.
+
+
+class _RansPlan:
+    """Host-parsed header of one rANS 4x8 stream: everything except the
+    renorm byte payload (the only part the device kernel touches)."""
+
+    __slots__ = ("order", "n_out", "states", "tables", "payload")
+
+    def __init__(self, order, n_out, states, tables, payload):
+        self.order = order
+        self.n_out = n_out
+        self.states = states  # (R0, R1, R2, R3)
+        self.tables = tables  # {ctx: (F[256], C[257], lookup bytes)}
+        self.payload = payload  # renorm byte stream
+
+    @property
+    def q4v(self) -> int:
+        if self.order == 1:
+            return self.n_out >> 2
+        return (self.n_out + 3) >> 2
+
+
+def _parse_rans_body(data: bytes, p: int, order: int, n_out: int) -> _RansPlan:
+    tables: Dict[int, Tuple[List[int], List[int], bytes]] = {}
+    if order == 0:
+        F, p = _read_freq_table0(data, p)
+        C, lookup = _cum(F)
+        tables[0] = (F, C, lookup)
+    else:
+        ctx = data[p]
+        p += 1
+        rle = 0
+        while True:
+            F, p = _read_freq_table0(data, p)
+            C, lookup = _cum(F)
+            tables[ctx] = (F, C, lookup)
+            if rle > 0:
+                rle -= 1
+                ctx += 1
+            else:
+                nxt = data[p]
+                p += 1
+                if nxt == ctx + 1:
+                    rle = data[p]
+                    p += 1
+                ctx = nxt
+            if ctx == 0:
+                break
+    states = struct.unpack_from("<4I", data, p)
+    p += 16
+    return _RansPlan(order, n_out, states, tables, data[p:])
+
+
+def parse_rans_plan(data: bytes) -> _RansPlan:
+    """Parse the header of one rANS 4x8 stream (order byte, sizes,
+    frequency tables, initial states) into a :class:`_RansPlan`.  Raises
+    :class:`CramError` on truncated or unknown-order streams."""
+    if not data:
+        return _RansPlan(0, 0, (_RANS_L,) * 4, {0: _EMPTY_TABLE}, b"")
+    try:
+        order = data[0]
+        if order not in (0, 1):
+            raise CramError(f"unknown rANS order {order}")
+        (n_out,) = struct.unpack_from("<I", data, 5)
+        return _parse_rans_body(data, 9, order, n_out)
+    except (IndexError, struct.error):
+        raise CramError("truncated rANS stream")
+
+
+_EMPTY_TABLE = ([0] * 256, [0] * 257, bytes(_TOTFREQ))
+
+#: Sub-batch cap for the NumPy tier: ``B * (NC+1)`` dense context slabs
+#: of 4 KiB each; 8192 keeps the lookup bank under ~32 MiB.
+_NP_BATCH_SLABS = 8192
+
+
+def _decode_plans_numpy(plans: Sequence[_RansPlan]):
+    """Lockstep-wave NumPy decode of many parsed streams at once.
+
+    Returns ``(outs, ok)``: per-slice decoded bytes (wave-order already
+    de-interleaved) and a bool vector — ``ok=False`` marks a slice whose
+    stream violated the renorm/cursor invariants (corrupt, or a context
+    missing from its table); the caller rescues those through the Python
+    oracle so behavior stays bit-exact with it on *every* input.  The
+    vectorization win scales with the batch width: all slices advance in
+    one wave loop, so the per-wave Python overhead amortizes across the
+    batch (the shape the tier-down rescue path actually sees)."""
+    B = len(plans)
+    outs: List[Optional[bytes]] = [None] * B
+    ok_all = np.ones(B, dtype=bool)
+    if B == 0:
+        return outs, ok_all
+    # Sub-batch so the dense per-context banks stay bounded.
+    start = 0
+    while start < B:
+        end = start + 1
+        slabs = len(plans[start].tables) + 1
+        while end < B:
+            nxt = max(slabs, len(plans[end].tables) + 1)
+            if (end - start + 1) * nxt > _NP_BATCH_SLABS:
+                break
+            slabs = nxt
+            end += 1
+        _decode_plan_group(plans[start:end], outs, ok_all, start)
+        start = end
+    return outs, ok_all
+
+
+def _decode_plan_group(plans, outs, ok_all, base):
+    B = len(plans)
+    n_out = np.array([pl.n_out for pl in plans], dtype=np.int64)
+    T = int(n_out.max())
+    fourq4 = np.array([4 * pl.q4v for pl in plans], dtype=np.int64)
+    clen = np.array([len(pl.payload) for pl in plans], dtype=np.int64)
+    maxc = int(clen.max()) if B else 0
+    data = np.zeros((B, maxc + 1), dtype=np.int64)
+    for b, pl in enumerate(plans):
+        if pl.payload:
+            data[b, : len(pl.payload)] = np.frombuffer(
+                pl.payload, dtype=np.uint8
+            )
+    R = np.array([pl.states for pl in plans], dtype=np.int64)
+    nc = max(len(pl.tables) for pl in plans)
+    NC = nc + 1  # one zeroed slab for contexts missing from the table
+    lookup = np.zeros((B, NC, _TOTFREQ), dtype=np.uint8)
+    Fb = np.zeros((B, NC, 256), dtype=np.int64)
+    Cb = np.zeros((B, NC, 256), dtype=np.int64)
+    ctx_map = np.full((B, 256), NC - 1, dtype=np.int64)
+    missing = np.zeros((B, 256), dtype=bool)
+    for b, pl in enumerate(plans):
+        # Order-0 ignores context: every prior symbol maps to slab 0.
+        missing[b, :] = pl.order == 1
+        for ci, (ctx, (F, C, lk)) in enumerate(sorted(pl.tables.items())):
+            if pl.order == 1:
+                ctx_map[b, ctx] = ci
+                missing[b, ctx] = False
+            else:
+                ctx_map[b, :] = ci
+            Fb[b, ci, :] = F
+            Cb[b, ci, :] = C[:256]
+            lookup[b, ci, :] = np.frombuffer(lk, dtype=np.uint8)
+    wave = np.zeros((B, max(T, 1)), dtype=np.uint8)
+    last = np.zeros((B, 4), dtype=np.int64)
+    p = np.zeros(B, dtype=np.int64)
+    ok = np.ones(B, dtype=bool)
+    ar = np.arange(B)
+    for t in range(T):
+        active = t < n_out
+        j = np.where(t < fourq4, t & 3, 3)
+        Rj = R[ar, j]
+        ctx_raw = last[ar, j]
+        ok &= ~(active & missing[ar, ctx_raw])
+        ci = ctx_map[ar, ctx_raw]
+        m = Rj & (_TOTFREQ - 1)
+        s = lookup[ar, ci, m].astype(np.int64)
+        wave[:, t] = np.where(active, s, 0)
+        Rn = Fb[ar, ci, s] * (Rj >> _TF_SHIFT) + m - Cb[ar, ci, s]
+        for _ in range(2):
+            need = active & (Rn < _RANS_L)
+            if need.any():
+                byte = data[ar, np.minimum(p, maxc)]
+                ok &= ~(need & (p >= clen))
+                Rn = np.where(need, (Rn << 8) | byte, Rn)
+                p = p + need
+        ok &= ~(active & (Rn < _RANS_L))
+        R[ar, j] = np.where(active, Rn, Rj)
+        last[ar, j] = np.where(active, s, ctx_raw)
+    for b, pl in enumerate(plans):
+        ok_all[base + b] = ok[b]
+        if not ok[b]:
+            continue
+        outs[base + b] = rans_deinterleave(
+            wave[b, : pl.n_out], pl.order, pl.n_out
+        )
+
+
+def rans_deinterleave(w: np.ndarray, order: int, n: int) -> bytes:
+    """Wave-order bytes → output-order bytes (shared by the NumPy tier
+    and the lanes kernel's host post-pass).  Order-0 wave order *is*
+    output order; order-1 interleaves the four quarters."""
+    if order == 0 or n < 4:
+        return w.tobytes()
+    q4 = n >> 2
+    t = np.arange(n)
+    pos = np.where(t < 4 * q4, (t & 3) * q4 + (t >> 2), t)
+    out = np.empty(n, dtype=np.uint8)
+    out[pos] = w
+    return out.tobytes()
+
+
+def _rans_decode0(data: bytes, p: int, n_out: int) -> bytes:
+    plan = _parse_rans_body(data, p, 0, n_out)
+    outs, ok = _decode_plans_numpy([plan])
+    if ok[0]:
+        return outs[0]
+    return _rans_decode0_py(data, p, n_out)
+
+
+def _rans_decode1(data: bytes, p: int, n_out: int) -> bytes:
+    plan = _parse_rans_body(data, p, 1, n_out)
+    outs, ok = _decode_plans_numpy([plan])
+    if ok[0]:
+        return outs[0]
+    return _rans_decode1_py(data, p, n_out)
+
+
+def rans_decode_batch(
+    datas: Sequence[bytes], strict: bool = True
+) -> List[Optional[bytes]]:
+    """Decode many rANS 4x8 streams through the NumPy lockstep tier,
+    rescuing any slice it rejects through the Python oracle.  With
+    ``strict=False`` a slice whose oracle decode also fails comes back
+    ``None`` instead of raising (the salvage shape)."""
+    outs: List[Optional[bytes]] = [None] * len(datas)
+    plans = []
+    idxs = []
+    for i, d in enumerate(datas):
+        try:
+            plans.append(parse_rans_plan(d))
+            idxs.append(i)
+        except CramError:
+            if strict:
+                raise
+    got, ok = _decode_plans_numpy(plans)
+    for k, i in enumerate(idxs):
+        if ok[k]:
+            outs[i] = got[k]
+    for i, d in enumerate(datas):
+        if outs[i] is None:
+            try:
+                outs[i] = rans_decode_py(d, 0)
+            except Exception:
+                if strict:
+                    raise
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# rANS 4x8 encode (order-0 and order-1)
+# ---------------------------------------------------------------------------
+
+
+def _write_freq(f: int) -> bytes:
+    if f >= 0x80:
+        return bytes([0x80 | (f >> 8), f & 0xFF])
+    return bytes([f])
+
+
+def _norm_freqs(hist: List[int]) -> List[int]:
+    """Scale a histogram to total exactly ``_TOTFREQ``; every occurring
+    symbol keeps frequency ≥ 1 (a zero would make it undecodable)."""
+    total = sum(hist)
+    F = [0] * 256
+    if total == 0:
+        F[0] = _TOTFREQ
+        return F
+    acc = 0
+    for s in range(256):
+        if hist[s]:
+            F[s] = max(1, (hist[s] * _TOTFREQ) // total)
+            acc += F[s]
+    # Settle the rounding drift: grow the most frequent symbol, or skim
+    # the largest entries down (never below 1) when the min-clamps
+    # overshot the budget.
+    drift = _TOTFREQ - acc
+    if drift >= 0:
+        F[max(range(256), key=lambda s: F[s])] += drift
+    else:
+        while drift < 0:
+            top = max(range(256), key=lambda s: F[s])
+            take = min(-drift, F[top] - 1)
+            if take <= 0:
+                raise CramError("rANS frequency normalization failed")
+            F[top] -= take
+            drift += take
+    return F
+
+
+def _write_freq_table0(F: List[int]) -> bytes:
+    """Order-0 table in the sym/RLE layout of :func:`_read_freq_table0`."""
+    syms = [s for s in range(256) if F[s] > 0]
+    out = bytearray([syms[0]])
+    rle = 0
+    for i, sym in enumerate(syms):
+        out += _write_freq(F[sym])
+        if rle > 0:
+            rle -= 1
+            continue
+        nxt = syms[i + 1] if i + 1 < len(syms) else 0
+        out.append(nxt)
+        if nxt == sym + 1:
+            run = 0
+            k = i + 1
+            while k + 1 < len(syms) and syms[k + 1] == syms[k] + 1:
+                run += 1
+                k += 1
+            out.append(run)
+            rle = run
+    return bytes(out)
+
+
+def _rans_enc_table(F: List[int]) -> Tuple[List[int], List[int]]:
+    C = [0] * 257
+    for i in range(256):
+        C[i + 1] = C[i] + F[i]
+    return F, C
+
+
+def _rans_enc_step(R: int, f: int, c: int, emitted: bytearray) -> int:
+    x_max = ((_RANS_L >> _TF_SHIFT) << 8) * f
+    while R >= x_max:
+        emitted.append(R & 0xFF)
+        R >>= 8
+    return ((R // f) << _TF_SHIFT) + c + (R % f)
+
+
+def rans_encode(data: bytes, order: int = 0) -> bytes:
+    """Encode ``data`` as one rANS 4x8 stream (CRAM 3.0 layout, the
+    exact bitstream :func:`rans_decode` and the lanes kernel read).
+
+    Symbols are pushed in reverse so the decoder pops them forward; the
+    final four states land in the header.  Order-1 mirrors the decoder's
+    quarter split: stream ``j`` owns quarter ``j`` (stream 3 plus the
+    remainder tail), each byte conditioned on its predecessor, the four
+    quarter-start bytes on context 0."""
+    if order not in (0, 1):
+        raise CramError(f"unknown rANS order {order}")
+    n = len(data)
+    if order == 0 or n == 0:
+        hist = [0] * 256
+        for b in data:
+            hist[b] += 1
+        F, C = _rans_enc_table(_norm_freqs(hist))
+        table = _write_freq_table0(F)
+        R = [_RANS_L] * 4
+        emitted = bytearray()
+        for i in range(n - 1, -1, -1):
+            s = data[i]
+            R[i & 3] = _rans_enc_step(R[i & 3], F[s], C[s], emitted)
+        if order == 1 and n == 0:
+            # An empty order-1 stream still carries an outer table with
+            # the single context 0 so the shared parser accepts it.
+            table = bytes([0]) + table + bytes([0])
+        body = table + struct.pack("<4I", *R) + bytes(reversed(emitted))
+        return bytes([order]) + struct.pack("<II", len(body), n) + body
+    q4 = n >> 2
+    idx = [0, q4, 2 * q4, 3 * q4]
+    limits = [q4, q4, q4, n - 3 * q4]
+    hists: Dict[int, List[int]] = {}
+    for j in range(4):
+        for step in range(limits[j]):
+            pos = idx[j] + step
+            ctx = data[pos - 1] if step > 0 else 0
+            hists.setdefault(ctx, [0] * 256)[data[pos]] += 1
+    tabs = {
+        ctx: _rans_enc_table(_norm_freqs(h)) for ctx, h in hists.items()
+    }
+    # Outer table: contexts ascending, same RLE layout one level up.
+    ctxs = sorted(tabs)
+    table = bytearray([ctxs[0]])
+    rle = 0
+    for i, ctx in enumerate(ctxs):
+        table += _write_freq_table0(tabs[ctx][0])
+        if rle > 0:
+            rle -= 1
+            continue
+        nxt = ctxs[i + 1] if i + 1 < len(ctxs) else 0
+        table.append(nxt)
+        if nxt == ctx + 1:
+            run = 0
+            k = i + 1
+            while k + 1 < len(ctxs) and ctxs[k + 1] == ctxs[k] + 1:
+                run += 1
+                k += 1
+            table.append(run)
+            rle = run
+    R = [_RANS_L] * 4
+    emitted = bytearray()
+    max_step = max(limits)
+    for step in range(max_step - 1, -1, -1):
+        for j in range(3, -1, -1):
+            if step >= limits[j]:
+                continue
+            pos = idx[j] + step
+            ctx = data[pos - 1] if step > 0 else 0
+            F, C = tabs[ctx]
+            s = data[pos]
+            R[j] = _rans_enc_step(R[j], F[s], C[s], emitted)
+    body = bytes(table) + struct.pack("<4I", *R) + bytes(reversed(emitted))
+    return bytes([1]) + struct.pack("<II", len(body), n) + body
+
+
+# ---------------------------------------------------------------------------
+# Batched block decompression: the codec-tier seam
+# ---------------------------------------------------------------------------
+
+
+class RansTierStats:
+    """Per-call tier accounting of :func:`decompress_batch`'s rANS leg
+    (mirror of ``ops.flate.CodecTierStats`` for the third codec
+    family)."""
+
+    def __init__(self):
+        self.lanes = 0          # slices decoded on the Pallas lanes tier
+        self.host = 0           # slices decoded by the NumPy host tier
+        self.tierdown_size = 0
+        self.tierdown_vmem = 0
+        self.tierdown_ctx = 0
+        self.tierdown_format = 0
+        self.tierdown_ok0 = 0
+
+    def lanes_hit_rate(self) -> float:
+        total = self.lanes + self.host
+        return self.lanes / total if total else 0.0
+
+
+#: Tier accounting of the most recent armed :func:`decompress_batch`
+#: call (read by bench.py's CRAM leg).
+LAST_RANS_STATS = RansTierStats()
+
+
+def decompress_batch(
+    blocks: Sequence[Tuple[int, bytes, int]],
+    *,
+    errors: str = "strict",
+    stream=None,
+    conf=None,
+    use_lanes: Optional[bool] = None,
+    interpret=None,
+) -> List[Optional[bytes]]:
+    """Decompress a container's blocks as one batch — the seam
+    ``spec/cram.py`` block reading routes through instead of inflating
+    one block at a time inline.
+
+    ``blocks`` is a sequence of ``(method, payload, raw_size)`` triples.
+    rANS 4x8 blocks ride the tier ladder: the Pallas lanes kernel when
+    the gate is armed (``stream.policy.use_rans_lanes`` /
+    ``ops.flate.rans_lanes_tier_enabled``) with per-slice tier-down —
+    never per-launch — then the NumPy lockstep host tier, then the
+    Python oracle.  Other methods decode on the host as before.
+
+    ``errors="strict"`` raises on the first undecodable block;
+    ``"salvage"`` returns ``None`` for that block (the caller quarantines
+    its slice) and counts ``cram.codec.unsupported`` /
+    ``cram.codec.corrupt``.  ``cram.rans.*`` counters move only when the
+    lanes tier is armed — a disarmed stream stays metric-silent."""
+    from ..utils.tracing import METRICS, span
+
+    results: List[Optional[bytes]] = [None] * len(blocks)
+    rans_idx = [
+        i
+        for i, (method, data, _raw) in enumerate(blocks)
+        if method == METHOD_RANS and data
+    ]
+    rans_set = set(rans_idx)
+    for i, (method, data, raw_size) in enumerate(blocks):
+        if i in rans_set:
+            continue
+        try:
+            results[i] = decompress(method, data, raw_size)
+        except CramUnsupportedCodec:
+            if errors != "salvage":
+                raise
+            METRICS.count("cram.codec.unsupported", 1)
+        except Exception:
+            if errors != "salvage":
+                raise
+            METRICS.count("cram.codec.corrupt", 1)
+    if not rans_idx:
+        return results
+    if use_lanes is None:
+        if stream is not None:
+            use_lanes = bool(getattr(stream.policy, "use_rans_lanes", False))
+        else:
+            from ..ops import flate
+
+            use_lanes = flate.rans_lanes_tier_enabled(conf)
+    datas = [blocks[i][1] for i in rans_idx]
+    outs: List[Optional[bytes]] = [None] * len(datas)
+    with span("cram.stage.rans", category="stage"):
+        if use_lanes:
+            from ..ops.pallas import rans_lanes as _rl
+
+            global LAST_RANS_STATS
+            outs, stats = _rl.rans_lanes(datas, interpret=interpret)
+            stats.host = sum(1 for o in outs if o is None)
+            LAST_RANS_STATS = stats
+            if stats.lanes:
+                METRICS.count("cram.rans.lanes_slices", stats.lanes)
+            if stats.host:
+                METRICS.count("cram.rans.host_slices", stats.host)
+            if stats.tierdown_size:
+                METRICS.count("cram.rans.tierdown.size", stats.tierdown_size)
+            if stats.tierdown_vmem:
+                METRICS.count("cram.rans.tierdown.vmem", stats.tierdown_vmem)
+            if stats.tierdown_ctx:
+                METRICS.count("cram.rans.tierdown.ctx", stats.tierdown_ctx)
+            if stats.tierdown_format:
+                METRICS.count(
+                    "cram.rans.tierdown.format", stats.tierdown_format
+                )
+            if stats.tierdown_ok0:
+                METRICS.count("cram.rans.tierdown.ok0", stats.tierdown_ok0)
+        pend = [k for k, o in enumerate(outs) if o is None]
+        if pend:
+            rescued = rans_decode_batch(
+                [datas[k] for k in pend], strict=(errors != "salvage")
+            )
+            for k, out in zip(pend, rescued):
+                outs[k] = out
+                if out is None:
+                    METRICS.count("cram.codec.corrupt", 1)
+    for k, i in enumerate(rans_idx):
+        results[i] = outs[k]
+    return results
 
 
 # ---------------------------------------------------------------------------

@@ -78,6 +78,12 @@ def pytest_configure(config):
         "tests (leak/double-copy drills; run everywhere — the ledger is "
         "object-agnostic)",
     )
+    config.addinivalue_line(
+        "markers",
+        "cram_lanes: full-size rANS 4x8 lockstep-lane decodes; needs a "
+        "real accelerator, skipped when JAX_PLATFORMS pins cpu "
+        "(interpret-mode small-slice tests run everywhere)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -101,6 +107,7 @@ def pytest_collection_modifyitems(config, items):
             "device_deflate" in item.keywords
             or "device_stream" in item.keywords
             or "device_write" in item.keywords
+            or "cram_lanes" in item.keywords
             or ("dedup" in item.keywords and "tpu" in item.keywords)
         ):
             item.add_marker(skip)

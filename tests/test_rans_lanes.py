@@ -1,0 +1,522 @@
+"""CRAM rANS 4x8 on the lockstep lanes: kernel vs oracle, tier-down,
+counters, salvage, and end-to-end byte-identity vs the BAM twin.
+
+The device decoder (``ops/pallas/rans_lanes.py``) must be *bit-exact*
+against the pure-Python oracle on every stream it accepts, and must
+tier down per-slice — never per-launch — on anything it cannot place
+(oversized, too many order-1 contexts, malformed headers, or a mid-wave
+invariant violation).  Tier-down is rescued by the NumPy host tier
+inside ``cram_codecs.decompress_batch``, so callers always see exact
+bytes; the only observable difference is the ``cram.rans.*`` counter
+mix.  Everything here runs in interpret mode on small slices under the
+CPU pin; the full-size launch is ``slow`` + ``cram_lanes`` (real chip).
+"""
+
+import gzip
+import os
+import pathlib
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.ops.pallas import rans_lanes as rl
+from hadoop_bam_tpu.spec import bam, cram
+from hadoop_bam_tpu.spec import cram_codecs as cc
+from hadoop_bam_tpu.utils import tracing
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _corpus():
+    """The fuzz corpus: empty, 1-byte, single-symbol runs, RLE-heavy,
+    uniform/incompressible, small alphabets, and n%4 cap-boundary tails
+    around one kernel chunk."""
+    random.seed(7)
+    c = [
+        b"",
+        b"A",
+        b"AB",
+        b"ABC",
+        b"hello",
+        b"B" * 500,                                # single symbol
+        b"\x00" * 300,                             # NUL run
+        bytes(range(256)) * 4,                     # uniform-256
+        bytes(random.choice(b"ACGT") for _ in range(1000)),
+        bytes(random.getrandbits(8) for _ in range(800)),   # incompressible
+        bytes(random.choice(b"abcdefgh") for _ in range(2000)),
+        bytes(random.choice(bytes(16)) for _ in range(3000)),
+        bytes(random.choice(b"xyz") for _ in range(4093)),  # n % 4 == 1
+        bytes(random.choice(b"xyz") for _ in range(4094)),
+        bytes(random.choice(b"xyz") for _ in range(4095)),
+    ]
+    return c
+
+
+def _counters():
+    return dict(tracing.METRICS._counters)
+
+
+def _moved(before, prefix):
+    after = _counters()
+    return {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in after
+        if str(k).startswith(prefix)
+        and after.get(k, 0) != before.get(k, 0)
+    }
+
+
+# ---------------------------------------------------------------------------
+# Kernel vs oracle (interpret mode, always on)
+# ---------------------------------------------------------------------------
+
+
+class TestKernelVsOracle:
+    def test_encoder_roundtrips_through_oracle(self):
+        for raw in _corpus():
+            for order in (0, 1):
+                enc = cc.rans_encode(raw, order=order)
+                assert cc.rans_decode_py(enc, len(raw)) == raw, (
+                    order,
+                    len(raw),
+                )
+
+    def test_numpy_host_tier_matches_oracle(self):
+        raws = _corpus() * 2
+        datas = [
+            cc.rans_encode(r, order=i % 2) for i, r in enumerate(raws)
+        ]
+        outs = cc.rans_decode_batch(datas)
+        assert outs == raws
+
+    def test_lanes_kernel_bit_exact_with_per_slice_tierdown(self):
+        """One launch over the whole corpus, both orders interleaved.
+        Every lane either matches the oracle exactly or tiers down
+        (None) for a *counted* reason — a lane may not be wrong."""
+        raws, datas = [], []
+        for raw in _corpus():
+            for order in (0, 1):
+                raws.append(raw)
+                datas.append(cc.rans_encode(raw, order=order))
+        outs, stats = rl.rans_lanes(datas, interpret=True)
+        n_none = 0
+        for i, (o, r) in enumerate(zip(outs, raws)):
+            if o is None:
+                n_none += 1
+            else:
+                assert o == r, (i, datas[i][0], len(r))
+        # Wide-alphabet order-1 slices exceed the context cap and tier
+        # down (by design: >_NC_CAP contexts never fit the VMEM banks);
+        # everything else decodes on the lanes.
+        assert n_none == (
+            stats.tierdown_size
+            + stats.tierdown_vmem
+            + stats.tierdown_ctx
+            + stats.tierdown_format
+            + stats.tierdown_ok0
+        )
+        assert stats.tierdown_ctx >= 1
+        assert stats.lanes == len(datas) - n_none
+        assert stats.lanes > len(datas) // 2
+
+    def test_malformed_streams_tier_down_as_format(self):
+        junk = [
+            b"\x07aaaa",                      # unknown order byte
+            cc.rans_encode(b"Q" * 10, 0)[:6],  # truncated mid-table
+        ]
+        outs, stats = rl.rans_lanes(junk, interpret=True)
+        assert outs == [None, None]
+        assert stats.tierdown_format == 2
+        assert stats.lanes == 0
+
+    def test_context_cap_tierdown_is_rescued_by_batch_seam(self):
+        """An order-1 stream with >_NC_CAP contexts is a lanes
+        tier-down, but decompress_batch's host rescue still returns the
+        exact bytes — per-slice, with the rest of the batch on-lane."""
+        wide = bytes(range(256)) * 8          # 256 order-1 contexts
+        narrow = b"ACGT" * 256
+        blocks = [
+            (cc.METHOD_RANS, cc.rans_encode(wide, order=1), len(wide)),
+            (cc.METHOD_RANS, cc.rans_encode(narrow, order=1), len(narrow)),
+        ]
+        before = _counters()
+        res = cc.decompress_batch(blocks, use_lanes=True, interpret=True)
+        assert res == [wide, narrow]
+        moved = _moved(before, "cram.rans.")
+        assert moved.get("cram.rans.tierdown.ctx", 0) >= 1
+        assert moved.get("cram.rans.lanes_slices", 0) >= 1
+        assert moved.get("cram.rans.host_slices", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# The decompress_batch seam: gating, counters, salvage
+# ---------------------------------------------------------------------------
+
+
+class TestBatchSeam:
+    def test_disarmed_batch_is_metric_silent(self):
+        raws = _corpus()[:8]
+        blocks = [
+            (cc.METHOD_RANS, cc.rans_encode(r, order=i % 2), len(r))
+            for i, r in enumerate(raws)
+        ]
+        blocks.append((cc.METHOD_GZIP, gzip.compress(b"hello"), 5))
+        blocks.append((cc.METHOD_RAW, b"xyz", 3))
+        before = _counters()
+        res = cc.decompress_batch(blocks, use_lanes=False)
+        assert res[: len(raws)] == raws
+        assert res[-2:] == [b"hello", b"xyz"]
+        assert _moved(before, "cram.") == {}
+
+    def test_armed_batch_counts_lanes_slices(self):
+        raws = [b"ACGT" * 100, b"Z" * 333]
+        blocks = [
+            (cc.METHOD_RANS, cc.rans_encode(r, order=0), len(r))
+            for r in raws
+        ]
+        before = _counters()
+        res = cc.decompress_batch(blocks, use_lanes=True, interpret=True)
+        assert res == raws
+        moved = _moved(before, "cram.rans.")
+        assert moved.get("cram.rans.lanes_slices") == 2
+        assert cc.LAST_RANS_STATS.lanes == 2
+        assert cc.LAST_RANS_STATS.lanes_hit_rate() == 1.0
+
+    def test_unsupported_method_strict_raises_salvage_quarantines(self):
+        blocks = [(8, b"\x01\x02", 2), (cc.METHOD_RAW, b"ok", 2)]
+        with pytest.raises(cc.CramUnsupportedCodec):
+            cc.decompress_batch(blocks, use_lanes=False)
+        before = _counters()
+        res = cc.decompress_batch(blocks, errors="salvage", use_lanes=False)
+        assert res == [None, b"ok"]
+        assert _moved(before, "cram.codec.").get(
+            "cram.codec.unsupported"
+        ) == 1
+
+    def test_corrupt_payload_strict_raises_salvage_none(self):
+        blocks = [
+            (cc.METHOD_GZIP, b"\x1f\x8bgarbage", 5),
+            (cc.METHOD_RAW, b"ok", 2),
+        ]
+        with pytest.raises(Exception):
+            cc.decompress_batch(blocks, use_lanes=False)
+        before = _counters()
+        res = cc.decompress_batch(blocks, errors="salvage", use_lanes=False)
+        assert res == [None, b"ok"]
+        assert _moved(before, "cram.codec.").get("cram.codec.corrupt") == 1
+
+
+# ---------------------------------------------------------------------------
+# File-level: rANS-coded CRAM roundtrip + slice quarantine
+# ---------------------------------------------------------------------------
+
+
+def _twin_header():
+    refs = [("c1", 1 << 24), ("c2", 1 << 24)]
+    return bam.BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n"
+        + "\n".join(f"@SQ\tSN:{nm}\tLN:{ln}" for nm, ln in refs),
+        refs,
+    )
+
+
+def _twin_records(n=480, seed=2):
+    """A CRAM-representable mixed corpus: unmapped records carry mapq 0
+    (CRAM 3.0 stores the MQ series only for mapped records — htslib
+    decodes unmapped reads with MAPQ 0, so a twin with nonzero unmapped
+    MAPQ could never be byte-identical)."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        unmapped = i % 17 == 0
+        recs.append(
+            bam.build_record(
+                f"r{i:05d}",
+                -1 if unmapped else int(rng.integers(0, 2)),
+                -1 if unmapped else int(rng.integers(0, 1 << 20)),
+                0 if unmapped else 30,
+                bam.FLAG_UNMAPPED if unmapped else 0,
+                [] if unmapped else [(36, "M")],
+                "ACGT" * 9,
+                bytes([25] * 36),
+                tags=b"NMi\x01\x00\x00\x00" if i % 3 == 0 else b"",
+            )
+        )
+    return recs
+
+
+def _write_twins(td, n=480, seed=2, per_container=120):
+    """(bam_path, cram_path) of the same records; the CRAM uses the
+    rANS codec for its external blocks."""
+    hdr = _twin_header()
+    pb = os.path.join(td, "twin.bam")
+    pc = os.path.join(td, "twin.cram")
+    with open(pb, "wb") as f:
+        bam.write_bam(f, hdr, iter(_twin_records(n, seed)), level=1)
+    hdr2, recs2 = bam.read_bam(pb)
+    with open(pc, "wb") as f:
+        cram.write_cram(
+            f, hdr2, recs2,
+            records_per_container=per_container, codec="rans",
+        )
+    return pb, pc
+
+
+class TestCramFile:
+    def test_rans_cram_roundtrip_exact(self, tmp_path):
+        pb, pc = _write_twins(str(tmp_path), n=240, per_container=80)
+        _, want = bam.read_bam(pb)
+        _, got = cram.read_cram(pc)
+        assert [r.encode() for r in got] == [r.encode() for r in want]
+
+    def test_rans_external_blocks_actually_present(self, tmp_path):
+        """The codec="rans" writer must emit METHOD_RANS external blocks
+        (not silently fall back to raw) — otherwise every test here
+        exercises nothing."""
+        _, pc = _write_twins(str(tmp_path), n=240, per_container=80)
+        data = open(pc, "rb").read()
+        major, _ = cram.parse_file_definition(data)
+        n_rans = 0
+        for ch in cram.iter_containers(data):
+            if ch.is_eof:
+                continue
+            pos = ch.offset + ch.header_size
+            end = ch.next_offset
+            while pos < end:
+                frame, pos = cram.Block.read_frame(data, pos, major)
+                if frame.method == cc.METHOD_RANS:
+                    n_rans += 1
+        assert n_rans > 0
+
+    def _corrupt_first_rans_block(self, data):
+        """Flip the order byte of the first rANS external payload to an
+        invalid value (7): both the lanes plan parser and the host
+        decoder reject it deterministically."""
+        major, _ = cram.parse_file_definition(data)
+        for ch in cram.iter_containers(data):
+            if ch.is_eof:
+                continue
+            pos = ch.offset + ch.header_size
+            end = ch.next_offset
+            while pos < end:
+                p0 = pos
+                frame, pos = cram.Block.read_frame(data, pos, major)
+                if (
+                    frame.method == cc.METHOD_RANS
+                    and frame.content_type == cram.CT_EXTERNAL
+                    and frame.payload
+                ):
+                    # Re-walk the frame header to the payload offset.
+                    q = p0 + 2
+                    _, q = cram.read_itf8(data, q)  # content id
+                    _, q = cram.read_itf8(data, q)  # compressed size
+                    _, q = cram.read_itf8(data, q)  # raw size
+                    out = bytearray(data)
+                    out[q] = 7
+                    return bytes(out)
+        raise AssertionError("no rANS external block found")
+
+    def test_corrupt_slice_salvage_quarantines_strict_raises(
+        self, tmp_path
+    ):
+        pb, pc = _write_twins(str(tmp_path), n=240, per_container=80)
+        data = self._corrupt_first_rans_block(open(pc, "rb").read())
+        with pytest.raises(Exception):
+            cram.read_cram(data)
+        before = _counters()
+        _, got = cram.read_cram(data, errors="salvage")
+        moved = _moved(before, "cram.slice.")
+        assert moved.get("cram.slice.quarantined", 0) >= 1
+        # The undamaged slices still decode, and exactly.
+        _, want = bam.read_bam(pb)
+        assert 0 < len(got) < len(want)
+        want_enc = {r.encode() for r in want}
+        assert all(r.encode() in want_enc for r in got)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: sort on .cram input, byte-identical to the BAM twin
+# ---------------------------------------------------------------------------
+
+
+class TestSortByteIdentity:
+    @pytest.fixture(scope="class")
+    def twins(self, tmp_path_factory):
+        td = str(tmp_path_factory.mktemp("rans_twins"))
+        return _write_twins(td, n=480, per_container=120)
+
+    def test_in_core_and_memory_budget_paths(self, tmp_path, twins):
+        from hadoop_bam_tpu.pipeline import sort_bam
+
+        pb, pc = twins
+        out_b = str(tmp_path / "ob.bam")
+        out_c = str(tmp_path / "oc.bam")
+        s_b = sort_bam(pb, out_b, split_size=64 << 10)
+        s_c = sort_bam(pc, out_c, split_size=64 << 10)
+        assert s_b.n_records == s_c.n_records
+        assert open(out_b, "rb").read() == open(out_c, "rb").read()
+
+        ob2 = str(tmp_path / "ob2.bam")
+        oc2 = str(tmp_path / "oc2.bam")
+        sort_bam(pb, ob2, split_size=64 << 10, memory_budget=256 << 10)
+        sort_bam(pc, oc2, split_size=64 << 10, memory_budget=256 << 10)
+        assert open(ob2, "rb").read() == open(oc2, "rb").read()
+        # Both budget outputs also match the in-core output.
+        assert open(ob2, "rb").read() == open(out_b, "rb").read()
+
+    def test_armed_sort_identical_and_counts_lanes(
+        self, tmp_path, monkeypatch
+    ):
+        """HBAM_RANS_LANES=1 arms the lanes tier through the whole
+        pipeline (StreamPolicy → DeviceStream → decompress_batch); the
+        sorted output must not change by a byte while cram.rans.*
+        counters show the tier actually ran.  Own small twins: the
+        armed decode runs the kernel in interpret mode under the CPU
+        pin, and emulation cost scales with slice waves."""
+        from hadoop_bam_tpu.pipeline import sort_bam
+
+        pb, pc = _write_twins(str(tmp_path), n=160, per_container=40)
+        out_b = str(tmp_path / "ob.bam")
+        sort_bam(pb, out_b, split_size=64 << 10)
+        monkeypatch.setenv("HBAM_RANS_LANES", "1")
+        before = _counters()
+        out_c = str(tmp_path / "oc.bam")
+        sort_bam(pc, out_c, split_size=64 << 10)
+        moved = _moved(before, "cram.rans.")
+        assert open(out_c, "rb").read() == open(out_b, "rb").read()
+        assert moved.get("cram.rans.lanes_slices", 0) > 0
+
+    def test_disarmed_sort_moves_no_rans_counters(
+        self, tmp_path, twins, monkeypatch
+    ):
+        from hadoop_bam_tpu.pipeline import sort_bam
+
+        pb, pc = twins
+        monkeypatch.delenv("HBAM_RANS_LANES", raising=False)
+        before = _counters()
+        out_c = str(tmp_path / "oc.bam")
+        sort_bam(pc, out_c, split_size=64 << 10)
+        assert _moved(before, "cram.rans.") == {}
+        assert _moved(before, "device_stream.cram_decodes") == {}
+
+
+# ---------------------------------------------------------------------------
+# Serve endpoints accept .cram
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestServeCram:
+    def test_view_and_flagstat_parity(self, tmp_path):
+        from hadoop_bam_tpu.serve.endpoints import (
+            ServeContext,
+            flagstat,
+            view_blob,
+        )
+
+        rng = np.random.default_rng(5)
+        hdr = _twin_header()
+        recs, pos = [], 100
+        for i in range(400):
+            pos += int(rng.integers(1, 500))
+            recs.append(
+                bam.build_record(
+                    f"r{i:05d}", 0, pos, 30, 0, [(36, "M")],
+                    "ACGT" * 9, bytes([25] * 36),
+                )
+            )
+        pb = str(tmp_path / "t.bam")
+        pc = str(tmp_path / "t.cram")
+        with open(pb, "wb") as f:
+            bam.write_bam(f, hdr, iter(recs), level=1)
+        hdr2, recs2 = bam.read_bam(pb)
+        with open(pc, "wb") as f:
+            cram.write_cram(
+                f, hdr2, recs2, records_per_container=100, codec="rans"
+            )
+        ctx = ServeContext.from_conf(with_batcher=False)
+        try:
+            fs_b = flagstat(ctx, pb)
+            fs_c = flagstat(ctx, pc)
+            pub = lambda d: {
+                k: d[k] for k in d if not k.startswith("_")
+            }
+            assert pub(fs_b) == pub(fs_c)
+            vb = view_blob(ctx, pb, "c1:5000-40000")
+            vc = view_blob(ctx, pc, "c1:5000-40000")
+            _, rb = bam.read_bam(vb)
+            _, rc = bam.read_bam(vc)
+            assert len(rb) > 0
+            assert [r.encode() for r in rb] == [r.encode() for r in rc]
+        finally:
+            ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability: the stall table sees the CRAM stages
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_attributes_cram_stages():
+    from tests.test_hbm import _load_module
+
+    tr = _load_module(
+        REPO / "tools" / "trace_report.py", "trace_report_rans"
+    )
+    tracing.TRACER.start(capacity=4096)
+    try:
+        raws = [b"ACGT" * 200, b"Z" * 100]
+        blocks = [
+            (cc.METHOD_RANS, cc.rans_encode(r, order=0), len(r))
+            for r in raws
+        ]
+        assert cc.decompress_batch(blocks, use_lanes=False) == raws
+        events = tracing.TRACER.chrome_events()
+    finally:
+        tracing.TRACER.stop()
+    rep = tr.stage_report(events)
+    assert rep is not None
+    assert "cram.stage.rans" in rep["stages"]
+    assert rep["stages"]["cram.stage.rans"]["events"] >= 1
+
+
+def test_trace_report_sees_series_stage(tmp_path):
+    from tests.test_hbm import _load_module
+
+    tr = _load_module(
+        REPO / "tools" / "trace_report.py", "trace_report_rans2"
+    )
+    _, pc = _write_twins(str(tmp_path), n=120, per_container=60)
+    tracing.TRACER.start(capacity=4096)
+    try:
+        cram.read_cram(pc)
+        events = tracing.TRACER.chrome_events()
+    finally:
+        tracing.TRACER.stop()
+    rep = tr.stage_report(events)
+    assert "cram.stage.series" in rep["stages"]
+    assert "cram.stage.rans" in rep["stages"]
+
+
+# ---------------------------------------------------------------------------
+# Full-size launch (real accelerator only)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.cram_lanes
+class TestFullSizeLanes:
+    def test_full_size_slices_bit_exact_on_chip(self):
+        rng = np.random.default_rng(11)
+        raws = [
+            bytes(rng.integers(65, 91, size=512 << 10, dtype=np.uint8)),
+            bytes(rng.choice(np.frombuffer(b"ACGTN", np.uint8),
+                             size=1 << 20).tobytes()),
+        ]
+        datas = [
+            cc.rans_encode(r, order=i % 2) for i, r in enumerate(raws)
+        ]
+        outs, stats = rl.rans_lanes(datas, interpret=False)
+        assert outs == raws
+        assert stats.lanes == len(raws)

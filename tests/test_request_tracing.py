@@ -812,6 +812,13 @@ _AMBIENT_EXEMPT = (
     # per-host attribution lives in the mesh shards (pid = host) and
     # the ClusterManifest instead (tests/test_mesh_observability.py).
     "parallel/multihost.py",
+    # The CRAM spec layer emits its stage events (cram.stage.series /
+    # cram.stage.rans) from wherever a container is decoded — batch
+    # sort or a serve request alike; like io/bam.py, attribution
+    # happens at the serve caller (endpoints run read_split under the
+    # request scope), not inside the format oracle.
+    "spec/cram.py",
+    "spec/cram_codecs.py",
 )
 
 

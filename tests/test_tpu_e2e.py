@@ -95,6 +95,18 @@ print("TPU_LOCKSTEP_OK n=%d" % len(payload))
 
 @pytest.mark.tpu
 def test_sort_pipeline_on_real_chip():
+    # Cheap pre-probe before paying for the full child: a wedged tunnel
+    # used to burn the child's whole timeout (180 s of suite wall) just
+    # to discover there is no chip.  The watchdogged probe answers in
+    # seconds on a live backend and bounds the wedged case.
+    from hadoop_bam_tpu.utils import backend as ub
+
+    probe_timeout = float(os.environ.get("HBAM_TPU_E2E_PROBE_TIMEOUT", "30"))
+    plat, perr = ub.probe_platform_ex(timeout_s=probe_timeout, retries=0)
+    if plat is None:
+        pytest.skip(f"accelerator probe failed: {perr}")
+    if plat == "cpu":
+        pytest.skip("no accelerator in this environment (default=cpu)")
     env = dict(os.environ)
     # Drop the CPU pinning the rest of the suite uses.
     env.pop("JAX_PLATFORMS", None)

@@ -602,3 +602,30 @@ def test_skew_healing_metric_literals_present():
         "pipeline.effective_rtt_ms",
     ):
         assert want in names, f"metric literal {want!r} missing"
+
+
+def test_cram_rans_metric_literals_present():
+    """The CRAM codec-family namespaces exist as literals in the package
+    — tests/test_rans_lanes.py and bench.py's CRAM leg read these exact
+    names (counter deltas and the lanes hit rate), so a rename that
+    skips them fails here, next to the shape lint."""
+    names = set()
+    for f in sorted((REPO / "hadoop_bam_tpu").rglob("*.py")):
+        for m in _NAME_CALL.finditer(f.read_text()):
+            names.add(m.group(2))
+    for want in (
+        "cram.rans.lanes_slices",
+        "cram.rans.host_slices",
+        "cram.rans.tierdown.size",
+        "cram.rans.tierdown.vmem",
+        "cram.rans.tierdown.ctx",
+        "cram.rans.tierdown.format",
+        "cram.rans.tierdown.ok0",
+        "cram.codec.unsupported",
+        "cram.codec.corrupt",
+        "cram.slice.quarantined",
+        "cram.container.quarantined",
+        "cram.stage.rans",
+        "cram.stage.series",
+    ):
+        assert want in names, f"metric literal {want!r} missing"
