@@ -425,6 +425,15 @@ def _measure(platform: str) -> dict:
         out.update(_cram_bench(tmp, platform))
     except Exception as e:  # never fail the headline for a diagnostic
         out["cram_bench_error"] = str(e)[:120]
+    # Fleet service mode (both platforms): goodput vs 1/2/4 daemons
+    # behind the front router, the zipfian warm hit rate the
+    # consistent-hash placement preserves, and the kill-a-daemon
+    # recovery drill — seconds from SIGKILL to the adopted job's
+    # byte-identical completion, with zero lost jobs (PR 18).
+    try:
+        out.update(_fleet_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["fleet_bench_error"] = str(e)[:120]
     return out
 
 
@@ -565,6 +574,269 @@ def _traced_overhead(tmp: str, srt: str, region: str) -> float:
         for _, t in daemons:
             t.join(timeout=30)
     return round((med_on - med_off) / max(med_on, 1e-9) * 100, 2)
+
+
+def _fleet_bench(tmp: str) -> dict:
+    """Fleet service mode (PR 18): goodput vs fleet size behind the
+    front router, the zipfian warm hit rate that consistent-hash
+    placement buys, and the kill-a-daemon recovery drill.
+
+    Goodput runs 8 closed-loop clients against a 1-, 2- and 4-daemon
+    in-thread fleet on a zipfian mix of distinct file identities: the
+    ring pins each identity's warmth to one member, so QPS should scale
+    with members while the fleet-wide ``serve.arena.hit`` rate stays
+    high (diluted warmth — the no-router strawman — would cold-decode
+    ~(N-1)/N of the hits).  The kill drill is the PR 18 acceptance
+    number in real processes: 3 CLI daemons, kill -9 the sort owner
+    mid-job, and report seconds from SIGKILL to the adopted job's
+    byte-identical completion plus the lost-job count (must be 0)."""
+    import random
+    import shutil
+    import signal
+    import subprocess
+    import threading
+
+    from hadoop_bam_tpu.conf import (
+        FLEET_DIR,
+        FLEET_HEARTBEAT_MS,
+        FLEET_NAME,
+        Configuration,
+    )
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.serve import BamDaemon, FleetRouter, ServeClient
+    from hadoop_bam_tpu.spec import indices
+    from hadoop_bam_tpu.utils.tracing import delta, snapshot
+
+    n = int(os.environ.get("HBAM_BENCH_FLEET_RECORDS", "8000"))
+    out: dict = {}
+    src = os.path.join(tmp, "fleet_src.bam")
+    synth_bam(src, n)
+    srt = os.path.join(tmp, "fleet_sorted.bam")
+    sort_bam([src], srt, backend="host", level=1)
+    with open(srt + ".bai", "wb") as f:
+        indices.build_bai(srt).save(f)
+    files = []
+    for i in range(6):
+        p = os.path.join(tmp, f"fleet_c{i}.bam")
+        shutil.copyfile(srt, p)
+        shutil.copyfile(srt + ".bai", p + ".bai")
+        files.append(p)
+    region = "chr1:1-30000000"
+    # Zipfian mix: file rank r drawn with weight 1/(r+1).
+    weights = [1.0 / (r + 1) for r in range(len(files))]
+    seq = random.Random(0).choices(files, weights=weights, k=4096)
+
+    def _spin_fleet(n_daemons: int):
+        fdir = os.path.join(tmp, f"fleet_dir_{n_daemons}")
+        daemons = []
+        for i in range(n_daemons):
+            conf = Configuration({
+                FLEET_DIR: fdir,
+                FLEET_NAME: f"bench-{n_daemons}-{i}",
+                FLEET_HEARTBEAT_MS: "200",
+            })
+            d = BamDaemon(
+                socket_path=os.path.join(tmp, f"fb{n_daemons}_{i}.sock"),
+                warmup=False, conf=conf,
+            )
+            ev = threading.Event()
+            th = threading.Thread(
+                target=d.serve_forever, args=(ev,), daemon=True
+            )
+            th.start()
+            if not ev.wait(120):
+                raise RuntimeError("fleet bench daemon did not come up")
+            daemons.append((d, th))
+        router = FleetRouter(
+            fleet_dir=fdir,
+            socket_path=os.path.join(tmp, f"fr{n_daemons}.sock"),
+        )
+        rev = threading.Event()
+        rth = threading.Thread(
+            target=router.serve_forever, args=(rev,), daemon=True
+        )
+        rth.start()
+        if not rev.wait(120):
+            raise RuntimeError("fleet bench router did not come up")
+        return fdir, daemons, router, rth
+
+    for n_daemons in (1, 2, 4):
+        fdir, daemons, router, rth = _spin_fleet(n_daemons)
+        try:
+            warm = ServeClient(socket_path=router.socket_path)
+            for p in files:  # one warm pass pins every identity
+                warm.view(p, region, level=1)
+            s0 = snapshot()
+            done = [0] * 8
+            stop_at = time.time() + 1.0
+
+            def _worker(slot):
+                c = ServeClient(socket_path=router.socket_path)
+                rng = random.Random(slot)
+                while time.time() < stop_at:
+                    c.view(seq[rng.randrange(len(seq))], region, level=1)
+                    done[slot] += 1
+
+            t0 = time.time()
+            threads = [
+                threading.Thread(target=_worker, args=(i,), daemon=True)
+                for i in range(len(done))
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+            dt = max(time.time() - t0, 1e-9)
+            d_ = delta(s0)["counters"]
+            reqs = sum(done)
+            out[f"fleet_view_qps_{n_daemons}d"] = round(reqs / dt, 1)
+            if n_daemons == 4:
+                out["fleet_warm_hit_rate"] = round(
+                    d_.get("serve.arena.hit", 0) / max(1, reqs), 3
+                )
+        finally:
+            ServeClient(socket_path=router.socket_path).shutdown()
+            rth.join(timeout=30)
+            for d, th in daemons:
+                try:
+                    ServeClient(socket_path=d.socket_path).shutdown()
+                except Exception:
+                    pass
+                th.join(timeout=30)
+
+    # -- kill-a-daemon recovery (real processes) ---------------------------
+    try:
+        out.update(_fleet_kill_drill(tmp, src))
+    except Exception as e:  # diagnostic only
+        out["fleet_kill9_error"] = str(e)[:120]
+    return out
+
+
+def _fleet_kill_drill(tmp: str, src: str) -> dict:
+    """kill -9 the sort owner mid-job; measure adoption recovery."""
+    import shutil
+    import signal
+    import subprocess
+    import threading
+
+    from hadoop_bam_tpu.pipeline import sort_bam
+    from hadoop_bam_tpu.serve import FleetRouter, ServeClient
+
+    budget = 48 << 10
+    oracle = os.path.join(tmp, "fleet_kill_oracle.bam")
+    # The single-daemon baseline: an uninterrupted sort of the same
+    # request is the byte-identity oracle for the adopted rerun.
+    sort_bam([src], oracle, backend="host", level=1, memory_budget=budget)
+    fdir = os.path.join(tmp, "fleet_kill_dir")
+    procs = {}
+    names = ["fk-a", "fk-b", "fk-c"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("HBAM_FAULTS", None)
+    router = None
+    rth = None
+    client = None
+    try:
+        for name in names:
+            sock = os.path.join(tmp, f"{name}.sock")
+            procs[name] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "hadoop_bam_tpu", "serve",
+                    "--socket", sock,
+                    "--journal", os.path.join(tmp, f"{name}.jsonl"),
+                    "--flightrec", os.path.join(tmp, f"{name}.flight"),
+                    "--flightrec-cadence-ms", "100",
+                    "--fleet-dir", fdir, "--fleet-name", name,
+                    "--heartbeat-ms", "200", "--no-warmup",
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        router = FleetRouter(
+            fleet_dir=fdir,
+            socket_path=os.path.join(tmp, "fleet_kill_router.sock"),
+            heartbeat_timeout_ms=1200.0,
+        )
+        rev = threading.Event()
+        rth = threading.Thread(
+            target=router.serve_forever, args=(rev,), daemon=True
+        )
+        rth.start()
+        if not rev.wait(120):
+            raise RuntimeError("kill-drill router did not come up")
+        client = ServeClient(socket_path=router.socket_path)
+        deadline = time.time() + 120
+        while len(client.fleet()["members"]) < 3:
+            if time.time() > deadline:
+                raise RuntimeError("kill-drill fleet never assembled")
+            time.sleep(0.2)
+        out_bam = os.path.join(tmp, "fleet_kill_out.bam")
+        reply = client._request({
+            "op": "sort", "bam": [src], "output": out_bam, "level": 1,
+            "memory_budget": budget,
+            "part_dir": os.path.join(tmp, "fleet_kill_parts"),
+        })
+        jid, owner = reply["job"], reply["member"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            jr = client._request(
+                {"op": "job", "id": jid}, idempotent=True
+            )
+            if jr["status"] in ("running", "done"):
+                break
+            time.sleep(0.02)
+        if jr["status"] != "running":
+            raise RuntimeError(
+                f"job reached {jr['status']!r} before the kill window"
+            )
+        procs[owner].send_signal(signal.SIGKILL)
+        procs[owner].wait(timeout=30)
+        t_kill = time.time()
+        deadline = t_kill + 300
+        jr = None
+        while time.time() < deadline:
+            try:
+                jr = client._request(
+                    {"op": "job", "id": jid}, idempotent=True
+                )
+                if jr["status"] in ("done", "failed"):
+                    break
+            except Exception:
+                pass  # JOB_LOST window between death and adoption
+            time.sleep(0.1)
+        if jr is None or jr["status"] != "done":
+            raise RuntimeError(f"adopted job never completed: {jr}")
+        recovery_s = time.time() - t_kill
+        view = client.fleet()
+        hand = [
+            h for h in view["handoffs"]
+            if h["member"] == owner and h.get("kind") == "death"
+        ]
+        lost = len(hand[-1].get("lost", [])) if hand else -1
+        with open(out_bam, "rb") as f1, open(oracle, "rb") as f2:
+            identical = f1.read() == f2.read()
+        return {
+            "fleet_kill9_recovery_s": round(recovery_s, 2),
+            "fleet_kill9_lost_jobs": lost,
+            "fleet_kill9_byte_identical": identical,
+            "fleet_kill9_verdict": (
+                view["dead"].get(owner, {})
+                .get("forensics", {}).get("verdict")
+            ),
+        }
+    finally:
+        if client is not None:
+            try:
+                client.shutdown()
+            except Exception:
+                pass
+        if router is not None:
+            router.stop()
+        if rth is not None:
+            rth.join(timeout=30)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
 
 
 def _overload_bench(tmp: str) -> dict:

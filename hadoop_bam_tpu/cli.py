@@ -491,6 +491,14 @@ def _cmd_serve(args) -> int:
         conf.set(SERVE_SLO, args.slo)
     if args.slo_windows is not None:
         conf.set(SERVE_SLO_WINDOWS, args.slo_windows)
+    from .conf import FLEET_DIR, FLEET_HEARTBEAT_MS, FLEET_NAME
+
+    if args.fleet_dir is not None:
+        conf.set(FLEET_DIR, args.fleet_dir)
+    if args.fleet_name is not None:
+        conf.set(FLEET_NAME, args.fleet_name)
+    if args.heartbeat_ms is not None:
+        conf.set_int(FLEET_HEARTBEAT_MS, args.heartbeat_ms)
     daemon = BamDaemon(
         conf=conf,
         socket_path=args.socket,
@@ -513,6 +521,49 @@ def _cmd_serve(args) -> int:
         daemon.serve_forever()
     except KeyboardInterrupt:
         daemon.stop()
+    return 0
+
+
+def _cmd_fleet(args) -> int:
+    """Run the fleet front router: one address for N serve daemons
+    (consistent-hash routing on the cache file identity, federated
+    admission, heartbeat membership, journal adoption on an unclean
+    death)."""
+    from .conf import (
+        Configuration,
+        FLEET_DIR,
+        FLEET_FILE_TOKENS,
+        FLEET_HEARTBEAT_TIMEOUT_MS,
+        FLEET_MIGRATE_WARMTH,
+        FLEET_TOKENS,
+        FLEET_VNODES,
+    )
+    from .serve.router import FleetRouter
+
+    conf = Configuration()
+    conf.set(FLEET_DIR, args.fleet_dir)
+    if args.heartbeat_timeout_ms is not None:
+        conf.set_int(FLEET_HEARTBEAT_TIMEOUT_MS, args.heartbeat_timeout_ms)
+    if args.vnodes is not None:
+        conf.set_int(FLEET_VNODES, args.vnodes)
+    if args.fleet_tokens is not None:
+        conf.set_int(FLEET_TOKENS, args.fleet_tokens)
+    if args.file_tokens is not None:
+        conf.set_int(FLEET_FILE_TOKENS, args.file_tokens)
+    if args.migrate_warmth:
+        conf.set_boolean(FLEET_MIGRATE_WARMTH, True)
+    router = FleetRouter(
+        conf=conf, socket_path=args.socket, port=args.port
+    )
+    router.start()
+    print(
+        f"fleet router on {router.endpoint} "
+        f"(dir {router.fleet_dir}, {len(router.ring)} member(s))"
+    )
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        router.stop()
     return 0
 
 
@@ -883,8 +934,66 @@ def build_parser() -> argparse.ArgumentParser:
         "--slo-windows", default=None, metavar="FAST,SLOW",
         help="SLO sliding windows in seconds "
              "(hadoopbam.serve.slo-windows; default '60,600')")
+    s.add_argument(
+        "--fleet-dir", default=None, metavar="DIR",
+        help="join a fleet: publish an atomic member record (name, "
+             "endpoint, journal, flight recorder) in DIR and heartbeat "
+             "it (hadoopbam.fleet.dir) — the fleet router routes to "
+             "members it finds there")
+    s.add_argument(
+        "--fleet-name", default=None,
+        help="this member's fleet name (hadoopbam.fleet.member-name; "
+             "default daemon-<pid>)")
+    s.add_argument(
+        "--heartbeat-ms", type=int, default=None,
+        help="fleet heartbeat cadence (hadoopbam.fleet.heartbeat-ms; "
+             "default 500)")
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_serve)
+
+    s = sub.add_parser(
+        "fleet",
+        help="fleet front router: one UDS/TCP address for N serve "
+             "daemons — consistent-hash placement on the cache file "
+             "identity, federated admission, heartbeat membership, "
+             "journal adoption on an unclean death",
+    )
+    s.add_argument(
+        "--fleet-dir", required=True, metavar="DIR",
+        help="the shared fleet directory daemons heartbeat into "
+             "(hadoopbam.fleet.dir)")
+    s.add_argument(
+        "--socket", default=None,
+        help="router UDS socket path (default: a per-user "
+             "hbam-fleet-<uid>.sock under the temp dir; "
+             "hadoopbam.fleet.socket)")
+    s.add_argument(
+        "--port", type=int, default=None,
+        help="route on 127.0.0.1:PORT instead of a UDS socket "
+             "(hadoopbam.fleet.port)")
+    s.add_argument(
+        "--heartbeat-timeout-ms", type=int, default=None,
+        help="declare a member dead after this much heartbeat silence, "
+             "then consult its flight recorder before adopting "
+             "(hadoopbam.fleet.heartbeat-timeout-ms; default 3000)")
+    s.add_argument(
+        "--vnodes", type=int, default=None,
+        help="virtual nodes per member on the consistent-hash ring "
+             "(hadoopbam.fleet.vnodes; default 64)")
+    s.add_argument(
+        "--fleet-tokens", type=int, default=None,
+        help="fleet-wide admission pool in cost units "
+             "(hadoopbam.fleet.tokens; default 32)")
+    s.add_argument(
+        "--file-tokens", type=int, default=None,
+        help="per-file in-flight cap in cost units — the hot-file "
+             "starvation bound (hadoopbam.fleet.file-tokens; default 8)")
+    s.add_argument(
+        "--migrate-warmth", action="store_true",
+        help="on a planned member leave, ship its warm arena windows "
+             "to the new ring owners as compressed BGZF members "
+             "(hadoopbam.fleet.migrate-warmth)")
+    s.set_defaults(func=_cmd_fleet)
 
     s = sub.add_parser(
         "stats",

@@ -185,6 +185,35 @@ SERVE_SLO_WINDOWS = "hadoopbam.serve.slo-windows"
 # startup (serve/warmup.py) so first-request latency is warm; "false"
 # skips the warm-up (first requests then pay the compiles).
 SERVE_WARMUP = "hadoopbam.serve.warmup"
+# Fleet topology (PR 18, serve/fleet.py + serve/router.py): with
+# FLEET_DIR set, each daemon publishes an atomic member record (name,
+# endpoint, journal path, flight-recorder base) there and refreshes it
+# every FLEET_HEARTBEAT_MS; the front router builds its consistent-hash
+# ring from those records, declares a member dead after
+# FLEET_HEARTBEAT_TIMEOUT_MS of silence (then consults the flight
+# recorder before adopting its journal), and spreads ownership with
+# FLEET_VNODES virtual nodes per member.  FLEET_NAME defaults to
+# "daemon-<pid>".  Unset FLEET_DIR = the single-daemon topology,
+# untouched.
+FLEET_DIR = "hadoopbam.fleet.dir"
+FLEET_NAME = "hadoopbam.fleet.member-name"
+FLEET_HEARTBEAT_MS = "hadoopbam.fleet.heartbeat-ms"
+FLEET_HEARTBEAT_TIMEOUT_MS = "hadoopbam.fleet.heartbeat-timeout-ms"
+FLEET_VNODES = "hadoopbam.fleet.vnodes"
+# The router's own endpoint (UDS path, or a 127.0.0.1 TCP port; default
+# a per-user /tmp/hbam-fleet-<uid>.sock), and the federated admission
+# sizing: FLEET_TOKENS cost-units in flight across the whole fleet,
+# FLEET_FILE_TOKENS for any single routing key (the hot-file cap — one
+# zipfian head must not starve every other file's owner).
+FLEET_SOCKET = "hadoopbam.fleet.socket"
+FLEET_PORT = "hadoopbam.fleet.port"
+FLEET_TOKENS = "hadoopbam.fleet.tokens"
+FLEET_FILE_TOKENS = "hadoopbam.fleet.file-tokens"
+# "true" ships a draining member's warm arena windows to their new ring
+# owners as PR 15 compressed BGZF members before the ring drops it, so
+# a planned leave moves cache warmth instead of re-paying cold reads.
+# Default "false" (a kill is never migrated — the corpse can't export).
+FLEET_MIGRATE_WARMTH = "hadoopbam.fleet.migrate-warmth"
 # Error-handling policy: "strict" (default — any corrupt BGZF member or
 # unparseable record aborts the job, the pre-PR-7 behavior) or "salvage"
 # (quarantine corrupt members/records, re-sync the record chain via the

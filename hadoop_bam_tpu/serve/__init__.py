@@ -22,12 +22,17 @@ admitted requests overlapping in-flight device work):
   launches;
 - :mod:`~hadoop_bam_tpu.serve.endpoints` — ``view`` / ``flagstat``
   implementations shared byte-for-byte with the one-shot CLI
-  subcommands.
+  subcommands;
+- :mod:`~hadoop_bam_tpu.serve.fleet` + :mod:`~hadoop_bam_tpu.serve.router`
+  — N daemons behind one stdlib front router: consistent-hash placement
+  on the cache file identity, federated admission, heartbeat membership,
+  and journal adoption on an unclean death (PR 18).
 """
 
 from .admission import (
     ERROR_CODES,
     AdmissionController,
+    FleetLedger,
     ShedError,
 )
 from .arena import HbmArena
@@ -42,10 +47,12 @@ from .client import (
 )
 from .endpoints import ServeContext, flagstat, view_blob, view_records
 from .exemplars import ExemplarStore, TailSampler
+from .fleet import HashRing, Heartbeater, classify_death, file_key
 from .flightrec import AccessLog
 from .journal import JobJournal
+from .router import FleetRouter, default_router_socket_path
 from .server import BamDaemon, default_socket_path
-from .slo import SloMonitor, SloObjective, parse_objectives
+from .slo import SloMonitor, SloObjective, fold_slo, parse_objectives
 from .warmup import compile_count, ensure_compile_watcher, warm_kernels
 
 __all__ = [
@@ -53,9 +60,15 @@ __all__ = [
     "AdmissionController",
     "BamDaemon",
     "ExemplarStore",
+    "FleetLedger",
+    "FleetRouter",
+    "HashRing",
+    "Heartbeater",
     "SloMonitor",
     "SloObjective",
     "TailSampler",
+    "classify_death",
+    "fold_slo",
     "parse_objectives",
     "DeadlineExceededError",
     "ERROR_CODES",
@@ -71,9 +84,11 @@ __all__ = [
     "ServeShedError",
     "ShedError",
     "compile_count",
+    "default_router_socket_path",
     "default_socket_path",
     "ensure_compile_watcher",
     "file_identity",
+    "file_key",
     "flagstat",
     "view_blob",
     "view_records",

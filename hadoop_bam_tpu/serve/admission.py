@@ -272,3 +272,107 @@ class AdmissionController:
         with self._cond:
             self._in_use = max(0, self._in_use - cost)
             self._cond.notify_all()
+
+
+# -- fleet ledger -----------------------------------------------------------
+
+
+class FleetLedger:
+    """Router-side federated admission: a fleet-wide token pool plus a
+    per-file cap, accounted at the routing hop.
+
+    Each member daemon still runs its own :class:`AdmissionController`
+    (its bounded queue is the only queue — the router never queues, so
+    overload answers stay immediate).  What the members *cannot* see is
+    cross-daemon skew: a zipfian workload pins one hot file's warmth on
+    its ring owner, and without a fleet view that one daemon's clients
+    consume every retry slot while the rest of the fleet idles.  The
+    ledger therefore sheds at the front door on two rules:
+
+    - **fleet pool** — at most ``tokens`` cost-units in flight across
+      all members (sized ~N × a member's budget; a safety net, not the
+      primary gate);
+    - **per-file cap** — at most ``file_tokens`` cost-units in flight
+      for any single routing key, so one hot file saturates its owner
+      at a bounded rate and everyone else's files stay servable
+      (``fleet.admission.shed.file_hot``).
+
+    Sheds raise :class:`ShedError` with code :data:`SHED` and a backoff
+    hint proportional to the contention, which the client's typed-retry
+    path already honors.
+    """
+
+    def __init__(
+        self,
+        tokens: int,
+        file_tokens: int,
+        costs: Optional[Dict[str, int]] = None,
+        name: str = "fleet.admission",
+    ):
+        if tokens < 1:
+            raise ValueError("tokens must be >= 1")
+        if file_tokens < 1:
+            raise ValueError("file_tokens must be >= 1")
+        self.tokens = int(tokens)
+        self.file_tokens = int(file_tokens)
+        self.costs = dict(DEFAULT_COSTS if costs is None else costs)
+        self.name = name
+        self._lock = threading.Lock()
+        self._in_use = 0
+        self._by_key: Dict[str, int] = {}
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                f"{self.name}.tokens": self.tokens,
+                f"{self.name}.tokens_in_use": self._in_use,
+                f"{self.name}.hot_files": sum(
+                    1 for v in self._by_key.values()
+                    if v >= self.file_tokens
+                ),
+            }
+
+    def acquire(self, op: str, key: Optional[str]):
+        """Admit ``op`` against routing key ``key`` or raise
+        :class:`ShedError`; returns a release callable (idempotent).
+        Control-plane ops (no cost entry) pass untouched."""
+        cost = self.costs.get(op)
+        if cost is None or key is None:
+            return lambda: None
+        cost = min(int(cost), self.tokens)
+        with self._lock:
+            held = self._by_key.get(key, 0)
+            if held + cost > self.file_tokens:
+                METRICS.count(f"{self.name}.shed", 1)
+                METRICS.count(f"{self.name}.shed.file_hot", 1)
+                raise ShedError(
+                    SHED, 25 * (1 + held),
+                    f"file over fleet per-file cap ({held} + {cost} > "
+                    f"{self.file_tokens})",
+                )
+            if self._in_use + cost > self.tokens:
+                METRICS.count(f"{self.name}.shed", 1)
+                METRICS.count(f"{self.name}.shed.pool_full", 1)
+                raise ShedError(
+                    SHED, 25 * (1 + self._in_use // max(1, self.tokens)),
+                    f"fleet token pool exhausted ({self._in_use} + {cost} "
+                    f"> {self.tokens})",
+                )
+            self._by_key[key] = held + cost
+            self._in_use += cost
+        METRICS.count(f"{self.name}.admitted", 1)
+        released = [False]
+
+        def _release() -> None:
+            if released[0]:
+                return
+            released[0] = True
+            with self._lock:
+                self._in_use = max(0, self._in_use - cost)
+                left = self._by_key.get(key, 0) - cost
+                if left > 0:
+                    self._by_key[key] = left
+                else:
+                    self._by_key.pop(key, None)
+
+        return _release

@@ -120,6 +120,44 @@ class HbmArena:
                 METRICS.count(f"{self.name}.evict", 1)
             self._publish_gauges()
 
+    def keys(self) -> list:
+        """Snapshot of the held keys, LRU→MRU (fleet warmth export and
+        the report tooling walk this; the lock is not held across the
+        caller's iteration)."""
+        with self._lock:
+            return list(self._entries.keys())
+
+    def evict_stale(self, path: str, current_identity: tuple) -> int:
+        """Drop every window decoded under a *previous* identity of
+        ``path``: entries keyed ``(kind, (path, size, mtime_ns), ...)``
+        whose identity tuple names this path but is not
+        ``current_identity``.  The routed-daemon revalidation seam — a
+        file rewritten in place (same path, new size/mtime_ns) must not
+        serve yesterday's decoded windows.  Returns the number dropped;
+        counts ``serve.cache.stale_evict`` per entry."""
+        dropped = 0
+        with self._lock:
+            stale = [
+                k
+                for k in self._entries
+                if isinstance(k, tuple)
+                and len(k) >= 2
+                and isinstance(k[1], tuple)
+                and len(k[1]) == 3
+                and k[1][0] == path
+                and k[1] != current_identity
+            ]
+            for k in stale:
+                nb, b_old = self._entries.pop(k)
+                self.used_bytes -= nb
+                self._ledger_drop(b_old)
+                dropped += 1
+            if dropped:
+                self._publish_gauges()
+        if dropped:
+            METRICS.count("serve.cache.stale_evict", dropped)
+        return dropped
+
     def evict_lru(self, n: int = 1) -> int:
         """Forcibly drop the ``n`` least-recently-used entries — the OOM
         recovery lever: on a device ``RESOURCE_EXHAUSTED`` the serve

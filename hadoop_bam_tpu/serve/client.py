@@ -174,6 +174,14 @@ class ServeClient:
         span), the daemon continues it, and retries reuse it — one
         logical request is one trace whatever the transport did.  The
         id is kept in :attr:`last_trace_id`.
+
+        Connection-reset/refused on an idempotent op — the signature of
+        a daemon restart or a fleet hand-off — is retried with
+        *jittered* backoff (±50%, so a fleet of clients bounced off the
+        same dying daemon does not re-stampede it in lockstep), and the
+        retry is a first-class ``client.retry`` hop on the request's
+        trace: the waterfall names the transport failure and the pause
+        instead of showing an unexplained gap.
         """
         ambient = current_request()
         rctx = (
@@ -195,7 +203,9 @@ class ServeClient:
                            else "between retries")
                     )
                 obj = {**obj, "deadline_ms": rem}
-            pause = self.retry_backoff * (2 ** attempt)
+            pause = self.retry_backoff * (2 ** attempt) * random.uniform(
+                0.5, 1.5
+            )
             try:
                 return self._request_once(obj)
             except ServeShedError as e:
@@ -210,6 +220,12 @@ class ServeClient:
             except _RETRYABLE as e:
                 last = e
             if attempt + 1 < attempts:
+                rctx.annotate(
+                    "client.retry",
+                    attempt=attempt + 1,
+                    error=type(last).__name__,
+                    pause_ms=pause * 1e3,
+                )
                 time.sleep(pause)
         assert last is not None
         raise (
@@ -355,6 +371,45 @@ class ServeClient:
             req["trace_id"] = trace_id
             return self._request(req, idempotent=True)["exemplar"]
         return self._request(req, idempotent=True)["exemplars"]
+
+    def adopt(self, journal: str, source: Optional[str] = None) -> dict:
+        """Direct this daemon to adopt a dead peer's journal: replay it,
+        resume what the checkpoints can reproduce byte-identically under
+        fresh local job ids, report the rest lost.  Returns the reply
+        with ``adopted`` ({peer job id → local job id}) and ``lost``.
+        Deliberately NOT idempotent-retried: a re-sent adopt would
+        double-submit the resumable jobs (the fleet router, the normal
+        caller, sends it exactly once per death)."""
+        req = {"op": "adopt", "journal": journal}
+        if source is not None:
+            req["source"] = source
+        return self._request(req)
+
+    def warmth(
+        self,
+        path: str,
+        export: bool = False,
+        windows: Optional[list] = None,
+        level: int = 1,
+    ) -> dict:
+        """The daemon's warm arena windows for ``path``: list (default),
+        export as PR 15 compressed members (``export=True``), or install
+        shipped ``windows`` into the local arena.  Listing/export are
+        idempotent reads; an import is applied once."""
+        req = {"op": "warmth", "path": path}
+        if windows is not None:
+            req["windows"] = windows
+            return self._request(req)
+        if export:
+            req["export"] = True
+            req["level"] = level
+        return self._request(req, idempotent=True)
+
+    def fleet(self) -> dict:
+        """The front router's fleet view (ring ownership shares, member
+        liveness, hand-off history).  Only the router answers this op;
+        a plain daemon replies unknown-op."""
+        return self._request({"op": "fleet"}, idempotent=True)
 
     def metrics(self) -> str:
         """The daemon's metrics in Prometheus text exposition format

@@ -199,6 +199,11 @@ def _view_records_scan(
     picks the rows, so the records (and their order) match the BAM twin
     byte-for-byte."""
     ident = ctx.cache.identity(path)
+    # Revalidation seam (PR 18): `identity` stats the file fresh, so any
+    # arena window decoded under a previous (size, mtime_ns) vintage is
+    # purged here — a rewritten file re-warms instead of serving stale
+    # decoded records (`serve.cache.stale_evict`).
+    ctx.arena.evict_stale(path, ident)
     picks: List[Tuple[object, np.ndarray]] = []
     for s in fmt.get_splits([path]):
         if deadline is not None:
@@ -314,6 +319,10 @@ def view_records(
             "view.index", ms=(time.perf_counter() - t_idx) * 1e3
         )
     ident = ctx.cache.identity(path)
+    # Revalidate on every routed hit: windows of a stale vintage are
+    # invalidated now and re-warmed by the misses below (PR 18 satellite
+    # — an mtime change must never serve yesterday's decode).
+    ctx.arena.evict_stale(path, ident)
     picks: List[Tuple[object, np.ndarray]] = []
     from ..io.bam import BamInputFormat
     from ..io.splits import FileVirtualSplit
@@ -436,6 +445,7 @@ def flagstat(
     with span("serve.flagstat"):
         hdr, _ = ctx.cache.header(path)
         ident = ctx.cache.identity(path)
+        ctx.arena.evict_stale(path, ident)  # PR 18: revalidate on hit
         kind, fmt = _endpoint_format(ctx, path)
         counts = {k: 0 for k in FLAGSTAT_KEYS}
         rctx = current_request()

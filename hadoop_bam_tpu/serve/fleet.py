@@ -1,0 +1,488 @@
+"""Fleet plumbing: the ring, membership, death forensics, warmth packing.
+
+One daemon owns one TPU; the millions-of-users story needs N of them
+behind a front router (serve/router.py) without giving up anything the
+single daemon earned — warm caches, crash-safe jobs, explainable deaths.
+This module is the shared substrate both sides stand on:
+
+- :class:`HashRing` — consistent hashing over the existing
+  ``(path, size, mtime_ns)`` cache identity (:func:`file_key`), with
+  virtual nodes so ownership spreads evenly and the loss of one member
+  moves only that member's ranges.  Hashing is ``blake2b``, never
+  Python's salted ``hash()`` — every process in the fleet must agree on
+  ownership byte-for-byte.
+- **membership** — each daemon publishes one atomic JSON record in a
+  shared fleet directory (:func:`write_member` / :func:`read_members`)
+  and refreshes it on a heartbeat cadence (:class:`Heartbeater`).  The
+  record carries everything a post-mortem needs: endpoint, journal
+  path, flight-recorder base, pid.
+- :func:`classify_death` — the router's adopt/no-adopt evidence,
+  built on the PR 11 flight-recorder contract: a ring whose last
+  record is ``"final": true`` is a clean drain (nothing to adopt —
+  the daemon finished its jobs before exiting); records without a
+  final (including a torn final line, which replay drops) are an
+  unclean death; no ring at all is an unknown.  Unclean and unknown
+  both adopt — the PR 10 journal resume path is idempotent and
+  identity-checked, so adopting a clean corpse's journal would merely
+  find nothing to do, but skipping a real corpse loses jobs.
+- **warmth packing** (:func:`pack_windows` / :func:`unpack_windows`) —
+  a member's hot decoded arena windows shipped as PR 15 compressed
+  BGZF members, so a planned hand-off (member join, graceful drain)
+  moves cache warmth instead of re-paying cold reads.  The receiver
+  re-decodes through the same host chain walk + SoA gather the read
+  path uses, so an imported window answers requests byte-identically
+  to a locally-read one.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.tracing import METRICS
+from . import flightrec as flightrec_mod
+
+DEFAULT_VNODES = 64
+DEFAULT_HEARTBEAT_MS = 500
+#: A member whose record is older than this is presumed dead (the
+#: router then consults the flight recorder before adopting).
+DEFAULT_HEARTBEAT_TIMEOUT_MS = 3000
+
+#: Death verdicts, in decreasing order of certainty.
+CLEAN = "clean"
+UNCLEAN = "unclean"
+UNKNOWN = "unknown"
+
+
+def stable_hash(key: str) -> int:
+    """64-bit position on the ring.  ``blake2b`` (stdlib, unsalted):
+    every fleet process — daemons, router, report tools — must compute
+    identical ownership, which Python's per-process ``hash()`` salt
+    forbids."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+def file_key(path: str) -> str:
+    """The routing key: the serve-cache ``(path, size, mtime_ns)`` file
+    identity, flattened.  A rewritten file is a *different* key — its
+    warmth deliberately lands on (possibly) a different owner, because
+    the old owner's arena windows are stale for it anyway.  An unstatable
+    path degrades to the path alone (the request will fail downstream
+    with a real error; routing just has to be deterministic)."""
+    try:
+        st = os.stat(path)
+        return f"{path}|{st.st_size}|{st.st_mtime_ns}"
+    except OSError:
+        return path
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (thread-safe).
+
+    ``vnodes`` points per member; ownership of a key is the first point
+    clockwise from the key's hash.  Removing a member hands each of its
+    ranges to the next surviving point — no other key moves, which is
+    the whole reason the fleet can lose a daemon without a global cache
+    cold-start."""
+
+    def __init__(self, members: Tuple[str, ...] = (), vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        self._members: set = set()
+        for m in members:
+            self.add(m)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    @property
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def add(self, name: str) -> None:
+        with self._lock:
+            if name in self._members:
+                return
+            self._members.add(name)
+            for v in range(self.vnodes):
+                h = stable_hash(f"{name}#{v}")
+                i = bisect.bisect_left(self._points, h)
+                self._points.insert(i, h)
+                self._owners.insert(i, name)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            if name not in self._members:
+                return
+            self._members.discard(name)
+            keep = [
+                (p, o)
+                for p, o in zip(self._points, self._owners)
+                if o != name
+            ]
+            self._points = [p for p, _ in keep]
+            self._owners = [o for _, o in keep]
+
+    def owner(self, key: str) -> Optional[str]:
+        """The member owning ``key``, or None on an empty ring."""
+        with self._lock:
+            if not self._points:
+                return None
+            i = bisect.bisect_right(self._points, stable_hash(key))
+            return self._owners[i % len(self._owners)]
+
+    def owners(self, key: str, n: int = 2) -> List[str]:
+        """Preference list: the owner, then the next ``n - 1`` distinct
+        members clockwise — the router's retry/adoption order."""
+        with self._lock:
+            if not self._points:
+                return []
+            out: List[str] = []
+            i = bisect.bisect_right(self._points, stable_hash(key))
+            for k in range(len(self._owners)):
+                o = self._owners[(i + k) % len(self._owners)]
+                if o not in out:
+                    out.append(o)
+                    if len(out) >= n:
+                        break
+            return out
+
+    def successor(self, name: str) -> Optional[str]:
+        """The member that inherits ``name``'s primary range when it
+        dies: the first distinct owner clockwise from ``name``'s first
+        vnode.  The adoption target — deterministic, so every router
+        (and the report tool) names the same adopter."""
+        with self._lock:
+            if name not in self._members or len(self._members) < 2:
+                return None
+            h = stable_hash(f"{name}#0")
+            i = bisect.bisect_right(self._points, h)
+            for k in range(len(self._owners)):
+                o = self._owners[(i + k) % len(self._owners)]
+                if o != name:
+                    return o
+            return None
+
+    def shares(self) -> Dict[str, float]:
+        """Fraction of the hash space each member owns (the report
+        tool's balance column)."""
+        with self._lock:
+            if not self._points:
+                return {}
+            total = 1 << 64
+            out: Dict[str, float] = {m: 0.0 for m in self._members}
+            for i, p in enumerate(self._points):
+                prev = self._points[i - 1] if i else self._points[-1] - total
+                out[self._owners[i]] += (p - prev) / total
+            return out
+
+
+# -- membership -------------------------------------------------------------
+
+
+def member_path(fleet_dir: str, name: str) -> str:
+    return os.path.join(fleet_dir, f"{name}.json")
+
+
+def write_member(fleet_dir: str, rec: dict) -> None:
+    """Publish one member record atomically (tmp + rename — a reader
+    never sees a torn record, the spill-manifest stance)."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = member_path(fleet_dir, rec["name"])
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def remove_member(fleet_dir: str, name: str) -> None:
+    try:
+        os.unlink(member_path(fleet_dir, name))
+    except OSError:
+        pass
+
+
+def read_members(fleet_dir: str) -> Dict[str, dict]:
+    """Every parseable member record in the fleet directory.  A torn or
+    foreign file is skipped (membership reads must never fail the
+    router), counted as ``fleet.members.unreadable``."""
+    out: Dict[str, dict] = {}
+    try:
+        names = sorted(os.listdir(fleet_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(fleet_dir, fn), "r", encoding="utf-8") as f:
+                rec = json.load(f)
+            if isinstance(rec, dict) and rec.get("name"):
+                out[rec["name"]] = rec
+        except (OSError, ValueError):
+            METRICS.count("fleet.members.unreadable", 1)
+    return out
+
+
+def heartbeat_age_s(rec: dict, now: Optional[float] = None) -> float:
+    """Seconds since the member last heartbeat (inf for a garbled
+    record — an unreadable heartbeat is a missed one)."""
+    now = time.time() if now is None else now
+    try:
+        return max(0.0, now - float(rec["t_wall"]))
+    except (KeyError, TypeError, ValueError):
+        return float("inf")
+
+
+class Heartbeater:
+    """The daemon's membership pulse: re-publish the member record every
+    ``period_s`` until stopped.  ``source`` returns the current record
+    (the daemon closes over its live endpoint/draining state); the final
+    write on stop carries whatever the source then says — a draining
+    daemon's last heartbeat says ``draining: true``, which the router
+    reads as a planned exit, not a death."""
+
+    def __init__(
+        self, fleet_dir: str, source: Callable[[], dict],
+        period_s: float = DEFAULT_HEARTBEAT_MS / 1e3,
+    ):
+        self.fleet_dir = fleet_dir
+        self.period = max(0.02, float(period_s))
+        self._source = source
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+
+    def beat(self) -> None:
+        rec = dict(self._source() or {})
+        rec["t_wall"] = time.time()
+        rec["seq"] = self._seq
+        self._seq += 1
+        write_member(self.fleet_dir, rec)
+        METRICS.count("fleet.heartbeats", 1)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.beat()  # registered before the first request can route here
+        self._thread = threading.Thread(
+            target=self._run, name="hbam-fleet-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.beat()
+            except Exception:  # noqa: BLE001 - the pulse never kills
+                METRICS.count("fleet.heartbeat_errors", 1)
+
+    def stop(self, unregister: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            if unregister:
+                remove_member(self.fleet_dir, (self._source() or {}).get("name", ""))
+            else:
+                self.beat()  # one final record (drain state included)
+        except Exception:  # noqa: BLE001
+            METRICS.count("fleet.heartbeat_errors", 1)
+
+
+# -- death forensics --------------------------------------------------------
+
+
+def classify_death(flightrec_base: Optional[str]) -> dict:
+    """The flight-recorder verdict on a silent member, as the router
+    consumes it: ``{"verdict": clean|unclean|unknown, ...}``.
+
+    - **clean** — the ring's last surviving record is ``final: true``:
+      the daemon drained (finished its jobs) before exiting.  No adopt.
+    - **unclean** — records exist but the last one is not final (a
+      SIGKILL: the periodic snapshots stop mid-stream; a torn final
+      line is dropped by replay and lands here too).  Adopt.
+    - **unknown** — no ring was configured, or neither segment exists
+      (or nothing in them parses).  Adopt: absence of evidence of a
+      clean drain must not strand journaled jobs.
+    """
+    if not flightrec_base:
+        return {"verdict": UNKNOWN, "reason": "no flight recorder configured",
+                "snapshots": 0, "torn": 0}
+    seg0, seg1 = flightrec_mod.segment_paths(flightrec_base)
+    if not (os.path.exists(seg0) or os.path.exists(seg1)):
+        return {"verdict": UNKNOWN, "reason": "flight-recorder ring missing",
+                "snapshots": 0, "torn": 0}
+    snaps, torn = flightrec_mod.load_ring(flightrec_base)
+    if not snaps:
+        return {
+            "verdict": UNCLEAN, "snapshots": 0, "torn": torn,
+            "reason": "ring exists but holds no parseable snapshot "
+                      "(died before/while writing the baseline)",
+        }
+    last = snaps[-1]
+    if last.get("final"):
+        return {
+            "verdict": CLEAN, "snapshots": len(snaps), "torn": torn,
+            "reason": f"final snapshot present (seq {last.get('seq')})",
+        }
+    return {
+        "verdict": UNCLEAN, "snapshots": len(snaps), "torn": torn,
+        "reason": (
+            f"{len(snaps)} snapshots, none final"
+            + (f" ({torn} torn line(s) dropped)" if torn else "")
+        ),
+    }
+
+
+def should_adopt(verdict: str) -> bool:
+    """Adopt on anything but a proven clean drain (see
+    :func:`classify_death` — the resume path is identity-checked and
+    idempotent, so over-adopting is cheap and under-adopting loses
+    jobs)."""
+    return verdict != CLEAN
+
+
+# -- warmth packing ---------------------------------------------------------
+
+#: Arena key kinds a fleet migration understands, with the SoA field
+#: set each was decoded under (must match serve/endpoints.py).
+_KIND_FIELDS = {
+    "view": (
+        "refid", "pos", "flag", "rec_off", "rec_len", "l_read_name",
+        "n_cigar_op",
+    ),
+    "flagstat": ("flag", "rec_off", "rec_len"),
+}
+
+
+def _arena_keys_for(arena, path: str) -> List[tuple]:
+    """The arena keys holding windows of ``path`` (any identity vintage):
+    ``(kind, (path, size, mtime_ns), a, b)`` tuples as the endpoints
+    build them."""
+    out = []
+    for key in arena.keys():
+        if (
+            isinstance(key, tuple) and len(key) == 4
+            and key[0] in _KIND_FIELDS
+            and isinstance(key[1], tuple) and len(key[1]) == 3
+            and key[1][0] == path
+        ):
+            out.append(key)
+    return out
+
+
+def pack_windows(arena, path: str, level: int = 1, max_windows: int = 64) -> List[dict]:
+    """Export ``path``'s warm decoded windows as PR 15 compressed
+    members: each window's records are gathered into one dense
+    (block_size word + body) stream (``gather_record_array`` — dense so
+    the receiver can re-walk it from offset 0) and deflated into
+    ≤64 KiB BGZF members, the same wire format the mesh shuffle ships.
+    Only windows whose identity still matches the file on disk ship —
+    stale warmth must not out-live its file twice."""
+    import base64
+
+    from .. import native
+    from ..io.bam import gather_record_array
+    from .cache import file_identity
+
+    try:
+        ident = file_identity(path)
+    except OSError:
+        return []
+    windows: List[dict] = []
+    for key in _arena_keys_for(arena, path):
+        if key[1] != ident:
+            continue  # stale vintage: not worth shipping
+        batch = arena.get(key)
+        if batch is None or getattr(batch, "data", None) is None:
+            continue
+        try:
+            payload = gather_record_array(batch)
+        except Exception:  # noqa: BLE001 - unshippable window: skip, count
+            METRICS.count("fleet.migrate.export_errors", 1)
+            continue
+        if len(payload) == 0:
+            continue
+        blob = native.deflate_blocks(payload, level=level)
+        windows.append({
+            "kind": key[0],
+            "span": [int(key[2]), int(key[3])],
+            "n_records": int(batch.n_records),
+            "nbytes": int(len(payload)),
+            "members_b64": base64.b64encode(blob).decode("ascii"),
+        })
+        METRICS.count("fleet.migrate.windows", 1)
+        METRICS.count("fleet.migrate.bytes", len(blob))
+        if len(windows) >= max_windows:
+            break
+    return windows
+
+
+def unpack_windows(arena, path: str, windows: List[dict]) -> int:
+    """Install shipped windows into the local arena: inflate the BGZF
+    members, re-walk the record chain, re-gather the SoA columns — the
+    same decode the read path performs, so an imported window serves
+    requests byte-identically to a locally-read one.  Returns how many
+    windows were installed (a window whose identity no longer matches
+    the file on disk, or whose payload will not parse, is dropped and
+    counted, never fatal)."""
+    import base64
+
+    import numpy as np
+
+    from ..io.bam import RecordBatch
+    from ..spec import bam as bam_spec
+    from ..spec import bgzf as bgzf_spec
+    from .cache import file_identity
+
+    try:
+        ident = file_identity(path)
+    except OSError:
+        METRICS.count("fleet.migrate.stale_drop", len(windows))
+        return 0
+    installed = 0
+    for w in windows:
+        kind = w.get("kind")
+        fields = _KIND_FIELDS.get(kind)
+        span = w.get("span") or [0, 0]
+        if fields is None:
+            METRICS.count("fleet.migrate.import_errors", 1)
+            continue
+        try:
+            blob = base64.b64decode(w["members_b64"])
+            payload = np.frombuffer(
+                bgzf_spec.decompress_all(blob), dtype=np.uint8
+            )
+            offsets = bam_spec.record_offsets(payload)
+            soa = bam_spec.soa_decode(payload, offsets, fields=fields)
+            batch = RecordBatch(
+                soa=soa, data=payload, keys=np.empty(0, np.int64)
+            )
+            if w.get("n_records") not in (None, batch.n_records):
+                raise ValueError(
+                    f"window re-decode mismatch: {batch.n_records} records "
+                    f"!= shipped {w.get('n_records')}"
+                )
+            key = (kind, ident, int(span[0]), int(span[1]))
+            arena.hold(key, batch)
+            installed += 1
+            METRICS.count("fleet.migrate.imported", 1)
+        except Exception:  # noqa: BLE001 - a bad window is dropped, counted
+            METRICS.count("fleet.migrate.import_errors", 1)
+    return installed

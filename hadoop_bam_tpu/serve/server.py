@@ -58,6 +58,9 @@ from typing import Dict, List, Optional, Tuple
 from .. import faults
 from ..conf import (
     Configuration,
+    FLEET_DIR,
+    FLEET_HEARTBEAT_MS,
+    FLEET_NAME,
     SERVE_ACCESS_LOG,
     SERVE_ACCESS_LOG_BYTES,
     SERVE_ADMISSION_TOKENS,
@@ -92,6 +95,7 @@ from ..utils.tracing import (
     transfers_report,
 )
 from . import exemplars as exemplars_mod
+from . import fleet as fleet_mod
 from . import flightrec as flightrec_mod
 from . import journal as journal_mod
 from . import slo as slo_mod
@@ -117,7 +121,7 @@ DEFAULT_MAX_INFLIGHT = 2
 #: (and thereby running under the dispatch RequestContext).
 KNOWN_OPS = (
     "ping", "view", "flagstat", "sort", "job", "stats", "metrics",
-    "exemplars", "shutdown",
+    "exemplars", "adopt", "warmth", "shutdown",
 )
 
 #: Data-plane ops whose completions feed the tail sampler and the access
@@ -290,6 +294,17 @@ class BamDaemon:
             if access_log_path
             else None
         )
+        # Fleet membership (PR 18): with hadoopbam.fleet.dir set, the
+        # daemon publishes an atomic member record (name, endpoint,
+        # journal path, flight-recorder base) in the shared fleet
+        # directory and refreshes it on a heartbeat cadence; the front
+        # router (serve/router.py) builds its consistent-hash ring from
+        # these records and reads a gone-stale one as a death signal.
+        self.fleet_dir = self.conf.get(FLEET_DIR)
+        self.fleet_name = (
+            self.conf.get(FLEET_NAME) or f"daemon-{os.getpid()}"
+        )
+        self._heartbeater: Optional[fleet_mod.Heartbeater] = None
         self._drain_requested = threading.Event()
         self._started_snapshot = snapshot()
 
@@ -341,7 +356,31 @@ class BamDaemon:
         self._listener = lst
         if self._flightrec is not None:
             self._flightrec.start()
+        if self.fleet_dir:
+            # Heartbeat only after the endpoint is final (a TCP daemon
+            # learns its port at bind): the first record the router sees
+            # is already routable.
+            self._heartbeater = fleet_mod.Heartbeater(
+                self.fleet_dir,
+                self._fleet_member_record,
+                period_s=self.conf.get_int(
+                    FLEET_HEARTBEAT_MS, fleet_mod.DEFAULT_HEARTBEAT_MS
+                ) / 1e3,
+            )
+            self._heartbeater.start()
         METRICS.count("serve.daemon_starts", 1)
+
+    def _fleet_member_record(self) -> dict:
+        """The heartbeat payload: everything a router (or post-mortem
+        tool) needs to route to, or recover from, this daemon."""
+        return {
+            "name": self.fleet_name,
+            "endpoint": self.endpoint,
+            "journal": self.journal_path,
+            "flightrec": self.flightrec_path,
+            "pid": os.getpid(),
+            "draining": self._draining.is_set(),
+        }
 
     def _recover_journal(self) -> None:
         """Replay the journal: restore terminal states, resume what the
@@ -446,6 +485,13 @@ class BamDaemon:
         self._stop.set()
 
     def _shutdown_cleanup(self) -> None:
+        if self._heartbeater is not None:
+            # The final beat carries the current draining flag: a
+            # drained daemon's last record says so, and the router
+            # treats its silence as a planned exit (the flight
+            # recorder's final snapshot is the authoritative evidence).
+            self._heartbeater.stop()
+            self._heartbeater = None
         for h in list(self._handlers):
             h.join(timeout=5.0)
         self._job_pool.shutdown(wait=True)
@@ -713,9 +759,110 @@ class BamDaemon:
                 },
                 False,
             )
+        if op == "adopt":
+            # Fleet hand-off (control plane — a death must be recoverable
+            # even while this daemon sheds data-plane load): replay a
+            # dead peer's journal and resume what the checkpoints can
+            # reproduce byte-identically, under fresh local job ids.
+            return (self._adopt(req), False)
+        if op == "warmth":
+            # Arena warmth as a first-class surface: list, export as
+            # PR 15 compressed members, or install a peer's shipped
+            # windows (planned fleet hand-offs move warmth, not just
+            # jobs).
+            return (self._warmth(req), False)
         if op == "shutdown":
             return (self._drain(), True)
         return ({"ok": False, "error": f"unknown op {op!r}"}, False)
+
+    # -- fleet hand-off -----------------------------------------------------
+
+    def _adopt(self, req: dict) -> dict:
+        """Adopt a dead peer's journal (the router's recovery action).
+
+        Replays the peer journal, plans recovery exactly as a restart of
+        the peer would (:func:`~hadoop_bam_tpu.serve.journal.recovery_plan`
+        — inputs identity must still match and the request must carry a
+        persistent ``part_dir``), then resubmits each resumable job
+        under a *fresh local* job id, journaled locally durable-before-
+        submit so a crash of the adopter is itself recoverable.  Returns
+        ``{"adopted": {peer jid: local jid}, "lost": [...]}``."""
+        jpath = req.get("journal")
+        if not jpath:
+            return {"ok": False, "error": "adopt needs a journal path"}
+        try:
+            jobs = journal_mod.replay(jpath)
+        except (ValueError, OSError) as e:
+            METRICS.count("serve.adopt.journal_errors", 1)
+            return {
+                "ok": False,
+                "error": f"peer journal {jpath!r} unreadable: {e}",
+            }
+        plan = journal_mod.recovery_plan(jobs)
+        adopted: Dict[str, str] = {}
+        lost: List[str] = []
+        for peer_jid, action in sorted(plan.items()):
+            if action != "resume":
+                lost.append(peer_jid)
+                METRICS.count("serve.adopt.lost", 1)
+                continue
+            peer_req = dict(jobs[peer_jid]["req"])
+            with self._jobs_lock:
+                self._job_seq += 1
+                jid = f"job-{self._job_seq:04d}"
+                self._jobs[jid] = {
+                    "status": "queued",
+                    "output": peer_req.get("output"),
+                    "adopted_from": {
+                        "job": peer_jid,
+                        "source": req.get("source"),
+                    },
+                }
+            if self._journal is not None:
+                # Durable locally before the pool sees it — adoption
+                # re-homes the job's crash-safety, not just its work.
+                self._journal.submit(
+                    jid, peer_req, jobs[peer_jid].get("inputs")
+                )
+                self._journal.state(jid, "adopted", source=req.get("source"))
+            self._job_pool.submit(self._run_sort, jid, peer_req)
+            adopted[peer_jid] = jid
+            METRICS.count("serve.adopt.resumed", 1)
+        METRICS.count("serve.adoptions", 1)
+        return {
+            "ok": True,
+            "adopted": adopted,
+            "lost": lost,
+            "jobs_seen": len(jobs),
+        }
+
+    def _warmth(self, req: dict) -> dict:
+        """The arena-warmth surface behind the ``warmth`` op: list this
+        daemon's warm windows for a path, export them as compressed
+        members, or install windows a peer shipped."""
+        path = req.get("path")
+        if not path:
+            return {"ok": False, "error": "warmth needs a path"}
+        if req.get("windows") is not None:
+            installed = fleet_mod.unpack_windows(
+                self.ctx.arena, path, req["windows"]
+            )
+            return {"ok": True, "installed": installed}
+        keys = fleet_mod._arena_keys_for(self.ctx.arena, path)
+        if not req.get("export"):
+            return {
+                "ok": True,
+                "windows": [
+                    {"kind": k[0], "span": [int(k[2]), int(k[3])]}
+                    for k in keys
+                ],
+            }
+        return {
+            "ok": True,
+            "windows": fleet_mod.pack_windows(
+                self.ctx.arena, path, level=int(req.get("level", 1))
+            ),
+        }
 
     # -- sort jobs ----------------------------------------------------------
 

@@ -320,6 +320,81 @@ class SloMonitor:
         }
 
 
+def fold_slo(blocks: List[dict]) -> dict:
+    """Fold per-member ``evaluate()`` blocks into one fleet-wide SLO
+    judgment (the router's ``stats`` op).
+
+    An objective's error budget is a property of the *service*, not of
+    any one daemon, so the fold sums each window's request and bad
+    counts across members (same objective name → same window lengths,
+    since every member parses the same conf grammar) and recomputes the
+    burn from the summed fractions: ``burn = (Σbad/Σtotal) / (1-target)``
+    — a member serving 1% of the traffic cannot dominate the fleet burn,
+    and one fully-burning hot member shows up exactly in proportion to
+    its share.  ``alerting`` is the union (any member's confirmed
+    multiwindow breach is a fleet breach: the affected keys route only
+    to it); ``compliant`` requires the folded fast burn ≤ 1 for every
+    objective and no member alerting."""
+    folded: Dict[str, dict] = {}
+    alerting: List[str] = []
+    for block in blocks:
+        if not block:
+            continue
+        for name in block.get("alerting") or []:
+            if name not in alerting:
+                alerting.append(name)
+        for o in block.get("objectives", []):
+            f = folded.get(o["name"])
+            if f is None:
+                f = {
+                    k: o[k]
+                    for k in ("name", "op", "kind", "target", "threshold_ms")
+                    if k in o
+                }
+                f["windows"] = {
+                    w: {
+                        "seconds": o["windows"][w]["seconds"],
+                        "total": 0.0,
+                        "bad": 0.0,
+                    }
+                    for w in ("fast", "slow")
+                }
+                f["members"] = 0
+                folded[o["name"]] = f
+            f["members"] += 1
+            for w in ("fast", "slow"):
+                f["windows"][w]["total"] += o["windows"][w]["total"]
+                f["windows"][w]["bad"] += o["windows"][w]["bad"]
+    objectives = []
+    worst = None
+    for f in folded.values():
+        for w in ("fast", "slow"):
+            win = f["windows"][w]
+            good = win["total"] - win["bad"]
+            burn = SloMonitor._burn(good, win["total"], f["target"])
+            win["burn"] = round(burn, 4)
+            win["compliant"] = burn <= 1.0
+            win["bad"] = round(win["bad"], 3)
+        f["alerting"] = f["name"] in alerting
+        objectives.append(f)
+        fb = f["windows"]["fast"]["burn"]
+        if worst is None or fb > worst["burn_fast"]:
+            worst = {
+                "name": f["name"], "op": f["op"],
+                "burn_fast": fb,
+                "burn_slow": f["windows"]["slow"]["burn"],
+            }
+    return {
+        "objectives": objectives,
+        "alerting": alerting,
+        "compliant": not alerting and all(
+            o["windows"]["fast"]["compliant"] for o in objectives
+        ),
+        "worst": worst,
+        "members": len([b for b in blocks if b]),
+    }
+
+
 def format_slo_block(slo: dict) -> str:
     """Human rendering of the ``stats`` op's ``slo`` block (the CLI
     ``stats`` subcommand and post-mortem replays share it)."""

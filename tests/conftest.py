@@ -84,6 +84,12 @@ def pytest_configure(config):
         "real accelerator, skipped when JAX_PLATFORMS pins cpu "
         "(interpret-mode small-slice tests run everywhere)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: serve-fleet (router/ring/adoption) tests; the "
+        "in-process <=3-daemon smoke is always-on, the multi-process "
+        "kill -9 drill also carries `slow`",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

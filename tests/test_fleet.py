@@ -1,0 +1,894 @@
+"""Serve fleet (PR 18): ring, death forensics, federated admission,
+router smoke, journal adoption.
+
+Always-on under the CPU pin: the fleet substrate is host-orchestration
+code (consistent hashing, heartbeat records, JSONL forensics, socket
+routing), and the in-process smoke keeps itself to <=3 in-thread daemons
+per the satellite budget.  The real multi-process kill -9 drill rides
+the ``slow`` marker (tier-1 excludes it; the bench fleet leg runs the
+same drill with timings).
+
+Warmth and recovery claims are asserted as counter deltas and byte
+comparisons, not inferred: ``serve.cache.stale_evict``,
+``fleet.deaths.unclean``, ``fleet.jobs_adopted``, and adopted-sort
+output bytes vs an uninterrupted oracle.
+"""
+
+import base64
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from hadoop_bam_tpu.conf import (
+    FLEET_DIR,
+    FLEET_HEARTBEAT_MS,
+    FLEET_NAME,
+    Configuration,
+)
+from hadoop_bam_tpu.pipeline import sort_bam
+from hadoop_bam_tpu.serve import (
+    BamDaemon,
+    FleetRouter,
+    HashRing,
+    ServeClient,
+    ShedError,
+)
+from hadoop_bam_tpu.serve import fleet as fleet_mod
+from hadoop_bam_tpu.serve import journal as journal_mod
+from hadoop_bam_tpu.serve.admission import SHED, FleetLedger
+from hadoop_bam_tpu.serve.client import ServeConnectionError
+from hadoop_bam_tpu.spec import indices
+from hadoop_bam_tpu.utils.tracing import (
+    RequestContext,
+    delta,
+    request_scope,
+    snapshot,
+)
+from tests.test_serve import _write_unsorted_bam
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring: determinism + minimal movement
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_deterministic_across_instances():
+    """Routing must be a pure function of (members, key): a restarted
+    router — or the offline fleet_report rebuild — lands every key on
+    the same owner (blake2b, not the salted builtin hash)."""
+    members = ("alpha", "bravo", "charlie", "delta")
+    keys = [f"/data/run{i}.bam|{1000 + i}|{i * 7}" for i in range(200)]
+    r1, r2 = HashRing(members), HashRing(members)
+    assert [r1.owner(k) for k in keys] == [r2.owner(k) for k in keys]
+    shares = r1.shares()
+    assert set(shares) == set(members)
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    # owners(): primary first, distinct successor second.
+    for k in keys[:20]:
+        owners = r1.owners(k, n=2)
+        assert owners[0] == r1.owner(k)
+        assert len(set(owners)) == 2
+
+
+def test_ring_removal_moves_only_the_dead_members_keys():
+    """The consistent-hashing contract the warmth placement rests on:
+    burying one member reassigns *its* keys and nobody else's."""
+    members = ("alpha", "bravo", "charlie", "delta")
+    keys = [f"/data/s{i}.bam|{i}|{i}" for i in range(500)]
+    ring = HashRing(members)
+    before = {k: ring.owner(k) for k in keys}
+    ring.remove("charlie")
+    after = {k: ring.owner(k) for k in keys}
+    for k in keys:
+        if before[k] != "charlie":
+            assert after[k] == before[k]
+        else:
+            assert after[k] != "charlie"
+    # And identically on a ring that never contained the dead member.
+    fresh = HashRing(("alpha", "bravo", "delta"))
+    assert after == {k: fresh.owner(k) for k in keys}
+
+
+def test_file_key_tracks_cache_identity(tmp_path):
+    """A rewritten file must hash elsewhere *by construction*: the
+    routing key embeds (size, mtime_ns), the serve cache identity."""
+    p = str(tmp_path / "a.bam")
+    with open(p, "wb") as f:
+        f.write(b"x" * 10)
+    k1 = fleet_mod.file_key(p)
+    st = os.stat(p)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    k2 = fleet_mod.file_key(p)
+    assert k1 != k2
+    assert fleet_mod.file_key(str(tmp_path / "missing.bam")) == str(
+        tmp_path / "missing.bam"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Death forensics (satellite: unclean-death classification fixtures as
+# the router consumes them — adopt/no-adopt per verdict)
+# ---------------------------------------------------------------------------
+
+
+def _write_ring(base: str, lines) -> None:
+    with open(base + ".0", "w") as f:
+        for ln in lines:
+            f.write(ln + "\n" if not ln.endswith("\n") else ln)
+
+
+def _snap(seq: int, final: bool = False) -> str:
+    import json
+
+    return json.dumps(
+        {"seq": seq, "final": final, "t_wall": 1000.0 + seq}
+    )
+
+
+def test_classify_death_clean_shutdown_no_adopt(tmp_path):
+    base = str(tmp_path / "flight")
+    _write_ring(base, [_snap(0), _snap(1), _snap(2, final=True)])
+    v = fleet_mod.classify_death(base)
+    assert v["verdict"] == "clean" and v["snapshots"] == 3
+    assert not fleet_mod.should_adopt(v["verdict"])
+
+
+def test_classify_death_truncated_final_record_adopts(tmp_path):
+    """kill -9 mid-drain: the final snapshot is torn mid-line, replay
+    drops it, and the surviving tail is non-final -> unclean, adopt.
+    This is exactly the record the router's monitor reads."""
+    base = str(tmp_path / "flight")
+    final = _snap(3, final=True)
+    _write_ring(base, [_snap(0), _snap(1), _snap(2)])
+    with open(base + ".0", "a") as f:
+        f.write(final[: len(final) // 2])  # torn: no trailing newline
+    v = fleet_mod.classify_death(base)
+    assert v["verdict"] == "unclean"
+    assert v["snapshots"] == 3 and v["torn"] >= 1
+    assert fleet_mod.should_adopt(v["verdict"])
+
+
+def test_classify_death_missing_ring_is_unknown_and_adopts(tmp_path):
+    v = fleet_mod.classify_death(str(tmp_path / "never-written"))
+    assert v["verdict"] == "unknown"
+    assert fleet_mod.should_adopt(v["verdict"])
+    v = fleet_mod.classify_death(None)
+    assert v["verdict"] == "unknown" and fleet_mod.should_adopt("unknown")
+
+
+def test_classify_death_unparseable_ring_is_unclean(tmp_path):
+    """Segments exist but nothing parses (died while writing the
+    baseline): absence of a *proven* clean drain must adopt."""
+    base = str(tmp_path / "flight")
+    _write_ring(base, ["{torn json", "also not json"])
+    v = fleet_mod.classify_death(base)
+    assert v["verdict"] == "unclean" and v["snapshots"] == 0
+    assert fleet_mod.should_adopt(v["verdict"])
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat membership records
+# ---------------------------------------------------------------------------
+
+
+def test_member_records_roundtrip_and_tolerate_garbage(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    fleet_mod.write_member(fdir, {"name": "a", "t_wall": time.time()})
+    fleet_mod.write_member(fdir, {"name": "b", "t_wall": time.time() - 60})
+    with open(os.path.join(fdir, "corrupt.json"), "w") as f:
+        f.write("{half a record")
+    recs = fleet_mod.read_members(fdir)
+    assert set(recs) == {"a", "b"}
+    assert fleet_mod.heartbeat_age_s(recs["a"]) < 5
+    assert fleet_mod.heartbeat_age_s(recs["b"]) > 30
+    fleet_mod.remove_member(fdir, "a")
+    assert set(fleet_mod.read_members(fdir)) == {"b"}
+
+
+def test_heartbeater_refreshes_and_final_beat_carries_draining(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    state = {"draining": False}
+
+    def source():
+        return {"name": "hb", "draining": state["draining"]}
+
+    hb = fleet_mod.Heartbeater(fdir, source, period_s=0.05)
+    hb.start()
+    try:
+        time.sleep(0.2)
+        rec = fleet_mod.read_members(fdir)["hb"]
+        assert rec["seq"] >= 2 and not rec["draining"]
+    finally:
+        state["draining"] = True
+        hb.stop()
+    rec = fleet_mod.read_members(fdir)["hb"]
+    assert rec["draining"] is True  # the final beat announces the drain
+
+
+# ---------------------------------------------------------------------------
+# Federated admission: the fleet ledger
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_ledger_per_file_cap_sheds_hot_file():
+    led = FleetLedger(tokens=64, file_tokens=2)
+    key = "/hot.bam|1|1"
+    s0 = snapshot()
+    r1 = led.acquire("view", key)
+    r2 = led.acquire("view", key)
+    with pytest.raises(ShedError) as ei:
+        led.acquire("view", key)
+    assert ei.value.code == SHED and ei.value.retry_after_ms > 0
+    # A *different* file is untouched by the hot one's cap.
+    r3 = led.acquire("view", "/cold.bam|1|1")
+    d = delta(s0)["counters"]
+    assert d["fleet.admission.shed.file_hot"] == 1
+    assert d["fleet.admission.admitted"] == 3
+    for rel in (r1, r2, r3):
+        rel()
+        rel()  # idempotent
+    assert led.gauges()["fleet.admission.tokens_in_use"] == 0
+
+
+def test_fleet_ledger_pool_exhaustion_and_control_plane_bypass():
+    led = FleetLedger(tokens=8, file_tokens=8)
+    rels = [led.acquire("sort", f"/s{i}.bam|1|1") for i in range(2)]  # 4+4
+    s0 = snapshot()
+    with pytest.raises(ShedError):
+        led.acquire("view", "/v.bam|1|1")
+    assert delta(s0)["counters"]["fleet.admission.shed.pool_full"] == 1
+    # Ops without a cost entry (control plane) always pass.
+    led.acquire("fleet", "/v.bam|1|1")()
+    led.acquire("view", None)()
+    rels[0]()
+    led.acquire("view", "/v.bam|1|1")()
+
+
+# ---------------------------------------------------------------------------
+# Client retry (satellite: jittered backoff + client.retry trace hop)
+# ---------------------------------------------------------------------------
+
+
+class _CapturingCtx(RequestContext):
+    """An ambient context whose children are kept for inspection."""
+
+    children = None  # set per-instance below (RequestContext has slots)
+
+    def child(self, op=""):
+        c = super().child(op)
+        _CHILDREN.append(c)
+        return c
+
+
+_CHILDREN = []
+
+
+def test_client_retry_annotates_trace_with_jittered_backoff(monkeypatch):
+    del _CHILDREN[:]
+    calls = {"n": 0}
+
+    def flaky(self, obj):
+        calls["n"] += 1
+        if calls["n"] <= 1:
+            raise ConnectionResetError("peer restarted")
+        return {"ok": True, "pong": True}
+
+    monkeypatch.setattr(ServeClient, "_request_once", flaky)
+    client = ServeClient(socket_path="/nonexistent.sock", retries=2,
+                         retry_backoff=0.001)
+    amb = _CapturingCtx("ab" * 16, "cd" * 8, op="test")
+    with request_scope(amb):
+        assert client.ping()["pong"]
+    assert calls["n"] == 2
+    # The retry is a first-class hop on the SAME trace the ambient
+    # scope originated (not a new trace, not a silent sleep).
+    (rctx,) = _CHILDREN
+    assert rctx.trace_id == amb.trace_id == client.last_trace_id
+    hops = [h for h in rctx.hops if h["hop"] == "client.retry"]
+    assert len(hops) == 1
+    assert hops[0]["attempt"] == 1
+    assert hops[0]["error"] == "ConnectionResetError"
+    assert hops[0]["pause_ms"] > 0
+
+
+def test_client_retry_backoff_is_jittered(monkeypatch):
+    """Exhaust every attempt: the recorded pauses must not be the
+    lockstep 2**n ladder (a fleet of clients bounced off one dying
+    daemon must not re-stampede it in phase)."""
+    del _CHILDREN[:]
+
+    def always_down(self, obj):
+        raise ConnectionRefusedError("down")
+
+    monkeypatch.setattr(ServeClient, "_request_once", always_down)
+    client = ServeClient(socket_path="/nonexistent.sock", retries=4,
+                         retry_backoff=0.0001)
+    amb = _CapturingCtx("ef" * 16, "01" * 8, op="test")
+    with request_scope(amb), pytest.raises(ServeConnectionError):
+        client.ping()
+    (rctx,) = _CHILDREN
+    pauses = [h["pause_ms"] for h in rctx.hops if h["hop"] == "client.retry"]
+    assert len(pauses) == 4
+    # De-jittered, pause/2**attempt would be constant; jitter spreads it.
+    normalized = [p / 2 ** (i + 1) for i, p in enumerate(pauses)]
+    assert max(normalized) - min(normalized) > 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Cache-identity staleness (satellite: revalidate on hit, stale_evict)
+# ---------------------------------------------------------------------------
+
+
+def _start_daemon(tmp_path, name="d", conf_extra=None, **kw):
+    sock = str(tmp_path / f"{name}.sock")
+    conf = Configuration(dict(conf_extra or {}))
+    d = BamDaemon(socket_path=sock, warmup=False, conf=conf, **kw)
+    ready = threading.Event()
+    t = threading.Thread(target=d.serve_forever, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(30), "daemon did not come up"
+    return d, t, ServeClient(socket_path=sock)
+
+
+def test_stale_arena_windows_evicted_on_identity_change(sorted_bam_copy):
+    """The staleness hole the satellite closes: a file rewritten in
+    place between requests must not serve windows decoded under the old
+    identity.  The endpoint revalidates on every hit — the stale
+    vintage is evicted (``serve.cache.stale_evict``) and the answer is
+    re-decoded, identical bytes."""
+    path, tmp_path = sorted_bam_copy
+    d, t, client = _start_daemon(tmp_path)
+    try:
+        first = client.view(path, "chr1:100000-300000", level=1)
+        warm = client.view(path, "chr1:100000-300000", level=1)
+        assert warm == first
+        # Rewrite-in-place stand-in: same bytes, bumped mtime_ns ->
+        # new cache identity, every held window is a stale vintage.
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        s0 = snapshot()
+        again = client.view(path, "chr1:100000-300000", level=1)
+        dlt = delta(s0)["counters"]
+        assert dlt.get("serve.cache.stale_evict", 0) >= 1
+        assert again == first  # same underlying bytes -> same answer
+        # The re-warmed vintage is current: a further hit is clean.
+        s1 = snapshot()
+        assert client.view(path, "chr1:100000-300000", level=1) == first
+        assert delta(s1)["counters"].get("serve.cache.stale_evict", 0) == 0
+    finally:
+        client.shutdown()
+        t.join(timeout=20)
+
+
+@pytest.fixture()
+def sorted_bam_copy(sorted_bam, tmp_path):
+    """A private copy of the module-scope sorted BAM: staleness tests
+    mutate mtime and must not poison other tests' cache identity."""
+    import shutil
+
+    dst = str(tmp_path / "private.bam")
+    shutil.copyfile(sorted_bam, dst)
+    shutil.copyfile(sorted_bam + ".bai", dst + ".bai")
+    return dst, tmp_path
+
+
+@pytest.fixture(scope="module")
+def sorted_bam(tmp_path_factory) -> str:
+    tmp = tmp_path_factory.mktemp("fleet")
+    src = str(tmp / "unsorted.bam")
+    out = str(tmp / "sorted.bam")
+    _write_unsorted_bam(src)
+    sort_bam([src], out, backend="host")
+    with open(out + ".bai", "wb") as f:
+        indices.build_bai(out).save(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Journal adoption: the daemon-side `adopt` op
+# ---------------------------------------------------------------------------
+
+
+def test_adopt_resumes_peer_journal_byte_identical(tmp_path):
+    """A dead peer's journal, adopted cold: the resumable job re-runs
+    under the adopter and its output is byte-identical to an
+    uninterrupted run; jobs that cannot be honestly re-run are reported
+    lost, not silently dropped."""
+    src = str(tmp_path / "in.bam")
+    _write_unsorted_bam(src, n=240, seed=5)
+    oracle = str(tmp_path / "oracle.bam")
+    sort_bam([src], oracle, backend="host", level=1)
+
+    # The corpse's journal: one resumable sort (inputs identity still
+    # current, persistent part_dir) + one lost (stale identity).
+    peer_journal = str(tmp_path / "peer.jsonl")
+    out = str(tmp_path / "adopted-out.bam")
+    j = journal_mod.JobJournal(peer_journal)
+    req = {
+        "bam": [src], "output": out, "level": 1,
+        "part_dir": str(tmp_path / "parts"),
+    }
+    j.submit("job-0001", req, journal_mod.input_identity([src]))
+    j.state("job-0001", "running")
+    gone = {"bam": [str(tmp_path / "gone.bam")],
+            "output": str(tmp_path / "x.bam"),
+            "part_dir": str(tmp_path / "parts2")}
+    j.submit("job-0002", gone, [
+        {"path": str(tmp_path / "gone.bam"), "size": 1, "mtime_ns": 1}
+    ])
+    j.state("job-0002", "running")
+    # Terminal before the death: no action, and NOT reported lost.
+    j.submit("job-0003", dict(req), journal_mod.input_identity([src]))
+    j.state("job-0003", "done")
+    j.close()
+
+    d, t, client = _start_daemon(
+        tmp_path, journal_path=str(tmp_path / "adopter.jsonl")
+    )
+    try:
+        s0 = snapshot()
+        r = client.adopt(peer_journal, source="corpse")
+        assert r["ok"] and r["jobs_seen"] == 3
+        assert list(r["adopted"]) == ["job-0001"]
+        assert r["lost"] == ["job-0002"]
+        local = r["adopted"]["job-0001"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            jr = client.job(local)
+            if jr["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert jr["status"] == "done", jr
+        assert jr["adopted_from"] == {"job": "job-0001", "source": "corpse"}
+        with open(out, "rb") as f1, open(oracle, "rb") as f2:
+            assert f1.read() == f2.read()
+        dlt = delta(s0)["counters"]
+        assert dlt["serve.adopt.resumed"] == 1
+        assert dlt["serve.adopt.lost"] == 1
+        # Adoption re-homed the job's crash-safety too: the adopter's
+        # own journal replays it as a terminal (done) job.
+        jobs = journal_mod.replay(str(tmp_path / "adopter.jsonl"))
+        assert jobs[local]["status"] == "done"
+    finally:
+        client.shutdown()
+        t.join(timeout=20)
+
+
+# ---------------------------------------------------------------------------
+# Router smoke: <=3 in-thread daemons, placement, warmth, fake death
+# ---------------------------------------------------------------------------
+
+
+def _start_fleet(tmp_path, names, fdir):
+    daemons = []
+    for name in names:
+        d, t, c = _start_daemon(
+            tmp_path, name=name,
+            conf_extra={
+                FLEET_DIR: fdir, FLEET_NAME: name,
+                FLEET_HEARTBEAT_MS: "100",
+            },
+            journal_path=str(tmp_path / f"{name}.jsonl"),
+        )
+        daemons.append((name, d, t, c))
+    return daemons
+
+
+def _start_router(tmp_path, fdir, **kw):
+    router = FleetRouter(
+        fleet_dir=fdir,
+        socket_path=str(tmp_path / "router.sock"),
+        **kw,
+    )
+    ready = threading.Event()
+    rt = threading.Thread(
+        target=router.serve_forever, args=(ready,), daemon=True
+    )
+    rt.start()
+    assert ready.wait(30), "router did not come up"
+    return router, rt, ServeClient(socket_path=router.socket_path)
+
+
+def test_router_places_by_identity_and_folds_the_fleet(
+    sorted_bam, tmp_path
+):
+    """The 3-daemon in-process smoke: one router address, consistent
+    placement (every request for one file lands on one member, so its
+    warmth accumulates there and nowhere else), fleet view coherent,
+    control-plane fan-out folds per-member stats."""
+    fdir = str(tmp_path / "fleet")
+    daemons = _start_fleet(tmp_path, ["m-a", "m-b", "m-c"], fdir)
+    router, rt, client = _start_router(tmp_path, fdir)
+    try:
+        ping = client.ping()
+        assert ping["router"] is True and ping["members"] == 3
+
+        view = client.fleet()
+        assert set(view["members"]) == {"m-a", "m-b", "m-c"}
+        assert abs(sum(view["ring"]["shares"].values()) - 1.0) < 1e-3
+
+        oracle = None
+        owner = None
+        for i in range(6):  # zipfian head: one hot file, repeated
+            r = client._request(
+                {"op": "view", "path": sorted_bam,
+                 "region": "chr1:100000-300000", "level": 1},
+                idempotent=True,
+            )
+            owner = owner or r["member"]
+            assert r["member"] == owner  # placement is sticky
+            blob = base64.b64decode(r["data_b64"])
+            oracle = oracle or blob
+            assert blob == oracle
+        # The warmth accumulated on the owner and ONLY the owner.
+        per_member = client.stats()["members"]
+        for name, st in per_member.items():
+            entries = st["arena"]["entries"]
+            if name == owner:
+                assert entries >= 1
+            else:
+                assert entries == 0
+        # flagstat routes through the same ring -> same owner.
+        fs = client._request(
+            {"op": "flagstat", "path": sorted_bam}, idempotent=True
+        )
+        assert fs["member"] == owner
+    finally:
+        client.shutdown()
+        router.stop()
+        rt.join(timeout=20)
+        for _, _, t, c in daemons:
+            c.shutdown()
+            t.join(timeout=20)
+
+
+def test_router_adopts_unclean_death_and_aliases_jobs(tmp_path):
+    """The recovery seam end to end, in process: a member goes silent
+    with a non-final flight-recorder ring and a journaled in-flight
+    sort; the router's scan classifies the death unclean, the ring
+    successor adopts the journal, the job completes byte-identically,
+    and the dead member's namespaced job id still answers through the
+    router's alias chase."""
+    src = str(tmp_path / "in.bam")
+    _write_unsorted_bam(src, n=240, seed=9)
+    oracle = str(tmp_path / "oracle.bam")
+    sort_bam([src], oracle, backend="host", level=1)
+
+    fdir = str(tmp_path / "fleet")
+    daemons = _start_fleet(tmp_path, ["live-a", "live-b"], fdir)
+    router, rt, client = _start_router(
+        tmp_path, fdir, heartbeat_timeout_ms=600.0
+    )
+    try:
+        # A ghost member joins (fresh heartbeat, real journal, real
+        # unclean flight-recorder ring, endpoint pointing nowhere)...
+        out = str(tmp_path / "ghost-out.bam")
+        gj = str(tmp_path / "ghost.jsonl")
+        j = journal_mod.JobJournal(gj)
+        j.submit(
+            "job-0001",
+            {"bam": [src], "output": out, "level": 1,
+             "part_dir": str(tmp_path / "ghost-parts")},
+            journal_mod.input_identity([src]),
+        )
+        j.state("job-0001", "running")
+        j.close()
+        fbase = str(tmp_path / "ghost-flight")
+        _write_ring(fbase, [_snap(0), _snap(1)])  # no final: SIGKILL
+        ghost = {
+            "name": "ghost", "journal": gj, "flightrec": fbase,
+            "endpoint": {"socket": str(tmp_path / "ghost.sock")},
+            "t_wall": time.time(), "seq": 1, "pid": 999999,
+        }
+        fleet_mod.write_member(fdir, ghost)
+        router.scan_members()
+        assert "ghost" in client.fleet()["members"]
+
+        # ...then goes silent: its record ages past the timeout.
+        s0 = snapshot()
+        fleet_mod.write_member(fdir, {**ghost, "t_wall": time.time() - 30})
+        router.scan_members()
+        view = client.fleet()
+        assert "ghost" not in view["members"]
+        dead = view["dead"]["ghost"]
+        assert dead["forensics"]["verdict"] == "unclean"
+        assert dead["adopter"] in ("live-a", "live-b")
+        assert dead["adopted"] == {"job-0001": dead["adopted"]["job-0001"]}
+        dlt = delta(s0)["counters"]
+        assert dlt["fleet.deaths.unclean"] == 1
+        assert dlt["fleet.jobs_adopted"] == 1
+
+        # The client's pre-death handle follows the job to its new home.
+        fleet_jid = "ghost:job-0001"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            jr = client._request({"op": "job", "id": fleet_jid},
+                                 idempotent=True)
+            if jr["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert jr["status"] == "done", jr
+        assert jr["member"] == dead["adopter"]
+        with open(out, "rb") as f1, open(oracle, "rb") as f2:
+            assert f1.read() == f2.read()  # zero lost, byte-identical
+        hand = [h for h in view["handoffs"] if h["member"] == "ghost"]
+        assert hand and hand[-1]["lost"] == []
+    finally:
+        client.shutdown()
+        router.stop()
+        rt.join(timeout=20)
+        for _, _, t, c in daemons:
+            c.shutdown()
+            t.join(timeout=20)
+
+
+def test_router_retries_read_on_successor_and_drops_draining(
+    sorted_bam, tmp_path
+):
+    """Owner socket dead -> an idempotent read retries once on the ring
+    successor with a ``router.retry`` hop; a draining member leaves the
+    ring cleanly (no forensics, no adoption)."""
+    import shutil
+
+    fdir = str(tmp_path / "fleet")
+    daemons = _start_fleet(tmp_path, ["r-a", "r-b"], fdir)
+    # Generous timeout: the hole member heartbeats exactly once, and it
+    # must stay "alive" (in the ring) for the whole retry exercise.
+    router, rt, client = _start_router(
+        tmp_path, fdir, heartbeat_timeout_ms=60_000.0
+    )
+    try:
+        # A fresh-but-unreachable member takes part of the ring: any
+        # read it owns must fail over to the live successor.
+        fleet_mod.write_member(fdir, {
+            "name": "r-hole",
+            "endpoint": {"socket": str(tmp_path / "nowhere.sock")},
+            "t_wall": time.time(), "seq": 1,
+        })
+        router.scan_members()
+        # Stage identities until >=1 hashes to the hole (1/3 share per
+        # member: 12 misses in a row is ~1e-2 — then we mint more).
+        holed, others = [], []
+        i = 0
+        while not holed and i < 48:
+            p = str(tmp_path / f"v{i}.bam")
+            shutil.copyfile(sorted_bam, p)
+            shutil.copyfile(sorted_bam + ".bai", p + ".bai")
+            (holed if router.ring.owner(fleet_mod.file_key(p))
+             == "r-hole" else others).append(p)
+            i += 1
+        assert holed, "48 distinct identities never hashed to the hole"
+        s0 = snapshot()
+        r = client._request(
+            {"op": "view", "path": holed[0],
+             "region": "chr1:100000-300000", "level": 1},
+            idempotent=True,
+        )
+        assert r["member"] != "r-hole"  # answered by the successor
+        dlt = delta(s0)["counters"]
+        assert dlt.get("fleet.router.retries", 0) == 1
+
+        # Planned leave: keep the heartbeat fresh but announce draining.
+        fleet_mod.write_member(fdir, {
+            "name": "r-hole",
+            "endpoint": {"socket": str(tmp_path / "nowhere.sock")},
+            "t_wall": time.time(), "seq": 2, "draining": True,
+        })
+        s1 = snapshot()
+        router.scan_members()
+        view = client.fleet()
+        assert "r-hole" not in view["members"]
+        assert "r-hole" not in view["dead"]  # a leave, not a death
+        leaves = [h for h in view["handoffs"]
+                  if h["member"] == "r-hole" and h["kind"] == "leave"]
+        assert leaves and leaves[-1]["reason"] == "draining"
+        assert delta(s1)["counters"].get("fleet.deaths", 0) == 0
+    finally:
+        client.shutdown()
+        router.stop()
+        rt.join(timeout=20)
+        for _, _, t, c in daemons:
+            c.shutdown()
+            t.join(timeout=20)
+
+
+# ---------------------------------------------------------------------------
+# Warmth migration: pack/unpack windows across arenas
+# ---------------------------------------------------------------------------
+
+
+def test_warmth_windows_roundtrip_between_daemons(sorted_bam, tmp_path):
+    """PR 15 members as the warmth data plane: windows exported from a
+    warm arena install into a cold peer, and the peer's first request
+    is an arena *hit* producing the same bytes."""
+    d1, t1, c1 = _start_daemon(tmp_path, name="w1")
+    d2, t2, c2 = _start_daemon(tmp_path, name="w2")
+    try:
+        first = c1.view(sorted_bam, "chr1:100000-300000", level=1)
+        listing = c1.warmth(sorted_bam)
+        assert listing["ok"] and len(listing["windows"]) >= 1
+        export = c1.warmth(sorted_bam, export=True)
+        assert export["windows"], "warm arena exported nothing"
+        assert all(w["members_b64"] for w in export["windows"])
+
+        install = c2.warmth(sorted_bam, windows=export["windows"])
+        assert install["installed"] == len(export["windows"])
+        s0 = snapshot()
+        assert c2.view(sorted_bam, "chr1:100000-300000", level=1) == first
+        dlt = delta(s0)["counters"]
+        assert dlt.get("serve.arena.hit", 0) >= 1  # served warm
+    finally:
+        for c, t in ((c1, t1), (c2, t2)):
+            c.shutdown()
+            t.join(timeout=20)
+
+
+# ---------------------------------------------------------------------------
+# SLO fold
+# ---------------------------------------------------------------------------
+
+
+def test_fold_slo_sums_windows_and_unions_alerts():
+    from hadoop_bam_tpu.serve.slo import fold_slo
+
+    def block(bad_fast, alerting):
+        return {
+            "compliant": not alerting,
+            "alerting": ["availability.page"] if alerting else [],
+            "objectives": [{
+                "name": "availability.page", "op": "view",
+                "kind": "availability", "target": 0.99,
+                "windows": {
+                    "fast": {"seconds": 300, "total": 100,
+                             "bad": bad_fast},
+                    "slow": {"seconds": 3600, "total": 1000,
+                             "bad": bad_fast},
+                },
+            }],
+        }
+
+    fold = fold_slo([block(0, False), block(50, True), None])
+    assert fold["members"] == 2
+    assert fold["compliant"] is False
+    assert fold["alerting"] == ["availability.page"]
+    assert fold["worst"]["name"] == "availability.page"
+    (obj,) = fold["objectives"]
+    assert obj["members"] == 2
+    assert obj["windows"]["fast"]["total"] == 200
+    assert obj["windows"]["fast"]["bad"] == 50
+    # Burn is recomputed over the *folded* window, not averaged.
+    assert obj["windows"]["fast"]["burn"] == pytest.approx(
+        (50 / 200) / 0.01
+    )
+    healthy = fold_slo([block(0, False), block(0, False)])
+    assert healthy["compliant"] is True and healthy["alerting"] == []
+
+
+# ---------------------------------------------------------------------------
+# The real thing: 3 subprocess daemons, kill -9 mid-job, zero lost jobs
+# ---------------------------------------------------------------------------
+
+
+def _spawn_fleet_daemon(tmp_path, name, fdir):
+    sock = str(tmp_path / f"{name}.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("HBAM_FAULTS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "hadoop_bam_tpu", "serve",
+            "--socket", sock,
+            "--journal", str(tmp_path / f"{name}.jsonl"),
+            "--flightrec", str(tmp_path / f"{name}.flight"),
+            "--flightrec-cadence-ms", "100",
+            "--fleet-dir", fdir, "--fleet-name", name,
+            "--heartbeat-ms", "200",
+            "--no-warmup",
+        ],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    client = ServeClient(socket_path=sock, timeout=30.0, retries=0)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(f"{name} exited rc={proc.returncode}")
+        try:
+            if client.ping()["ok"]:
+                return proc
+        except Exception:
+            time.sleep(0.1)
+    proc.kill()
+    raise AssertionError(f"{name} never became ready")
+
+
+@pytest.mark.slow
+def test_kill9_mid_sort_peer_adopts_journal_byte_identical(tmp_path):
+    """The PR 18 acceptance drill in real processes: 3 daemons behind
+    the router, kill -9 the sort's owner mid-job, the monitor's
+    forensics say unclean, the ring successor adopts the journal, and
+    the job completes byte-identical to an uninterrupted run — zero
+    lost jobs."""
+    src = str(tmp_path / "in.bam")
+    _write_unsorted_bam(src, n=2500, seed=17)
+    budget = 48 << 10
+    oracle = str(tmp_path / "oracle.bam")
+    sort_bam([src], oracle, backend="host", level=1, memory_budget=budget)
+
+    fdir = str(tmp_path / "fleet")
+    names = ["fd-a", "fd-b", "fd-c"]
+    procs = {n: _spawn_fleet_daemon(tmp_path, n, fdir) for n in names}
+    router, rt, client = _start_router(
+        tmp_path, fdir, heartbeat_timeout_ms=1200.0
+    )
+    out = str(tmp_path / "out.bam")
+    try:
+        deadline = time.time() + 60
+        while len(client.fleet()["members"]) < 3:
+            assert time.time() < deadline, "fleet never assembled"
+            time.sleep(0.2)
+        reply = client._request({
+            "op": "sort", "bam": [src], "output": out, "level": 1,
+            "memory_budget": budget,
+            "part_dir": str(tmp_path / "parts"),
+        })
+        jid = reply["job"]
+        owner = reply["member"]
+        assert jid.startswith(owner + ":")
+
+        # Kill the owner the moment the job is observably running.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            jr = client._request({"op": "job", "id": jid},
+                                 idempotent=True)
+            if jr["status"] in ("running", "done"):
+                break
+            time.sleep(0.02)
+        assert jr["status"] == "running", (
+            f"job reached {jr['status']!r} before the kill window"
+        )
+        procs[owner].send_signal(signal.SIGKILL)
+        assert procs[owner].wait(timeout=30) == -signal.SIGKILL
+
+        # The monitor buries the corpse and a peer adopts; the same
+        # fleet job id keeps answering through the alias.
+        deadline = time.time() + 300
+        jr = None
+        while time.time() < deadline:
+            try:
+                jr = client._request({"op": "job", "id": jid},
+                                     idempotent=True)
+                if jr["status"] in ("done", "failed"):
+                    break
+            except Exception:
+                pass  # JOB_LOST window between death and adoption
+            time.sleep(0.25)
+        assert jr is not None and jr["status"] == "done", jr
+        assert jr["member"] != owner
+
+        view = client.fleet()
+        dead = view["dead"][owner]
+        assert dead["forensics"]["verdict"] == "unclean"
+        local = jid.split(":", 1)[1]
+        assert local in dead["adopted"]
+        hand = [h for h in view["handoffs"]
+                if h["member"] == owner and h["kind"] == "death"]
+        assert hand and hand[-1]["lost"] == []  # zero lost jobs
+        with open(out, "rb") as f1, open(oracle, "rb") as f2:
+            assert f1.read() == f2.read()
+    finally:
+        client.shutdown()
+        router.stop()
+        rt.join(timeout=20)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
