@@ -434,7 +434,68 @@ def _measure(platform: str) -> dict:
         out.update(_fleet_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["fleet_bench_error"] = str(e)[:120]
+    # FASTQ ingest plane (both platforms): gzip-member decode on the
+    # inflate lanes + device record-boundary scan + queryname collation
+    # to uBAM, vs the pure-host gunzip+parse oracle on the same corpus
+    # (byte-identity gated).  Same round provenance as every other
+    # number: a degraded round never updates a headline.
+    try:
+        out.update(_ingest_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["ingest_bench_error"] = str(e)[:120]
     return out
+
+
+def _ingest_bench(tmp: str) -> dict:
+    """FASTQ → collated-uBAM front door: decompressed MB/s and
+    records/s of ``ingest_fastq`` against the host oracle's
+    gunzip+parse+collate pace, plus the scan-tier hit rate (the
+    fraction of record-boundary chunks the lockstep lanes actually
+    claimed; host/serial tier-downs drag it under 1.0)."""
+    import gzip as _gzip
+    import random as _random
+
+    from hadoop_bam_tpu.ingest import ingest_fastq, ingest_oracle
+
+    n = max(2000, N_RECORDS // 100)
+    rng = _random.Random(7)
+    paths = []
+    total_raw = 0
+    for fi in (1, 2):
+        recs = []
+        for i in range(n):
+            ln = rng.randrange(80, 151)
+            seq = "".join(rng.choice("ACGTN") for _ in range(ln))
+            qual = "".join(chr(rng.randrange(35, 74)) for _ in range(ln))
+            recs.append(f"@b{i}\n{seq}\n+\n{qual}\n")
+        raw = "".join(recs).encode()
+        total_raw += len(raw)
+        p = os.path.join(tmp, f"bench_r{fi}.fastq.gz")
+        with open(p, "wb") as f:
+            # BGZF-eligible members: <=64 KiB uncompressed each.
+            for k in range(0, len(raw), 60_000):
+                f.write(_gzip.compress(raw[k: k + 60_000], 5))
+        paths.append(p)
+    got = os.path.join(tmp, "ingest_got.bam")
+    want = os.path.join(tmp, "ingest_want.bam")
+    t0 = time.time()
+    st = ingest_fastq(paths[0], got, r2=paths[1], level=1)
+    t_ingest = time.time() - t0
+    t0 = time.time()
+    ingest_oracle(paths[0], want, r2=paths[1], level=1)
+    t_host = time.time() - t0
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        if f1.read() != f2.read():
+            return {"ingest_bench_error": "byte-identity gate failed"}
+    scanned = st.scan_lanes + st.scan_host + st.scan_serial
+    return {
+        "ingest_MBps": round(total_raw / max(t_ingest, 1e-9) / 1e6, 1),
+        "ingest_records_per_sec": round(st.n_records / max(t_ingest, 1e-9)),
+        "ingest_vs_host_oracle": round(t_host / max(t_ingest, 1e-9), 3),
+        "ingest_scan_tier_hit_rate": round(
+            st.scan_lanes / max(scanned, 1), 4
+        ),
+    }
 
 
 def _serve_bench(tmp: str) -> dict:

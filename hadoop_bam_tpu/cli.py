@@ -376,6 +376,68 @@ def _cmd_fixmate(args) -> int:
     return 0
 
 
+def _cmd_ingest(args) -> int:
+    from .conf import (
+        DEFLATE_LANES,
+        FASTQ_BASE_QUALITY_ENCODING,
+        FASTQ_FILTER_FAILED_QC,
+        INFLATE_LANES,
+        INGEST_DEVICE_SCAN,
+        Configuration,
+    )
+    from .ingest import ingest_fastq
+
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    if args.inflate_lanes is not None:
+        conf.set_boolean(INFLATE_LANES, args.inflate_lanes == "on")
+    if args.deflate_lanes is not None:
+        conf.set_boolean(DEFLATE_LANES, args.deflate_lanes == "on")
+    if args.device_scan is not None:
+        conf.set(INGEST_DEVICE_SCAN,
+                 "true" if args.device_scan == "on" else "false")
+    if args.quality_encoding:
+        conf.set(FASTQ_BASE_QUALITY_ENCODING, args.quality_encoding)
+    if args.filter_failed_qc:
+        conf.set_boolean(FASTQ_FILTER_FAILED_QC, True)
+    traced = _arm_trace(args, conf)
+    from .utils.tracing import delta, snapshot
+
+    before = snapshot() if args.metrics else None
+    stats = ingest_fastq(
+        args.fastq,
+        args.output,
+        r2=args.r2,
+        conf=conf,
+        level=args.level,
+        memory_budget=args.memory_budget,
+        part_dir=args.part_dir,
+    )
+    _check_drained()
+    if traced:
+        _export_trace(args)
+    paired = f", {stats.n_pairs} pairs" if stats.n_pairs else ""
+    lost = (
+        f", {stats.n_quarantined_members} members quarantined"
+        if stats.n_quarantined_members else ""
+    )
+    print(
+        f"{args.output}: {stats.n_records} records from "
+        f"{stats.n_members or 1} members{paired}{lost}"
+    )
+    if args.metrics:
+        import json
+
+        from .utils.tracing import run_manifest
+
+        report = delta(before)
+        report["run_manifest"] = run_manifest(
+            backend="ingest", conf=conf, counters=report["counters"]
+        ).as_dict()
+        print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
 def _cmd_view(args) -> int:
     """One-shot ranged view: the daemon's ``view`` endpoint without a
     daemon — same code path (serve.endpoints.view_blob), so the output is
@@ -795,6 +857,57 @@ def build_parser() -> argparse.ArgumentParser:
     _add_trace_arg(s)
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_fixmate)
+
+    s = sub.add_parser(
+        "ingest",
+        help="FASTQ (optionally .gz, optionally paired R1/R2) to "
+             "queryname-collated unaligned BAM: gzip members decode on "
+             "the inflate lanes, record boundaries come from the device "
+             "record-scan kernel, pairs collate by name, the uBAM writes "
+             "through the device deflate path — fixmate-ready output",
+    )
+    s.add_argument("fastq", help="R1 (or sole) FASTQ input, plain or gzip")
+    s.add_argument("--r2", default=None, metavar="FASTQ",
+                   help="R2 mate file for paired-end input")
+    s.add_argument("-o", "--output", required=True)
+    s.add_argument("--level", type=int, default=6)
+    s.add_argument(
+        "--memory-budget", type=_parse_size, default=None, metavar="BYTES",
+        help="bounded-memory ingest: encoded records spill in rank-tagged "
+             "runs and k-way merge (byte-identical output; accepts k/m/g "
+             "suffixes)")
+    s.add_argument(
+        "--part-dir", default=None, metavar="DIR",
+        help="spill directory for --memory-budget runs (default: a "
+             "temporary directory)")
+    s.add_argument(
+        "--quality-encoding", choices=("sanger", "illumina"), default=None,
+        help="input base quality encoding (hbam.fastq-input."
+             "base-quality-encoding; illumina converts to sanger)")
+    s.add_argument(
+        "--filter-failed-qc", action="store_true",
+        help="drop records whose CASAVA 1.8 filter field says Y "
+             "(hbam.fastq-input.filter-failed-qc)")
+    s.add_argument(
+        "--inflate-lanes", choices=("on", "off"), default=None,
+        help="force the lockstep-lane device inflate tier for the "
+             "compressed members (default: auto rule)")
+    s.add_argument(
+        "--deflate-lanes", choices=("on", "off"), default=None,
+        help="force the lockstep-lane device deflate tier for the uBAM "
+             "output (default: auto rule)")
+    s.add_argument(
+        "--device-scan", choices=("on", "off"), default=None,
+        help="force the device record-boundary scan kernel "
+             "(hadoopbam.ingest.device-scan; default: follows the "
+             "inflate-lanes auto rule)")
+    s.add_argument("--metrics", action="store_true",
+                   help="print the counter report after the run "
+                        "(ingest.*, fastq.scan.*, salvage.ingest_* "
+                        "counters plus the run manifest)")
+    _add_trace_arg(s)
+    _add_robustness_args(s)
+    s.set_defaults(func=_cmd_ingest)
 
     s = sub.add_parser(
         "view",

@@ -51,6 +51,15 @@ INPUT_BASE_QUALITY_ENCODING = "hbam.input.base-quality-encoding"
 INPUT_FILTER_FAILED_QC = "hbam.input.filter-failed-qc"
 FASTQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.fastq-output.base-quality-encoding"
 QSEQ_OUTPUT_BASE_QUALITY_ENCODING = "hbam.qseq-output.base-quality-encoding"
+# FASTQ ingest plane (ingest.py): decoded payloads are re-chunked into
+# claim regions of this many bytes for the record-boundary scan kernel
+# (default 57088, the device inflate payload), each scanned with this
+# much overlap past the claim so the tail record can complete (default
+# 2048).  device-scan: "true" forces the Pallas record-scan tier on,
+# "false" off; unset defers to the inflate-lanes auto rule.
+INGEST_CHUNK_BYTES = "hadoopbam.ingest.chunk-bytes"
+INGEST_SCAN_OVERLAP = "hadoopbam.ingest.scan-overlap"
+INGEST_DEVICE_SCAN = "hadoopbam.ingest.device-scan"
 # New in the TPU build (per driver BASELINE.json north star).
 BACKEND = "hadoopbam.backend"
 # Lockstep-lane Pallas inflate tier (ops/pallas/inflate_lanes.py): "true"

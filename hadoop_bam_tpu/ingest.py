@@ -1,0 +1,1034 @@
+"""Lane-speed FASTQ ingest: ``fastq[.gz] → queryname-collated uBAM``.
+
+The unaligned front door (ROADMAP #5).  One job rides the existing
+device machinery end to end:
+
+- **Inflate**: gzip/BGZF members from the FASTQ inputs decode through
+  ``DeviceStream.decode_members`` — the fourth stream client, the
+  ``BGZFEnhancedGzipCodec`` stance.  A BGZF-style .fastq.gz yields its
+  exact member table from the header scan; plain multi-member gzip is
+  probed host-side and every member whose deflate payload fits a BGZF
+  frame is *repacked by pure header byte-rewrite* (gzip and BGZF share
+  the deflate body and CRC32/ISIZE trailer) so it rides the lanes in
+  ≤64 KiB units; oversized members tier down to host zlib per member.
+  Counted under ``ingest.inflate.*``.
+- **Scan**: decoded runs re-chunk into claim regions for the
+  ``ops/pallas/record_scan`` kernel (tier-down per chunk to the NumPy
+  host scan, the serial walker beneath both); the per-run record tables
+  are reconciled by extent tiling — any gap falls back to the walker.
+- **Collate**: queryname order comes from the PR 9 collate engine
+  (murmur3 name-hash pair grouping, ``strnum_cmp`` verification against
+  the actual name bytes) over columns built straight from the id lines.
+- **Write**: records emit through the device write path
+  (``DeviceStream.deflate_stream``) with member cuts at fixed absolute
+  payload offsets, so the in-core, ``memory_budget`` (spill + k-way
+  rank merge), and ``errors=salvage`` paths are all byte-identical to
+  :func:`ingest_oracle`, the pure-host reference.
+
+Salvage semantics: a corrupt member quarantines *whole records* — runs
+break at the gap, the tail frame of the pre-gap run and the torn head
+of the post-gap run are dropped by the two-record resync, and a 4-line
+frame is never torn.  Unequal R1/R2 record counts raise in strict mode
+and quarantine the tail in salvage.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import os
+import struct
+import tempfile
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .collate.device import collate_by_name
+from .collate.host import collation_counts, natural_sort_key, queryname_perm
+from .collate.signature import QNAME_SEED2
+from .conf import (
+    ERRORS_MODE,
+    FASTQ_BASE_QUALITY_ENCODING,
+    FASTQ_FILTER_FAILED_QC,
+    INGEST_CHUNK_BYTES,
+    INGEST_DEVICE_SCAN,
+    INGEST_SCAN_OVERLAP,
+    INPUT_BASE_QUALITY_ENCODING,
+    INPUT_FILTER_FAILED_QC,
+)
+from .device_stream import DeviceStream
+from .io.fastq import ILLUMINA_PATTERN
+from .ops.pallas.record_scan import (
+    WindowOverrun,
+    record_scan,
+    scan_window_host,
+    scan_window_py,
+)
+from .spec import bgzf
+from .spec.bam import BamHeader, build_record
+from .spec.fragment import (
+    ILLUMINA_MAX,
+    ILLUMINA_OFFSET,
+    SANGER_MAX,
+    SANGER_OFFSET,
+    FormatException,
+)
+from .utils.murmur3 import murmurhash3_int32_batch
+from .utils.tracing import METRICS, current_request, span
+
+#: uBAM flags: PAIRED|UNMAP|MUNMAP plus READ1/READ2, or plain UNMAP.
+FLAG_R1 = 0x4D
+FLAG_R2 = 0x8D
+FLAG_SINGLE = 0x4
+
+#: Default claim region per scan chunk — the device inflate payload, so
+#: one decoded member is one scan chunk on the common path.
+DEFAULT_CHUNK_BYTES = 0xDF00
+DEFAULT_SCAN_OVERLAP = 2048
+
+#: BGZF member payload cut for the uBAM write path (spec MAX_PAYLOAD).
+_BLOCK_PAYLOAD = 0xFF00
+
+_GZ_MAGIC = b"\x1f\x8b\x08"
+
+
+@contextlib.contextmanager
+def _hop(name: str, **extras):
+    """One waterfall hop on the ambient request context (a serve ingest
+    job's trace shows decode/scan/collate/write durations); batch mode —
+    no ambient context — is the disarmed ``is None`` branch."""
+    rctx = current_request()
+    if rctx is None:
+        yield
+        return
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        rctx.annotate(name, ms=(_time.perf_counter() - t0) * 1e3, **extras)
+
+
+@dataclass
+class IngestStats:
+    """What one ingest job did, and what salvage cost."""
+
+    n_records: int = 0
+    n_pairs: int = 0
+    n_singletons: int = 0
+    n_orphans: int = 0
+    n_members: int = 0
+    n_repacked: int = 0
+    n_host_members: int = 0
+    n_quarantined_members: int = 0
+    n_quarantined_frames: int = 0
+    n_tail_records: int = 0
+    n_filtered: int = 0
+    scan_chunks: int = 0
+    scan_lanes: int = 0
+    scan_host: int = 0
+    scan_serial: int = 0
+    out_bytes: int = 0
+
+    def merge_input(self, other: "IngestStats") -> None:
+        for f in (
+            "n_members", "n_repacked", "n_host_members",
+            "n_quarantined_members", "n_quarantined_frames",
+            "n_filtered", "scan_chunks", "scan_lanes", "scan_host",
+            "scan_serial",
+        ):
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+
+
+# ---------------------------------------------------------------------------
+# Member tables and the inflate-lane decode
+
+
+@dataclass
+class _Member:
+    """One compressed member: device extents into ``dev_buf`` when it
+    can ride the lanes, else raw extents for the per-member host tier.
+    ``usize`` is None for a corrupt/unparseable gap (salvage only)."""
+
+    usize: Optional[int]
+    dev: Optional[Tuple[int, int]] = None    # (coffset, csize) in dev_buf
+    raw: Optional[Tuple[int, int]] = None    # (offset, csize) in the input
+
+
+def _gzip_header_len(buf: bytes, off: int) -> int:
+    if buf[off: off + 3] != _GZ_MAGIC:
+        raise FormatException("not a gzip member at offset %d" % off)
+    flg = buf[off + 3]
+    p = off + 10
+    if flg & 4:
+        xlen = buf[p] | (buf[p + 1] << 8)
+        p += 2 + xlen
+    if flg & 8:
+        p = buf.index(b"\x00", p) + 1
+    if flg & 16:
+        p = buf.index(b"\x00", p) + 1
+    if flg & 2:
+        p += 2
+    return p - off
+
+
+def _bgzf_repack(buf: bytes, off: int, csize: int) -> Optional[bytes]:
+    """A plain gzip member rewritten as one valid BGZF member — header
+    swap only, the deflate body and CRC32/ISIZE trailer are byte-shared
+    between the formats.  None when the member doesn't fit a BGZF frame
+    (BSIZE u16, payload < 64 KiB): that member decodes on the host."""
+    hdr = _gzip_header_len(buf, off)
+    body = csize - hdr - 8
+    total = 18 + body + 8
+    if body < 0 or total - 1 > 0xFFFF:
+        return None
+    isize = struct.unpack_from("<I", buf, off + csize - 4)[0]
+    if isize > 0xFFFF:
+        return None
+    return (
+        bgzf.MAGIC
+        + b"\x00\x00\x00\x00\x00\xff\x06\x00BC\x02\x00"
+        + struct.pack("<H", total - 1)
+        + buf[off + hdr: off + csize]
+    )
+
+
+def _member_table(
+    data: bytes, errors: str, stats: IngestStats
+) -> Tuple[List[_Member], bytes]:
+    """Per-member decode plan for one input, plus the device buffer the
+    ``dev`` extents index (the input itself for BGZF, the repacked
+    synthetic stream for plain gzip, empty for uncompressed text)."""
+    if not data.startswith(b"\x1f\x8b"):
+        return [], b""   # uncompressed: one plain run, no members
+    members: List[_Member] = []
+    if bgzf.is_bgzf(data):
+        pos = 0
+        while pos < len(data):
+            hdr = bgzf.parse_block_header(data, pos)
+            if hdr is None:
+                if errors != "salvage":
+                    raise FormatException(
+                        "corrupt BGZF member chain at offset %d" % pos
+                    )
+                nxt = bgzf.find_next_block(data, pos + 1)
+                members.append(_Member(usize=None))
+                stats.n_quarantined_members += 1
+                METRICS.count("salvage.ingest_members", 1)
+                if nxt is None:
+                    break
+                pos = nxt[0]
+                continue
+            bsize, _ = hdr
+            usize = struct.unpack_from("<I", data, pos + bsize - 4)[0]
+            members.append(_Member(usize=usize, dev=(pos, bsize)))
+            pos += bsize
+        return members, data
+
+    # Plain multi-member gzip: host probe for extents, then repack
+    # eligible members into synthetic BGZF units for the lanes.
+    repacked = bytearray()
+    pos = 0
+    while pos < len(data):
+        d = zlib.decompressobj(31)
+        try:
+            out = d.decompress(data[pos:])
+            if not d.eof:
+                raise zlib.error("truncated gzip member")
+        except zlib.error:
+            if errors != "salvage":
+                raise FormatException(
+                    "corrupt gzip member at offset %d" % pos
+                )
+            members.append(_Member(usize=None))
+            stats.n_quarantined_members += 1
+            METRICS.count("salvage.ingest_members", 1)
+            nxt = data.find(_GZ_MAGIC, pos + 3)
+            if nxt < 0:
+                break
+            pos = nxt
+            continue
+        csize = (len(data) - pos) - len(d.unused_data)
+        syn = _bgzf_repack(data, pos, csize)
+        if syn is not None and len(out) <= 0xFFFF:
+            members.append(
+                _Member(usize=len(out), dev=(len(repacked), len(syn)))
+            )
+            repacked += syn
+            stats.n_repacked += 1
+            METRICS.count("ingest.inflate.repacked", 1)
+        else:
+            members.append(_Member(usize=len(out), raw=(pos, csize)))
+            stats.n_host_members += 1
+            METRICS.count("ingest.inflate.host_members", 1)
+        pos += csize
+    return members, bytes(repacked)
+
+
+def _decode_input(
+    data: bytes, stream: DeviceStream, errors: str, stats: IngestStats
+) -> List[Optional[bytes]]:
+    """Decode one input into per-member payloads in stream order, with
+    ``None`` gaps for quarantined members (salvage only).  Uncompressed
+    inputs come back as a single payload."""
+    members, dev_buf = _member_table(data, errors, stats)
+    if not members:
+        return [data]
+    stats.n_members += len(members)
+    METRICS.count("ingest.inflate.members", len(members))
+    dev_idx = [i for i, m in enumerate(members) if m.dev is not None]
+    payloads: List[Optional[bytes]] = [None] * len(members)
+    if dev_idx:
+        co = np.asarray([members[i].dev[0] for i in dev_idx], np.int64)
+        cs = np.asarray([members[i].dev[1] for i in dev_idx], np.int64)
+        us = np.asarray([members[i].usize for i in dev_idx], np.int64)
+        try:
+            out, offs = stream.decode_members(
+                np.frombuffer(dev_buf, np.uint8), co, cs, us
+            )
+            blob = np.asarray(out).tobytes()
+            for k, i in enumerate(dev_idx):
+                payloads[i] = blob[int(offs[k]): int(offs[k + 1])]
+        except Exception:
+            if errors != "salvage":
+                raise
+            for i in dev_idx:
+                off, _ = members[i].dev
+                try:
+                    payloads[i], _ = bgzf.inflate_block(dev_buf, off)
+                except Exception:
+                    members[i].usize = None
+                    stats.n_quarantined_members += 1
+                    METRICS.count("salvage.ingest_members", 1)
+    for i, m in enumerate(members):
+        if m.raw is not None:
+            off, csize = m.raw
+            try:
+                payloads[i] = zlib.decompress(
+                    data[off: off + csize], 31
+                )
+            except zlib.error:
+                if errors != "salvage":
+                    raise FormatException(
+                        "corrupt gzip member at offset %d" % off
+                    )
+                m.usize = None
+                stats.n_quarantined_members += 1
+                METRICS.count("salvage.ingest_members", 1)
+    decoded = sum(len(p) for p in payloads if p is not None)
+    METRICS.count("ingest.inflate.bytes", decoded)
+    return payloads
+
+
+def _runs_of(payloads: List[Optional[bytes]]) -> List[Tuple[bytes, bool]]:
+    """Contiguous decoded runs between quarantine gaps, each tagged
+    aligned (True only for the stream head: a post-gap run resyncs)."""
+    runs: List[Tuple[bytes, bool]] = []
+    cur: List[bytes] = []
+    aligned = True
+    for p in payloads:
+        if p is None:
+            if cur:
+                runs.append((b"".join(cur), aligned))
+                cur = []
+            aligned = False
+            continue
+        cur.append(p)
+    if cur:
+        runs.append((b"".join(cur), aligned))
+    return runs
+
+
+# ---------------------------------------------------------------------------
+# The record scan: device kernel → host scan → serial walker
+
+
+def _scan_run(
+    run: bytes,
+    aligned: bool,
+    chunk_bytes: int,
+    overlap: int,
+    device: bool,
+    errors: str,
+    stats: IngestStats,
+) -> np.ndarray:
+    """Record table ``[n, 8]`` (run-absolute offsets) for one decoded
+    run, via the tier ladder, with run-tiling reconciliation."""
+    if not run:
+        return np.zeros((0, 8), np.int32)
+    chunks = []
+    offs = []
+    for off in range(0, len(run), chunk_bytes):
+        win = run[off: off + chunk_bytes + overlap]
+        chunks.append((
+            win,
+            min(chunk_bytes, len(run) - off),
+            aligned and off == 0,
+            off + len(win) >= len(run),
+        ))
+        offs.append(off)
+    stats.scan_chunks += len(chunks)
+    METRICS.count("fastq.scan.chunks", len(chunks))
+
+    tables: List[Optional[np.ndarray]] = [None] * len(chunks)
+    if device:
+        tables, kstats = record_scan(chunks)
+        stats.scan_lanes += kstats.lanes
+        METRICS.count("fastq.scan.lanes", kstats.lanes)
+
+    def serial() -> np.ndarray:
+        stats.scan_serial += 1
+        METRICS.count("fastq.scan.serial_fallback", 1)
+        tab, n_quar = scan_window_py(
+            run, len(run), aligned, True, salvage=(errors == "salvage")
+        )
+        if n_quar:
+            stats.n_quarantined_frames += n_quar
+            METRICS.count("salvage.ingest_frames", n_quar)
+        return tab
+
+    try:
+        for k, (win, cl, al, fin) in enumerate(chunks):
+            if tables[k] is None:
+                stats.scan_host += 1
+                METRICS.count("fastq.scan.host", 1)
+                tables[k] = scan_window_host(win, cl, al, fin)
+    except WindowOverrun:
+        return serial()
+    except FormatException:
+        if errors != "salvage":
+            raise
+        return serial()
+
+    parts = [t + np.int32(o) * np.array([1, 0] * 4, np.int32)
+             for t, o in zip(tables, offs) if len(t)]
+    table = (np.concatenate(parts) if parts
+             else np.zeros((0, 8), np.int32))
+
+    # Tiling reconciliation: consecutive records must abut (one LF or
+    # CRLF apart) and an aligned run must start at offset 0 — a gap
+    # means a chunk silently lost a record, so the walker decides.
+    ok = True
+    if len(table):
+        qual_end = table[:-1, 6] + table[:-1, 7]
+        sep = table[1:, 0].astype(np.int64) - qual_end.astype(np.int64)
+        ok = bool(((sep >= 1) & (sep <= 2)).all())
+        last_end = int(table[-1, 6] + table[-1, 7])
+        ok = ok and (len(run) - last_end) in (0, 1, 2)
+        if aligned:
+            ok = ok and int(table[0, 0]) == 0
+    elif aligned and len(run):
+        ok = False
+    if not ok:
+        METRICS.count("fastq.scan.reconciled", 1)
+        return serial()
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Columns: ids, qualities, flags
+
+
+@dataclass
+class _InputColumns:
+    """Per-input record columns in stream order; seq/qual stay as
+    offsets into the decoded runs (payloads bounded, columns in
+    memory)."""
+
+    runs: List[bytes] = field(default_factory=list)
+    run_idx: List[int] = field(default_factory=list)
+    table: List[np.ndarray] = field(default_factory=list)  # per-run [n,8]
+    qnames: List[str] = field(default_factory=list)
+    reads: List[int] = field(default_factory=list)         # 0 = unnumbered
+
+    def __len__(self) -> int:
+        return len(self.qnames)
+
+    def record_bytes(self, i: int) -> Tuple[bytes, bytes, bytes]:
+        """(id line sans '@', seq, qual) raw bytes of record ``i``."""
+        run = self.runs[self.run_idx[i]]
+        row = self.table[i]
+        return (
+            run[row[0] + 1: row[0] + row[1]],
+            run[row[2]: row[2] + row[3]],
+            run[row[6]: row[6] + row[7]],
+        )
+
+
+def _parse_id(name: str, look_for_illumina: bool):
+    """(qname, read, filter_passed, still_illumina): the reference's
+    stateful Illumina-then-``/N`` id chain, shared with
+    ``io.fastq._fastq_materializer``."""
+    read = 0
+    filter_passed = None
+    if look_for_illumina:
+        m = ILLUMINA_PATTERN.fullmatch(name)
+        if m:
+            return (name.split(None, 1)[0], int(m.group(8)),
+                    m.group(9) == "N", True)
+        look_for_illumina = False
+    qname = name.split(None, 1)[0] if name else ""
+    if len(qname) >= 2 and qname[-2] == "/" and qname[-1].isdigit():
+        read = int(qname[-1])
+        qname = qname[:-2]
+    return qname, read, filter_passed, look_for_illumina
+
+
+def _scan_input(
+    data: bytes,
+    stream: DeviceStream,
+    conf,
+    errors: str,
+    chunk_bytes: int,
+    overlap: int,
+    device: bool,
+    filter_failed: bool,
+) -> Tuple[_InputColumns, IngestStats]:
+    """Decode + scan + id-parse one input into stream-order columns."""
+    stats = IngestStats()
+    with span("ingest.stage.decode", category="stage"), \
+            _hop("ingest.decode"):
+        payloads = _decode_input(data, stream, errors, stats)
+        runs = _runs_of(payloads)
+    cols = _InputColumns()
+    look = True
+    with span("ingest.stage.scan", category="stage"), _hop("ingest.scan"):
+        for run, aligned in runs:
+            table = _scan_run(
+                run, aligned, chunk_bytes, overlap, device, errors, stats
+            )
+            r = len(cols.runs)
+            cols.runs.append(run)
+            for row in table:
+                name = run[row[0] + 1: row[0] + row[1]].decode(
+                    "latin-1"
+                )
+                qname, read, fpass, look = _parse_id(name, look)
+                if filter_failed and fpass is False:
+                    stats.n_filtered += 1
+                    continue
+                cols.run_idx.append(r)
+                cols.table.append(row)
+                cols.qnames.append(qname)
+                cols.reads.append(read)
+    return cols, stats
+
+
+def _sanger_quals(cols: _InputColumns, encoding: str) -> List[bytes]:
+    """Per-record Sanger-encoded quality bytes, verified (sanger input)
+    or range-checked ±31 shifted (illumina input) — the read_split
+    stance, vectorized per run would be overkill here: qualities stream
+    straight into the record encoder."""
+    out = []
+    if encoding == "illumina":
+        lo, hi = ILLUMINA_OFFSET, ILLUMINA_OFFSET + ILLUMINA_MAX
+    elif encoding == "sanger":
+        lo, hi = SANGER_OFFSET, SANGER_OFFSET + SANGER_MAX
+    else:
+        raise ValueError(f"Unsupported base quality encoding {encoding}")
+    for i in range(len(cols)):
+        _, _, qual = cols.record_bytes(i)
+        a = np.frombuffer(qual, np.uint8)
+        if len(a) and (int(a.min()) < lo or int(a.max()) > hi):
+            raise FormatException(
+                "base quality score out of range for %s encoding in "
+                "record %r" % (encoding, cols.qnames[i])
+            )
+        if encoding == "illumina":
+            a = (a.astype(np.int16)
+                 - (ILLUMINA_OFFSET - SANGER_OFFSET)).astype(np.uint8)
+        out.append(a.tobytes())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The blocked uBAM writer (byte-stable member cuts)
+
+
+class _BlockedUbamWriter:
+    """BGZF writer with member cuts at fixed absolute payload offsets:
+    compression only ever sees exact multiples of ``block_payload``
+    (remainder buffered), so output bytes are independent of how the
+    caller batches writes — the in-core, spill-merge, and oracle paths
+    produce identical files."""
+
+    def __init__(self, fh, stream: Optional[DeviceStream], level: int,
+                 block_payload: int = _BLOCK_PAYLOAD):
+        self._fh = fh
+        self._stream = stream
+        self._level = level
+        self._bp = block_payload
+        self._buf = bytearray()
+        self.out_bytes = 0
+
+    def _deflate(self, payload: bytes) -> bytes:
+        if self._stream is not None:
+            return self._stream.deflate_stream(
+                payload, level=self._level, block_payload=self._bp
+            )
+        from . import native
+
+        return native.deflate_blocks(
+            payload, level=self._level, block_payload=self._bp
+        )
+
+    def write(self, b: bytes) -> None:
+        self._buf += b
+        cut = (len(self._buf) // self._bp) * self._bp
+        if cut:
+            comp = self._deflate(bytes(self._buf[:cut]))
+            del self._buf[:cut]
+            self._fh.write(comp)
+            self.out_bytes += len(comp)
+
+    def close(self) -> None:
+        if self._buf:
+            comp = self._deflate(bytes(self._buf))
+            self._buf.clear()
+            self._fh.write(comp)
+            self.out_bytes += len(comp)
+        self._fh.write(bgzf.TERMINATOR)
+        self.out_bytes += len(bgzf.TERMINATOR)
+
+
+_UBAM_HEADER_TEXT = "@HD\tVN:1.6\tSO:queryname\n"
+
+
+def _encode_record(qname: str, flag: int, seq: bytes, qual: bytes) -> bytes:
+    rec = build_record(
+        name=qname, refid=-1, pos=-1, mapq=0, flag=flag, cigar=[],
+        seq=seq.decode("latin-1"), qual=qual.decode("latin-1"),
+    )
+    return rec.encode()
+
+
+# ---------------------------------------------------------------------------
+# The front door
+
+
+def ingest_fastq(
+    fastq: Union[str, Sequence[str]],
+    output: str,
+    r2: Optional[str] = None,
+    conf=None,
+    level: int = 6,
+    memory_budget: Optional[int] = None,
+    part_dir: Optional[str] = None,
+    errors: Optional[str] = None,
+    chunk_bytes: Optional[int] = None,
+    overlap: Optional[int] = None,
+    deadline=None,
+    resource_cache=None,
+) -> IngestStats:
+    """Ingest FASTQ (optionally gzip/BGZF compressed, optionally paired
+    R1/R2) into a queryname-collated unaligned BAM at ``output``.
+
+    ``memory_budget`` bounds the record-assembly working set: encoded
+    records spill in rank-tagged runs and k-way merge back — the output
+    is byte-identical to the in-core path.  ``errors="salvage"``
+    quarantines corrupt members and torn frames instead of aborting.
+    """
+    if isinstance(fastq, (list, tuple)):
+        paths = list(fastq)
+        r1_path = paths[0]
+        if len(paths) > 1 and r2 is None:
+            r2 = paths[1]
+    else:
+        r1_path = fastq
+    errors = errors or (
+        (conf.get(ERRORS_MODE, "strict") if conf is not None else "strict")
+        or "strict"
+    )
+    if errors not in ("strict", "salvage"):
+        raise ValueError(f"unknown errors mode: {errors}")
+    cget = (lambda k, d=None: conf.get(k, d)) if conf is not None \
+        else (lambda k, d=None: d)
+    if chunk_bytes is None:
+        chunk_bytes = int(cget(INGEST_CHUNK_BYTES, DEFAULT_CHUNK_BYTES)
+                          or DEFAULT_CHUNK_BYTES)
+    if overlap is None:
+        overlap = int(cget(INGEST_SCAN_OVERLAP, DEFAULT_SCAN_OVERLAP)
+                      or DEFAULT_SCAN_OVERLAP)
+    stream = DeviceStream(conf=conf, deadline=deadline, name="ingest")
+    dev_conf = str(cget(INGEST_DEVICE_SCAN, "") or "").lower()
+    device = (dev_conf == "true") if dev_conf in ("true", "false") \
+        else stream.policy.inflate_lanes
+    encoding = str(
+        cget(FASTQ_BASE_QUALITY_ENCODING,
+             cget(INPUT_BASE_QUALITY_ENCODING, "sanger")) or "sanger"
+    )
+    filter_failed = str(
+        cget(FASTQ_FILTER_FAILED_QC,
+             cget(INPUT_FILTER_FAILED_QC, "false")) or "false"
+    ).lower() == "true"
+
+    stats = IngestStats()
+    inputs: List[_InputColumns] = []
+    for path in [r1_path] + ([r2] if r2 else []):
+        with open(path, "rb") as fh:
+            data = fh.read()
+        cols, istats = _scan_input(
+            data, stream, conf, errors, chunk_bytes, overlap, device,
+            filter_failed,
+        )
+        stats.merge_input(istats)
+        inputs.append(cols)
+
+    paired_files = r2 is not None
+    if paired_files and len(inputs[0]) != len(inputs[1]):
+        n1, n2 = len(inputs[0]), len(inputs[1])
+        if errors != "salvage":
+            raise FormatException(
+                "paired FASTQ inputs have unequal record counts "
+                f"({n1} vs {n2})"
+            )
+        lo = min(n1, n2)
+        stats.n_tail_records += (n1 - lo) + (n2 - lo)
+        METRICS.count("salvage.ingest_tail_records", (n1 - lo) + (n2 - lo))
+        for cols in inputs:
+            del cols.qnames[lo:], cols.reads[lo:]
+            del cols.run_idx[lo:], cols.table[lo:]
+
+    # Global record list in read order: R1 stream then R2 stream (the
+    # collation owns interleaving them back into queryname order).
+    qnames: List[str] = []
+    flags: List[int] = []
+    src: List[Tuple[int, int]] = []
+    for fi, cols in enumerate(inputs):
+        default_read = fi + 1 if paired_files else 0
+        for i in range(len(cols)):
+            read = cols.reads[i] or default_read
+            flags.append(
+                FLAG_SINGLE if read == 0
+                else (FLAG_R2 if read == 2 else FLAG_R1)
+            )
+            qnames.append(cols.qnames[i])
+            src.append((fi, i))
+    n = len(qnames)
+    stats.n_records = n
+    METRICS.count("ingest.records", n)
+
+    with span("ingest.stage.collate", category="stage"), \
+            _hop("ingest.collate"):
+        name_bytes = [q.encode("latin-1") for q in qnames]
+        blob = np.frombuffer(b"".join(name_bytes), np.uint8)
+        name_len = np.asarray([len(b) for b in name_bytes], np.int32)
+        name_off = np.zeros(n, np.int64)
+        if n:
+            np.cumsum(name_len[:-1], out=name_off[1:])
+        flag_col = np.asarray(flags, np.int32)
+        cols = {
+            "qh1": murmurhash3_int32_batch(
+                blob, name_off, name_len.astype(np.int64), 0
+            ),
+            "qh2": murmurhash3_int32_batch(
+                blob, name_off, name_len.astype(np.int64), QNAME_SEED2
+            ),
+            "flag": flag_col,
+            "pos": np.full(n, -1, np.int32),
+            "cand": ((flag_col & 0x1) != 0).astype(np.int32),
+            "name_len": name_len,
+            "name_off": name_off,
+            "names": blob,
+        }
+        perm, _ = queryname_perm(cols)
+        census = collation_counts(cols, collate_by_name(cols))
+        stats.n_pairs = int(census["pairs"])
+        stats.n_singletons = int(census["singletons"])
+        stats.n_orphans = int(census["orphans"])
+        METRICS.count("ingest.pairs", stats.n_pairs)
+        METRICS.count("ingest.orphans", stats.n_orphans)
+
+    quals = [_sanger_quals(cols, encoding) for cols in inputs]
+
+    def record_payload(i: int) -> bytes:
+        fi, ri = src[i]
+        _, seq, _ = inputs[fi].record_bytes(ri)
+        return _encode_record(qnames[i], flags[i], seq, quals[fi][ri])
+
+    header = BamHeader(_UBAM_HEADER_TEXT, []).with_sort_order("queryname")
+    with span("ingest.stage.write", category="stage"), \
+            _hop("ingest.write"), open(output, "wb") as fh:
+        w = _BlockedUbamWriter(fh, stream, level)
+        w.write(header.encode())
+        if memory_budget is None:
+            for i in perm:
+                w.write(record_payload(int(i)))
+        else:
+            _spill_merge(
+                w, record_payload, perm, n, memory_budget, part_dir
+            )
+        w.close()
+        stats.out_bytes = w.out_bytes
+    METRICS.count("ingest.out_bytes", stats.out_bytes)
+    return stats
+
+
+def _spill_merge(w, record_payload, perm, n, memory_budget, part_dir):
+    """Budget-bounded emission: encode records in read order into
+    rank-sorted spill runs of at most ``memory_budget`` bytes, then
+    k-way merge the runs by rank — the same record order, hence the
+    same bytes, as the in-core path."""
+    rank = np.empty(n, np.int64)
+    rank[perm] = np.arange(n, dtype=np.int64)
+    with contextlib.ExitStack() as stack:
+        if part_dir is None:
+            spill_dir = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="hbam-ingest-")
+            )
+        else:
+            os.makedirs(part_dir, exist_ok=True)
+            spill_dir = part_dir
+        run_paths: List[str] = []
+        batch: List[Tuple[int, bytes]] = []
+        batch_bytes = 0
+
+        def flush():
+            nonlocal batch, batch_bytes
+            if not batch:
+                return
+            batch.sort(key=lambda t: t[0])
+            path = os.path.join(
+                spill_dir, "ingest-run-%05d.bin" % len(run_paths)
+            )
+            with open(path, "wb") as rf:
+                for rk, payload in batch:
+                    rf.write(struct.pack("<qI", rk, len(payload)))
+                    rf.write(payload)
+            run_paths.append(path)
+            batch = []
+            batch_bytes = 0
+
+        for i in range(n):
+            payload = record_payload(i)
+            batch.append((int(rank[i]), payload))
+            batch_bytes += len(payload)
+            if batch_bytes >= max(memory_budget, 1):
+                flush()
+        flush()
+
+        def reader(path):
+            with open(path, "rb") as rf:
+                while True:
+                    hdr = rf.read(12)
+                    if not hdr:
+                        return
+                    rk, ln = struct.unpack("<qI", hdr)
+                    yield rk, rf.read(ln)
+
+        for _, payload in heapq.merge(
+            *[reader(p) for p in run_paths], key=lambda t: t[0]
+        ):
+            w.write(payload)
+
+
+# ---------------------------------------------------------------------------
+# The pure-host oracle
+
+
+def ingest_oracle(
+    fastq: Union[str, Sequence[str]],
+    output: str,
+    r2: Optional[str] = None,
+    conf=None,
+    level: int = 6,
+    errors: Optional[str] = None,
+) -> int:
+    """Reference ingest: python-gzip decode, serial two-record-resync
+    parse, python natural sort — no kernels, no collate engine, no
+    device stream.  Shares only the spec-level byte encoders
+    (``build_record`` and the blocked member cuts) so byte-identity is a
+    meaningful check of the device path.  Returns the record count."""
+    if isinstance(fastq, (list, tuple)):
+        paths = list(fastq)
+        r1_path = paths[0]
+        if len(paths) > 1 and r2 is None:
+            r2 = paths[1]
+    else:
+        r1_path = fastq
+    errors = errors or (
+        (conf.get(ERRORS_MODE, "strict") if conf is not None else "strict")
+        or "strict"
+    )
+    cget = (lambda k, d=None: conf.get(k, d)) if conf is not None \
+        else (lambda k, d=None: d)
+    encoding = str(
+        cget(FASTQ_BASE_QUALITY_ENCODING,
+             cget(INPUT_BASE_QUALITY_ENCODING, "sanger")) or "sanger"
+    )
+    filter_failed = str(
+        cget(FASTQ_FILTER_FAILED_QC,
+             cget(INPUT_FILTER_FAILED_QC, "false")) or "false"
+    ).lower() == "true"
+
+    def decode(path):
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if not data.startswith(b"\x1f\x8b"):
+            return [data]
+        chunks: List[Optional[bytes]] = []
+        pos = 0
+        while pos < len(data):
+            d = zlib.decompressobj(31)
+            try:
+                out = d.decompress(data[pos:])
+                if not d.eof:
+                    raise zlib.error("truncated member")
+            except zlib.error:
+                if errors != "salvage":
+                    raise FormatException(
+                        "corrupt gzip member at offset %d" % pos
+                    )
+                chunks.append(None)
+                nxt = data.find(_GZ_MAGIC, pos + 3)
+                if nxt < 0:
+                    break
+                pos = nxt
+                continue
+            chunks.append(out)
+            pos += (len(data) - pos) - len(d.unused_data)
+        return chunks
+
+    def lines_of(run):
+        out = []
+        pos = 0
+        while pos < len(run):
+            nl = run.find(b"\n", pos)
+            if nl < 0:
+                nl = len(run)
+            line = run[pos:nl]
+            if line.endswith(b"\r"):
+                line = line[:-1]
+            out.append(line)
+            pos = nl + 1
+        return out
+
+    def parse_run(run, aligned):
+        lines = lines_of(run)
+
+        def frame(i):
+            if i + 3 >= len(lines):
+                return None
+            return (lines[i][:1] == b"@" and lines[i + 2][:1] == b"+"
+                    and len(lines[i + 1]) == len(lines[i + 3]))
+
+        i = 0
+        if not aligned:
+            while i < len(lines):
+                fa = frame(i)
+                if fa is None:
+                    i = len(lines)
+                    break
+                if fa and (frame(i + 4) or frame(i + 4) is None):
+                    break
+                i += 1
+        recs = []
+        while i < len(lines):
+            fr = frame(i)
+            if fr:
+                recs.append((lines[i][1:], lines[i + 1], lines[i + 3]))
+                i += 4
+                continue
+            if errors != "salvage":
+                raise FormatException(
+                    "fastq: %s in record %d" % (
+                        "truncated record" if fr is None
+                        else "frame violation", len(recs),
+                    )
+                )
+            if fr is None:
+                break
+            i += 1
+            while i < len(lines):
+                fa = frame(i)
+                if fa is None:
+                    i = len(lines)
+                    break
+                if fa and (frame(i + 4) or frame(i + 4) is None):
+                    break
+                i += 1
+        return recs
+
+    def parse_input(path):
+        recs = []
+        aligned = True
+        pending: List[bytes] = []
+        for chunk in decode(path):
+            if chunk is None:
+                if pending:
+                    recs.extend(parse_run(b"".join(pending), aligned))
+                    pending = []
+                aligned = False
+                continue
+            pending.append(chunk)
+        if pending:
+            recs.extend(parse_run(b"".join(pending), aligned))
+        out = []
+        look = True
+        for name_b, seq, qual in recs:
+            name = name_b.decode("latin-1")
+            qname, read, fpass, look = _parse_id(name, look)
+            if filter_failed and fpass is False:
+                continue
+            if encoding == "illumina":
+                a = np.frombuffer(qual, np.uint8)
+                if len(a) and (int(a.min()) < ILLUMINA_OFFSET
+                               or int(a.max()) > ILLUMINA_OFFSET
+                               + ILLUMINA_MAX):
+                    raise FormatException(
+                        "base quality score out of range"
+                    )
+                qual = (a.astype(np.int16) - (ILLUMINA_OFFSET
+                        - SANGER_OFFSET)).astype(np.uint8).tobytes()
+            else:
+                a = np.frombuffer(qual, np.uint8)
+                if len(a) and (int(a.min()) < SANGER_OFFSET
+                               or int(a.max()) > SANGER_OFFSET
+                               + SANGER_MAX):
+                    raise FormatException(
+                        "base quality score out of range"
+                    )
+            out.append((qname, read, seq, qual))
+        return out
+
+    paired = r2 is not None
+    records = []
+    for fi, path in enumerate([r1_path] + ([r2] if r2 else [])):
+        recs = parse_input(path)
+        records.append(recs)
+    if paired and len(records[0]) != len(records[1]):
+        if errors != "salvage":
+            raise FormatException(
+                "paired FASTQ inputs have unequal record counts "
+                f"({len(records[0])} vs {len(records[1])})"
+            )
+        lo = min(len(records[0]), len(records[1]))
+        records = [r[:lo] for r in records]
+
+    flat = []
+    for fi, recs in enumerate(records):
+        for qname, read, seq, qual in recs:
+            read = read or (fi + 1 if paired else 0)
+            flag = (FLAG_SINGLE if read == 0
+                    else (FLAG_R2 if read == 2 else FLAG_R1))
+            flat.append((qname, flag, seq, qual))
+
+    order = sorted(
+        range(len(flat)),
+        key=lambda i: (
+            natural_sort_key(flat[i][0].encode("latin-1")),
+            flat[i][1], i,
+        ),
+    )
+    header = BamHeader(_UBAM_HEADER_TEXT, []).with_sort_order("queryname")
+    with open(output, "wb") as fh:
+        w = _BlockedUbamWriter(fh, None, level)
+        w.write(header.encode())
+        for i in order:
+            qname, flag, seq, qual = flat[i]
+            w.write(_encode_record(qname, flag, seq, qual))
+        w.close()
+    return len(flat)

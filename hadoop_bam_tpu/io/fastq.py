@@ -131,7 +131,14 @@ class FastqInputFormat:
     def position_at_first_record(
         self, data: bytes, start: int, end: int
     ) -> int:
-        """The @/+ resync with backtracking (FastqInputFormat.java:156-198)."""
+        """The @/+ resync with backtracking (FastqInputFormat.java:156-198),
+        hardened to the split-guesser stance: a candidate ``@`` line is
+        trusted only when it heads TWO consecutive verified records
+        (``@``/``+`` markers plus equal seq/qual lengths, twice over) —
+        a lone ``@``-plus-``+`` look-ahead mistakes a quality string
+        beginning with ``@`` for a record start whenever the split lands
+        mid-quality-line.  The second record is waived only when the
+        data ends before it can complete."""
         if start == 0:
             return 0
         r = SplitLineReader(data, start, len(data))
@@ -143,11 +150,26 @@ class FastqInputFormat:
                 return len(data)
             if line.startswith(b"@"):
                 backtrack = r.tell()
-                r.read_line()  # sequence?
-                third = r.read_line()  # '+' if line_start was a record start
-                if third is not None and third.startswith(b"+"):
+                window = [line]
+                for _ in range(7):
+                    nxt = r.read_line()
+                    if nxt is None:
+                        break
+                    window.append(nxt)
+
+                def frame(i: int) -> Optional[bool]:
+                    if i + 3 >= len(window):
+                        return None  # incomplete: data ran out
+                    return (
+                        window[i].startswith(b"@")
+                        and window[i + 2].startswith(b"+")
+                        and len(window[i + 1]) == len(window[i + 3])
+                    )
+
+                first, second = frame(0), frame(4)
+                if first and (second or second is None):
                     return line_start
-                r.pos = backtrack  # it was a quality line: resume after it
+                r.pos = backtrack  # not a record start: resume after it
                 pos = backtrack
             else:
                 pos = r.tell()

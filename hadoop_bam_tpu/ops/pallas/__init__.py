@@ -6,4 +6,11 @@ mesh), and to the plain-XLA ops/ implementations when Pallas is unavailable.
 
 from .histogram import quality_histogram, quality_histogram_auto  # noqa: F401
 from .overlap import overlap_mask, overlap_mask_auto  # noqa: F401
+from .record_scan import (  # noqa: F401
+    RecordScanStats,
+    WindowOverrun,
+    record_scan,
+    scan_window_host,
+    scan_window_py,
+)
 from .unpack import unpack_nibbles, unpack_nibbles_auto  # noqa: F401

@@ -69,6 +69,10 @@ from .io.merger import merge_bam_parts
 from .ops.sort import sort_keys
 from .parallel.executor import ElasticExecutor, bgzf_part_valid
 
+# The FASTQ front door lives in its own module (it feeds this pipeline
+# rather than riding it) but is part of the public pipeline surface.
+from .ingest import IngestStats, ingest_fastq, ingest_oracle  # noqa: F401
+
 
 def _input_format(conf, in_paths):
     """BamInputFormat for all-``.bam`` inputs (the hot default path,

@@ -63,7 +63,9 @@ ERROR_CODES = (SHED, RETRY_AFTER, DEADLINE_EXCEEDED, JOB_LOST)
 #: Token cost per data-plane op.  Ops absent here are control plane and
 #: bypass admission entirely (the daemon must answer ping/stats/drain
 #: even — especially — while shedding everything else).
-DEFAULT_COSTS: Dict[str, int] = {"view": 1, "flagstat": 2, "sort": 4}
+DEFAULT_COSTS: Dict[str, int] = {
+    "view": 1, "flagstat": 2, "sort": 4, "ingest": 4,
+}
 
 DEFAULT_TOKENS = 8
 DEFAULT_MAX_QUEUE = 64

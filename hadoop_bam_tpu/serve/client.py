@@ -284,6 +284,28 @@ class ServeClient:
             "job"
         ]
 
+    def ingest(
+        self,
+        fastq,
+        output: str,
+        r2: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        **kwargs,
+    ) -> str:
+        """Submit a FASTQ → collated-uBAM ingest job; returns the job id
+        (poll with :meth:`job` or block with :meth:`wait`).  ``fastq``
+        is the R1 (or sole) input path, or a [r1, r2] list; same job
+        lifecycle as :meth:`sort` — not auto-retried, journaled, resumed
+        from ``part_dir`` checkpoints on daemon restart."""
+        paths = list(fastq) if isinstance(fastq, (list, tuple)) else [fastq]
+        if r2 is not None:
+            paths.append(r2)
+        req = {"op": "ingest", "fastq": paths, "output": output}
+        req.update(kwargs)
+        return self._request(req, deadline=self._deadline(deadline_ms))[
+            "job"
+        ]
+
     def job(self, job_id: str) -> dict:
         return self._request({"op": "job", "id": job_id}, idempotent=True)
 

@@ -455,8 +455,8 @@ class FleetRouter:
 
     def _routing_path(self, req: dict) -> Optional[str]:
         op = req.get("op")
-        if op == "sort":
-            paths = req.get("bam")
+        if op in ("sort", "ingest"):
+            paths = req.get("bam") if op == "sort" else req.get("fastq")
             if isinstance(paths, str):
                 return paths
             return paths[0] if paths else None
@@ -506,8 +506,9 @@ class FleetRouter:
             try:
                 reply = self._forward(member, req, rctx)
             except (ServeConnectionError, ConnectionError, OSError) as e:
-                if len(owners) < 2 or op == "sort":
-                    # A sort submit is never blind-retried (a resubmit
+                self._maybe_eager_death(member, e, rctx)
+                if len(owners) < 2 or op in ("sort", "ingest"):
+                    # A job submit is never blind-retried (a resubmit
                     # is a second job) — the death monitor's adoption
                     # path owns its recovery instead.
                     raise
@@ -521,12 +522,40 @@ class FleetRouter:
                 METRICS.count("fleet.router.retries", 1)
                 member = retry_to
                 reply = self._forward(member, req, rctx)
-            if op == "sort" and "job" in reply:
+            if op in ("sort", "ingest") and "job" in reply:
                 reply["job"] = f"{member}:{reply['job']}"
             reply.setdefault("member", member)
             return reply
         finally:
             release()
+
+    def _maybe_eager_death(self, member: str, err: BaseException,
+                           rctx: Optional[RequestContext]) -> None:
+        """Eager death detection: a *connection-refused* from a member
+        whose heartbeat is still fresh means the process died between
+        heartbeats (refused is active OS evidence the listener is gone —
+        unlike a timeout, which may just be load).  Classify and bury it
+        immediately instead of waiting out the heartbeat floor, so the
+        successor retry below already routes against the repaired
+        ring."""
+        refused = isinstance(err, ConnectionRefusedError) or (
+            "refused" in str(err).lower()
+        )
+        if not refused:
+            return
+        with self._lock:
+            rec = self._members.get(member)
+        if rec is None:
+            return
+        fresh = fleet_mod.heartbeat_age_s(rec, time.time()) <= (
+            self.heartbeat_timeout_ms / 1e3
+        )
+        if not fresh:
+            return  # the ordinary monitor pass owns stale members
+        METRICS.count("fleet.eager_refused", 1)
+        if rctx is not None:
+            rctx.annotate("router.eager_death", member=member)
+        self._on_death(member, rec)
 
     def _job_status(self, req: dict) -> dict:
         rid = req.get("id") or ""

@@ -120,15 +120,15 @@ DEFAULT_MAX_INFLIGHT = 2
 #: ``_dispatch``, so an op cannot be added without being registered
 #: (and thereby running under the dispatch RequestContext).
 KNOWN_OPS = (
-    "ping", "view", "flagstat", "sort", "job", "stats", "metrics",
-    "exemplars", "adopt", "warmth", "shutdown",
+    "ping", "view", "flagstat", "sort", "ingest", "job", "stats",
+    "metrics", "exemplars", "adopt", "warmth", "shutdown",
 )
 
 #: Data-plane ops whose completions feed the tail sampler and the access
 #: log.  Control-plane ops (ping/stats/…) run under a RequestContext too
 #: but record no summaries — a stats scrape per second must not flood
 #: the per-request artifacts.
-TRACED_OPS = ("view", "flagstat", "sort")
+TRACED_OPS = ("view", "flagstat", "sort", "ingest")
 
 
 def default_socket_path() -> str:
@@ -431,7 +431,7 @@ class BamDaemon:
             if self._journal is not None:
                 self._journal.state(jid, "resumed")
             self._job_pool.submit(
-                self._run_sort, jid, dict(jobs[jid]["req"])
+                self._run_job, jid, dict(jobs[jid]["req"])
             )
 
     def install_signal_handlers(self) -> None:
@@ -695,11 +695,25 @@ class BamDaemon:
             if self._draining.is_set():
                 return ({"ok": False, "error": "daemon is draining"}, False)
             # The job holds its admission tokens for its whole lifetime
-            # (released in _run_sort), so queued+running jobs weigh on
+            # (released in _run_job), so queued+running jobs weigh on
             # the same budget concurrent views contend for.
             ticket = self.admission.acquire(op, deadline=deadline)
             try:
-                jid = self._submit_sort(req, ticket, deadline)
+                jid = self._submit_job(req, ticket, deadline)
+            except BaseException:
+                ticket.release()
+                raise
+            return ({"ok": True, "job": jid}, False)
+        if op == "ingest":
+            # FASTQ → collated-uBAM job: same lifecycle as sort (job id,
+            # whole-lifetime admission ticket, journal durable-before-
+            # pool, crash resume via part_dir) — the write-heavy op the
+            # fleet routes alongside the sort traffic.
+            if self._draining.is_set():
+                return ({"ok": False, "error": "daemon is draining"}, False)
+            ticket = self.admission.acquire(op, deadline=deadline)
+            try:
+                jid = self._submit_job(req, ticket, deadline)
             except BaseException:
                 ticket.release()
                 raise
@@ -825,7 +839,7 @@ class BamDaemon:
                     jid, peer_req, jobs[peer_jid].get("inputs")
                 )
                 self._journal.state(jid, "adopted", source=req.get("source"))
-            self._job_pool.submit(self._run_sort, jid, peer_req)
+            self._job_pool.submit(self._run_job, jid, peer_req)
             adopted[peer_jid] = jid
             METRICS.count("serve.adopt.resumed", 1)
         METRICS.count("serve.adoptions", 1)
@@ -864,9 +878,23 @@ class BamDaemon:
             ),
         }
 
-    # -- sort jobs ----------------------------------------------------------
+    # -- sort / ingest jobs -------------------------------------------------
 
-    def _submit_sort(
+    @staticmethod
+    def _job_kind(req: dict) -> str:
+        """A job request's kind, from its payload rather than ``op`` —
+        journal replays and peer adoptions carry the req dict without
+        the op key, and must resume as what they were."""
+        return "ingest" if "fastq" in req else "sort"
+
+    @staticmethod
+    def _job_inputs(req: dict) -> List[str]:
+        paths = req.get("fastq") if "fastq" in req else req.get("bam")
+        if isinstance(paths, str):
+            paths = [paths]
+        return list(paths or [])
+
+    def _submit_job(
         self, req: dict, ticket=None, deadline: Optional[Deadline] = None
     ) -> str:
         # The job continues the submission's trace on the pool thread as
@@ -876,7 +904,8 @@ class BamDaemon:
         from ..utils.tracing import current_request
 
         rctx = current_request()
-        job_ctx = rctx.child(op="sort.job") if rctx is not None else None
+        kind = self._job_kind(req)
+        job_ctx = rctx.child(op=f"{kind}.job") if rctx is not None else None
         with self._jobs_lock:
             self._job_seq += 1
             jid = f"job-{self._job_seq:04d}"
@@ -890,16 +919,13 @@ class BamDaemon:
             # Durable before the pool sees it: a crash between this
             # append and the submit leaves a journaled job the restart
             # resumes (or reports lost) — never one nobody remembers.
-            paths = req.get("bam")
-            if isinstance(paths, str):
-                paths = [paths]
             self._journal.submit(
                 jid,
                 {k: v for k, v in req.items() if k != "op"},
-                journal_mod.input_identity(list(paths or [])),
+                journal_mod.input_identity(self._job_inputs(req)),
             )
         self._job_pool.submit(
-            self._run_sort, jid, dict(req), ticket, deadline, job_ctx
+            self._run_job, jid, dict(req), ticket, deadline, job_ctx
         )
         METRICS.count("serve.jobs_submitted", 1)
         return jid
@@ -918,7 +944,7 @@ class BamDaemon:
             # crashed-then-resumed job shows its state machine inline.
             rctx.annotate("journal.state", job=jid, status=status)
 
-    def _run_sort(
+    def _run_job(
         self,
         jid: str,
         req: dict,
@@ -926,36 +952,57 @@ class BamDaemon:
         deadline: Optional[Deadline] = None,
         rctx: Optional[RequestContext] = None,
     ) -> None:
+        kind = self._job_kind(req)
         with self._jobs_lock:
             self._jobs[jid]["status"] = "running"
         outcome = "OK"
         with request_scope(rctx):
             self._journal_state(jid, "running")
             try:
-                from ..pipeline import sort_bam
+                if kind == "ingest":
+                    from ..ingest import ingest_fastq
 
-                paths = req["bam"]
-                if isinstance(paths, str):
-                    paths = [paths]
-                stats = sort_bam(
-                    paths,
-                    req["output"],
-                    conf=self.conf,
-                    level=int(req.get("level", 6)),
-                    memory_budget=req.get("memory_budget"),
-                    part_dir=req.get("part_dir"),
-                    write_splitting_bai=bool(req.get("write_splitting_bai")),
-                    mark_duplicates=bool(req.get("mark_duplicates")),
-                    sort_order=req.get("sort_order"),
-                    resource_cache=self.ctx.cache,
-                    deadline=deadline,
-                )
-                stats_d = {
-                    "n_records": stats.n_records,
-                    "n_splits": stats.n_splits,
-                    "backend": stats.backend,
-                    "n_duplicates": stats.n_duplicates,
-                }
+                    stats = ingest_fastq(
+                        self._job_inputs(req),
+                        req["output"],
+                        conf=self.conf,
+                        level=int(req.get("level", 6)),
+                        memory_budget=req.get("memory_budget"),
+                        part_dir=req.get("part_dir"),
+                        errors=req.get("errors"),
+                        deadline=deadline,
+                        resource_cache=self.ctx.cache,
+                    )
+                    stats_d = {
+                        "n_records": stats.n_records,
+                        "n_pairs": stats.n_pairs,
+                        "n_members": stats.n_members,
+                        "out_bytes": stats.out_bytes,
+                    }
+                else:
+                    from ..pipeline import sort_bam
+
+                    stats = sort_bam(
+                        self._job_inputs(req),
+                        req["output"],
+                        conf=self.conf,
+                        level=int(req.get("level", 6)),
+                        memory_budget=req.get("memory_budget"),
+                        part_dir=req.get("part_dir"),
+                        write_splitting_bai=bool(
+                            req.get("write_splitting_bai")
+                        ),
+                        mark_duplicates=bool(req.get("mark_duplicates")),
+                        sort_order=req.get("sort_order"),
+                        resource_cache=self.ctx.cache,
+                        deadline=deadline,
+                    )
+                    stats_d = {
+                        "n_records": stats.n_records,
+                        "n_splits": stats.n_splits,
+                        "backend": stats.backend,
+                        "n_duplicates": stats.n_duplicates,
+                    }
                 with self._jobs_lock:
                     self._jobs[jid].update(status="done", stats=stats_d)
                 self._journal_state(jid, "done", stats=stats_d)
@@ -980,12 +1027,12 @@ class BamDaemon:
                     ticket.release()
                 if rctx is not None:
                     # The job's own completion record: same trace id as
-                    # the submission, op "sort.job", so a failed or slow
-                    # job earns its exemplar even though the submission
-                    # request replied fast.
+                    # the submission, op "<kind>.job", so a failed or
+                    # slow job earns its exemplar even though the
+                    # submission request replied fast.
                     summary = exemplars_mod.request_summary(
                         rctx, outcome, rctx.elapsed_ms(),
-                        op="sort.job", extra={"job": jid},
+                        op=f"{kind}.job", extra={"job": jid},
                     )
                     self.sampler.observe(summary)
                     if self._access_log is not None:

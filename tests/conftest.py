@@ -90,6 +90,13 @@ def pytest_configure(config):
         "in-process <=3-daemon smoke is always-on, the multi-process "
         "kill -9 drill also carries `slow`",
     )
+    config.addinivalue_line(
+        "markers",
+        "ingest: FASTQ ingest plane (ingest.py + "
+        "ops/pallas/record_scan.py) tests — always-on scans stay "
+        "<=3 KiB in interpret mode; full-size device-geometry scans "
+        "also carry `slow`",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -184,3 +184,136 @@ class TestQualityHelpers:
         mask = b.valid_mask()
         assert mask[0].sum() == 91 and mask[1].sum() == 9
         assert b.seq.dtype == np.uint8
+
+
+class TestResyncRegression:
+    """``position_at_first_record`` regression corpus (PR 19 satellite):
+    the old single-frame probe accepted any ``@``-line with a ``+`` two
+    lines down; a corrupt prefix whose quality line is torn fooled it.
+    The fix demands the candidate frame verify (seq/qual lengths match)
+    AND the *next* frame verify too (or not exist: EOF waiver)."""
+
+    CORRUPT_PREFIX = (
+        b"GARBAGE\n"
+        b"@fake\nAAAA\n+BBB\n"  # torn: '+BBB' is a plus-line, no qual
+        b"@real1\nACGT\n+\nIIII\n"
+        b"@real2\nTTTT\n+\nJJJJ\n"
+    )
+
+    def test_corrupt_prefix_resyncs_past_fake_record(self):
+        # A split landing inside 'GARBAGE' skips the partial line and
+        # probes '@fake': it has a '+' two lines down (the old
+        # acceptance test), but its frame fails the length check and
+        # the window walks on to '@real1'.
+        fmt = FastqInputFormat()
+        b = batch_from(fmt, self.CORRUPT_PREFIX, 2, len(self.CORRUPT_PREFIX))
+        assert b.names == ["real1", "real2"]
+
+    def test_single_record_at_eof_is_waived(self):
+        # The two-consecutive-records rule must not demand a second
+        # record when the candidate is the last one in the split.
+        data = b"XX\n@only\nACGT\n+\nIIII\n"
+        b = batch_from(FastqInputFormat(), data, 1, len(data))
+        assert b.names == ["only"]
+
+    def test_quality_at_same_length_every_cut(self):
+        # Qualities starting with '@' and exactly seq-length: the
+        # hardest resync corpus.  Every cut point still yields
+        # exactly-once record delivery across the two splits.
+        rec = b"@id%d\nACGT\n+\n@@@@\n"
+        data = b"".join(rec % i for i in range(8))
+        fmt = FastqInputFormat()
+        for cut in range(1, len(data)):
+            n = (
+                batch_from(fmt, data, 0, cut).n_records
+                + batch_from(fmt, data, cut, len(data)).n_records
+            )
+            assert n == 8, f"cut={cut}"
+
+
+class TestPairedPathologies:
+    """Paired-end ingest pathologies (PR 19 satellite): suffix vs CASAVA
+    read numbers, orphan census, unequal R1/R2 on strict and salvage."""
+
+    @staticmethod
+    def _write(tmp_path, name, text: bytes) -> str:
+        p = str(tmp_path / name)
+        with open(p, "wb") as f:
+            f.write(text)
+        return p
+
+    def test_slash_suffix_and_casava_agree_on_read_numbers(self, tmp_path):
+        from hadoop_bam_tpu.ingest import ingest_fastq
+
+        slash = (
+            b"@q0/1\nACGT\n+\nIIII\n@q1/1\nTTTT\n+\nJJJJ\n",
+            b"@q0/2\nGGGG\n+\nKKKK\n@q1/2\nCCCC\n+\nLLLL\n",
+        )
+        casava = (
+            b"@q0 1:N:0:AC\nACGT\n+\nIIII\n@q1 1:N:0:AC\nTTTT\n+\nJJJJ\n",
+            b"@q0 2:N:0:AC\nGGGG\n+\nKKKK\n@q1 2:N:0:AC\nCCCC\n+\nLLLL\n",
+        )
+        for tag, (r1, r2) in (("slash", slash), ("casava", casava)):
+            p1 = self._write(tmp_path, f"{tag}_1.fastq", r1)
+            p2 = self._write(tmp_path, f"{tag}_2.fastq", r2)
+            out = str(tmp_path / f"{tag}.bam")
+            stats = ingest_fastq(p1, out, r2=p2, level=1)
+            assert stats.n_records == 4, tag
+            assert stats.n_pairs == 2 and stats.n_orphans == 0, tag
+
+    def test_orphan_census(self, tmp_path):
+        from hadoop_bam_tpu.ingest import ingest_fastq
+
+        # Same record count per side, but q2's mate is missing from R2
+        # (a stray 'z9' sits in its place): census flags both as
+        # orphans, the true pairs stay pairs.
+        r1 = b"".join(b"@q%d/1\nACGT\n+\nIIII\n" % i for i in range(3))
+        r2 = (
+            b"@q0/2\nGGGG\n+\nKKKK\n@q1/2\nCCCC\n+\nLLLL\n"
+            b"@z9/2\nAAAA\n+\nMMMM\n"
+        )
+        p1 = self._write(tmp_path, "o1.fastq", r1)
+        p2 = self._write(tmp_path, "o2.fastq", r2)
+        stats = ingest_fastq(p1, str(tmp_path / "o.bam"), r2=p2, level=1)
+        assert stats.n_records == 6
+        assert stats.n_pairs == 2
+        assert stats.n_orphans == 2  # q2/1 and z9/2
+
+    def test_unequal_r1_r2_strict_raises_salvage_truncates(self, tmp_path):
+        from hadoop_bam_tpu.ingest import ingest_fastq, ingest_oracle
+
+        r1 = b"".join(b"@p%d/1\nACGT\n+\nIIII\n" % i for i in range(5))
+        r2 = b"".join(b"@p%d/2\nGGGG\n+\nKKKK\n" % i for i in range(3))
+        p1 = self._write(tmp_path, "u1.fastq", r1)
+        p2 = self._write(tmp_path, "u2.fastq", r2)
+        got = str(tmp_path / "got.bam")
+        with pytest.raises(FormatException):
+            ingest_fastq(p1, got, r2=p2, level=1)
+        stats = ingest_fastq(p1, got, r2=p2, level=1, errors="salvage")
+        assert stats.n_records == 6  # truncated to min(5, 3) per side
+        assert stats.n_tail_records == 2
+        want = str(tmp_path / "want.bam")
+        ingest_oracle(p1, want, r2=p2, level=1, errors="salvage")
+        with open(got, "rb") as f1, open(want, "rb") as f2:
+            assert f1.read() == f2.read()
+
+
+class TestQseqFilterFlags:
+    def test_filter_failed_qc_conf_drops_zero_flag(self):
+        passed = QSEQ_LINE  # trailing '\t1'
+        failed = QSEQ_LINE[:-1] + b"0"
+        data = passed + b"\n" + failed + b"\n"
+        b = batch_from(QseqInputFormat(), data)
+        assert b.n_records == 2
+        assert b.fragments[0].filter_passed is True
+        assert b.fragments[1].filter_passed is False
+        conf = Configuration({"hbam.qseq-input.filter-failed-qc": "true"})
+        b2 = batch_from(QseqInputFormat(conf), data)
+        assert b2.n_records == 1
+        assert b2.fragments[0].filter_passed is True
+
+    def test_generic_input_filter_key_also_applies(self):
+        failed = QSEQ_LINE[:-1] + b"0"
+        conf = Configuration({"hbam.input.filter-failed-qc": "true"})
+        b = batch_from(QseqInputFormat(conf), failed + b"\n")
+        assert b.n_records == 0

@@ -701,6 +701,77 @@ def test_router_retries_read_on_successor_and_drops_draining(
             t.join(timeout=20)
 
 
+def test_router_eager_death_on_connection_refused(sorted_bam, tmp_path):
+    """Eager death detection (PR 19 satellite): ECONNREFUSED from a
+    member whose heartbeat is still fresh is active OS evidence the
+    listener died between beats — the router buries it immediately
+    (``fleet.eager_refused``) instead of waiting out the heartbeat
+    floor, and the successor retry answers against the repaired ring.
+    A *nonexistent* socket (FileNotFoundError) must NOT trigger it —
+    that path stays on the plain retry ramp."""
+    import shutil
+    import socket as socket_mod
+
+    fdir = str(tmp_path / "fleet")
+    daemons = _start_fleet(tmp_path, ["e-a", "e-b"], fdir)
+    router, rt, client = _start_router(
+        tmp_path, fdir, heartbeat_timeout_ms=60_000.0
+    )
+    try:
+        # A genuinely refusing endpoint: bind + close leaves the socket
+        # file behind, and connect() gets ECONNREFUSED from the kernel.
+        ghost_sock = str(tmp_path / "ghost.sock")
+        s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        s.bind(ghost_sock)
+        s.listen(1)
+        s.close()
+        fleet_mod.write_member(fdir, {
+            "name": "e-ghost",
+            "endpoint": {"socket": ghost_sock},
+            "t_wall": time.time(), "seq": 1,
+        })
+        router.scan_members()
+        holed = None
+        for i in range(48):
+            p = str(tmp_path / f"g{i}.bam")
+            shutil.copyfile(sorted_bam, p)
+            shutil.copyfile(sorted_bam + ".bai", p + ".bai")
+            if router.ring.owner(fleet_mod.file_key(p)) == "e-ghost":
+                holed = p
+                break
+        assert holed, "48 distinct identities never hashed to the ghost"
+        s0 = snapshot()
+        r = client._request(
+            {"op": "view", "path": holed,
+             "region": "chr1:100000-300000", "level": 1},
+            idempotent=True,
+        )
+        assert r["member"] in ("e-a", "e-b")  # successor answered
+        dlt = delta(s0)["counters"]
+        assert dlt.get("fleet.eager_refused", 0) == 1
+        assert dlt.get("fleet.deaths", 0) == 1
+        view = client.fleet()
+        assert "e-ghost" in view["dead"]  # buried without a beat missed
+        assert "e-ghost" not in view["members"]
+        # Routing against the repaired ring: the same identity now has a
+        # live owner, no further eager burials.
+        s1 = snapshot()
+        r2 = client._request(
+            {"op": "view", "path": holed,
+             "region": "chr1:100000-300000", "level": 1},
+            idempotent=True,
+        )
+        assert r2["member"] in ("e-a", "e-b")
+        assert delta(s1)["counters"].get("fleet.eager_refused", 0) == 0
+    finally:
+        client.shutdown()
+        router.stop()
+        rt.join(timeout=20)
+        for _, _, t, c in daemons:
+            c.shutdown()
+            t.join(timeout=20)
+
+
 # ---------------------------------------------------------------------------
 # Warmth migration: pack/unpack windows across arenas
 # ---------------------------------------------------------------------------

@@ -1,0 +1,399 @@
+"""FASTQ ingest plane: the record-scan kernel's three tiers, the gzip
+member probe/repack, and end-to-end byte-identity of ``ingest_fastq``
+against the pure-host oracle on the in-core, memory-budget, and salvage
+paths.
+
+Kernel geometry discipline (test-budget note): every always-on
+record_scan launch in this file pins the ONE small geometry —
+256-byte claims with 256-byte overlap (512-byte windows → 256 packed
+words) and ``rec_cap=64`` — so the in-process jit cache compiles the
+interpret-mode kernel once; corpora stay ≤3 KiB.  Full-size
+(57 KiB-claim) scans ride the e2e tests' host tier on a cpu pin and
+would carry ``slow`` if ever launched at device geometry here.
+"""
+
+import gzip
+import random
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.conf import (
+    FASTQ_BASE_QUALITY_ENCODING,
+    INGEST_CHUNK_BYTES,
+    INGEST_SCAN_OVERLAP,
+    Configuration,
+)
+from hadoop_bam_tpu.ingest import (
+    IngestStats,
+    _bgzf_repack,
+    _member_table,
+    ingest_fastq,
+    ingest_oracle,
+)
+from hadoop_bam_tpu.ops.pallas.record_scan import (
+    WindowOverrun,
+    record_scan,
+    scan_window_host,
+    scan_window_py,
+)
+from hadoop_bam_tpu.spec import bgzf
+from hadoop_bam_tpu.spec.fragment import FormatException
+
+pytestmark = pytest.mark.ingest
+
+# The pinned small geometry (see module docstring).
+CHUNK = 256
+OVERLAP = 256
+REC_CAP = 64
+
+
+def make_fastq(n, seed=0, crlf=False, qual_at_every=0, trailing_nl=True,
+               name="r"):
+    """A deterministic corpus: ``n`` records, optional CRLF endings and
+    qualities beginning with ``@`` every ``qual_at_every``-th record."""
+    rng = random.Random(seed)
+    eol = "\r\n" if crlf else "\n"
+    recs = []
+    for i in range(n):
+        ln = rng.randrange(6, 36)
+        seq = "".join(rng.choice("ACGTN") for _ in range(ln))
+        first = "@" if qual_at_every and i % qual_at_every == 0 else "I"
+        qual = first + "".join(
+            chr(rng.randrange(33, 74)) for _ in range(ln - 1)
+        )
+        recs.append(eol.join([f"@{name}{i}", seq, "+", qual]) + eol)
+    text = "".join(recs)
+    if not trailing_nl:
+        text = text.rstrip("\r\n")
+    return text.encode()
+
+
+def chunks_of(run, aligned=True):
+    out = []
+    for off in range(0, len(run), CHUNK):
+        win = run[off: off + CHUNK + OVERLAP]
+        out.append((
+            win,
+            min(CHUNK, len(run) - off),
+            aligned and off == 0,
+            off + len(win) >= len(run),
+        ))
+    return out
+
+
+def stitch(tables):
+    """Run-absolute record table from per-chunk window-relative ones."""
+    parts = []
+    for k, t in enumerate(tables):
+        if t is not None and len(t):
+            adj = t + np.int32(k * CHUNK) * np.array([1, 0] * 4, np.int32)
+            parts.append(adj)
+    return np.concatenate(parts) if parts else np.zeros((0, 8), np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Three-tier equality
+
+
+@pytest.mark.parametrize("crlf", [False, True])
+@pytest.mark.parametrize("qual_at", [0, 3])
+def test_scan_three_tiers_bit_identical(crlf, qual_at):
+    """Kernel (interpret mode on a cpu pin), NumPy host scan, and the
+    Python walker produce the same record table per chunk — including
+    CRLF endings and qualities beginning with '@' (which must never
+    split a record)."""
+    run = make_fastq(30, seed=11, crlf=crlf, qual_at_every=qual_at)
+    assert len(run) <= 3 << 10
+    chunks = chunks_of(run)
+    tables, stats = record_scan(chunks, rec_cap=REC_CAP)
+    assert stats.launches >= 1
+    host = [scan_window_host(*c) for c in chunks]
+    for k, (t, h) in enumerate(zip(tables, host)):
+        if t is not None:
+            np.testing.assert_array_equal(t, h, err_msg=f"chunk {k}")
+    full = stitch(host)
+    walker, nq = scan_window_py(run, len(run), True, True)
+    assert nq == 0
+    np.testing.assert_array_equal(full, walker)
+    assert len(full) == 30  # every record claimed exactly once
+
+
+def test_scan_final_window_without_trailing_newline():
+    run = make_fastq(12, seed=4, trailing_nl=False)
+    chunks = chunks_of(run)
+    tables, _ = record_scan(chunks, rec_cap=REC_CAP)
+    host = [scan_window_host(*c) for c in chunks]
+    for t, h in zip(tables, host):
+        if t is not None:
+            np.testing.assert_array_equal(t, h)
+    walker, _ = scan_window_py(run, len(run), True, True)
+    np.testing.assert_array_equal(stitch(host), walker)
+    assert len(walker) == 12
+
+
+def test_scan_unaligned_run_resyncs_identically():
+    """A post-gap run starts mid-record: all tiers drop the torn head
+    via the two-consecutive-verified-records rule and agree on the
+    rest."""
+    full = make_fastq(24, seed=7)
+    run = full[17:]  # mid-record: torn head frame
+    chunks = chunks_of(run, aligned=False)
+    tables, _ = record_scan(chunks, rec_cap=REC_CAP)
+    host = [scan_window_host(*c) for c in chunks]
+    for t, h in zip(tables, host):
+        if t is not None:
+            np.testing.assert_array_equal(t, h)
+    walker, _ = scan_window_py(run, len(run), False, True)
+    np.testing.assert_array_equal(stitch(host), walker)
+    assert len(walker) == 23  # the torn first record is dropped
+
+
+def test_scan_tier_down_is_per_chunk_not_per_launch():
+    """A garbage chunk and a clean chunk in the SAME launch: the garbage
+    lane reports ok=0 and tiers down alone; the clean chunk's table
+    comes back from the kernel."""
+    clean = make_fastq(8, seed=2)[:CHUNK + OVERLAP]
+    garbage = bytes(range(1, 128)) * 4  # no record structure, no sync
+    chunks = [
+        (garbage[:CHUNK + OVERLAP], CHUNK, True, False),
+        (clean, min(CHUNK, len(clean)), True, True),
+    ]
+    tables, stats = record_scan(chunks, rec_cap=REC_CAP)
+    assert stats.launches == 1
+    assert tables[0] is None  # per-chunk tier-down...
+    assert tables[1] is not None  # ...never per-launch
+    assert stats.host == 1 and stats.lanes == 1
+    assert stats.reasons.get("scan", 0) == 1
+
+
+def test_scan_size_gate_tiers_down_per_chunk():
+    """An oversized window is gated before launch (reason "size") while
+    normal chunks still scan."""
+    big = b"\n" * ((1 << 17) + 64)
+    ok = make_fastq(6, seed=3)[:CHUNK + OVERLAP]
+    tables, stats = record_scan(
+        [(big, 1 << 17, True, False), (ok, min(CHUNK, len(ok)), True, True)],
+        rec_cap=REC_CAP,
+    )
+    assert tables[0] is None
+    assert tables[1] is not None
+    assert stats.reasons.get("size", 0) == 1
+
+
+def test_host_scan_overrun_and_walker_salvage():
+    """A record spilling past a non-final window raises WindowOverrun in
+    the host tier (the caller rescans the run serially); the walker's
+    salvage mode quarantines torn frames instead of raising."""
+    rec = b"@long\n" + b"A" * 300 + b"\n+\n" + b"I" * 300 + b"\n"
+    with pytest.raises(WindowOverrun):
+        scan_window_host(rec[: CHUNK + OVERLAP], CHUNK, True, False)
+    torn = b"@a\nACGT\n+\nIII\n@b\nGGGG\n+\nJJJJ\n"  # len(qual) != len(seq)
+    with pytest.raises(FormatException):
+        scan_window_py(torn, len(torn), True, True)
+    table, nq = scan_window_py(torn, len(torn), True, True, salvage=True)
+    assert nq >= 1
+    assert len(table) == 1  # @b survives, the torn frame quarantined
+
+
+# ---------------------------------------------------------------------------
+# gzip member probe and BGZF repack
+
+
+def test_plain_gzip_members_repack_to_valid_bgzf():
+    payload = make_fastq(10, seed=5)
+    blob = gzip.compress(payload[:200], 6) + gzip.compress(payload[200:], 6)
+    stats = IngestStats()
+    members, dev_buf = _member_table(blob, "strict", stats)
+    assert len(members) == 2 and stats.n_repacked == 2
+    got = b""
+    for m in members:
+        off, csize = m.dev
+        hdr = bgzf.parse_block_header(dev_buf, off)
+        assert hdr is not None and hdr[0] == csize
+        part, consumed = bgzf.inflate_block(dev_buf, off)
+        assert consumed == csize
+        got += part
+    assert got == payload  # repack is a pure header rewrite
+
+
+def test_oversized_gzip_member_stays_on_host_tier():
+    big = (b"@r0\n" + b"A" * 40000 + b"\n+\n" + b"I" * 40000 + b"\n") * 2
+    blob = gzip.compress(big, 1)  # usize > 0xFFFF: no BGZF frame fits
+    stats = IngestStats()
+    members, dev_buf = _member_table(blob, "strict", stats)
+    assert len(members) == 1
+    assert members[0].dev is None and members[0].raw is not None
+    assert stats.n_host_members == 1 and dev_buf == b""
+
+
+def test_repack_rejects_oversized_and_accepts_small():
+    small = gzip.compress(b"x" * 100, 6)
+    assert _bgzf_repack(small, 0, len(small)) is not None
+    big = gzip.compress(bytes(70000), 0)
+    assert _bgzf_repack(big, 0, len(big)) is None  # ISIZE > 0xFFFF
+
+
+# ---------------------------------------------------------------------------
+# End-to-end byte-identity vs the host oracle
+
+
+def _gz_members(text: bytes, member_bytes=600):
+    out = b""
+    for k in range(0, len(text), member_bytes):
+        out += gzip.compress(text[k: k + member_bytes], 5)
+    return out
+
+
+def _pe_corpus(tmp_path, n=40, seed=0):
+    r1 = make_fastq(n, seed=seed, qual_at_every=5, name="q")
+    r2 = make_fastq(n, seed=seed + 1, qual_at_every=7, name="q")
+    p1, p2 = str(tmp_path / "r1.fastq.gz"), str(tmp_path / "r2.fastq.gz")
+    with open(p1, "wb") as f:
+        f.write(_gz_members(r1))
+    with open(p2, "wb") as f:
+        f.write(_gz_members(r2))
+    return p1, p2
+
+
+def test_ingest_in_core_matches_oracle(tmp_path):
+    p1, p2 = _pe_corpus(tmp_path)
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    stats = ingest_fastq(p1, got, r2=p2, level=4)
+    n = ingest_oracle(p1, want, r2=p2, level=4)
+    assert stats.n_records == n == 80
+    assert stats.n_pairs == 40 and stats.n_orphans == 0
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_ingest_memory_budget_byte_identical(tmp_path):
+    p1, p2 = _pe_corpus(tmp_path, seed=3)
+    a, b = str(tmp_path / "a.bam"), str(tmp_path / "b.bam")
+    ingest_fastq(p1, a, r2=p2, level=4)
+    stats = ingest_fastq(
+        p1, b, r2=p2, level=4, memory_budget=256,
+        part_dir=str(tmp_path / "spill"),
+    )
+    assert stats.n_records == 80
+    with open(a, "rb") as f1, open(b, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_ingest_salvage_quarantines_members_byte_identical(tmp_path):
+    text = make_fastq(40, seed=9)
+    members = [gzip.compress(text[k: k + 500], 5)
+               for k in range(0, len(text), 500)]
+    bad = bytearray(members[1])
+    for j in range(14, 26):
+        bad[j] ^= 0xFF
+    blob = b"".join([members[0], bytes(bad)] + members[2:])
+    p = str(tmp_path / "corrupt.fastq.gz")
+    with open(p, "wb") as f:
+        f.write(blob)
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    with pytest.raises(FormatException):
+        ingest_fastq(p, got, level=4)  # strict aborts
+    stats = ingest_fastq(p, got, level=4, errors="salvage")
+    n = ingest_oracle(p, want, level=4, errors="salvage")
+    assert stats.n_quarantined_members == 1
+    assert 0 < stats.n_records == n < 40  # whole records lost, none torn
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_ingest_small_chunk_conf_exercises_scan_tiling(tmp_path):
+    """Tiny conf-driven claim regions force multi-chunk scans per run;
+    the tiling reconciliation accepts the stitched tables and output
+    stays byte-identical to the oracle."""
+    text = make_fastq(30, seed=13, qual_at_every=4)
+    p = str(tmp_path / "t.fastq.gz")
+    with open(p, "wb") as f:
+        f.write(gzip.compress(text, 5))
+    conf = Configuration()
+    conf.set(INGEST_CHUNK_BYTES, str(CHUNK))
+    conf.set(INGEST_SCAN_OVERLAP, str(OVERLAP))
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    stats = ingest_fastq(p, got, conf=conf, level=4)
+    ingest_oracle(p, want, level=4)
+    assert stats.scan_chunks > 1 and stats.scan_serial == 0
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_ingest_uncompressed_single_end(tmp_path):
+    text = make_fastq(15, seed=21)
+    p = str(tmp_path / "plain.fastq")
+    with open(p, "wb") as f:
+        f.write(text)
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    stats = ingest_fastq(p, got, level=4)
+    ingest_oracle(p, want, level=4)
+    assert stats.n_records == 15 and stats.n_singletons == 15
+    assert stats.n_members == 0  # plain text: no member table
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_ingest_illumina_quality_conversion(tmp_path):
+    rng = random.Random(5)
+    recs = []
+    for i in range(10):
+        ln = rng.randrange(6, 20)
+        seq = "".join(rng.choice("ACGT") for _ in range(ln))
+        qual = "".join(chr(rng.randrange(64, 104)) for _ in range(ln))
+        recs.append(f"@i{i}\n{seq}\n+\n{qual}\n")
+    p = str(tmp_path / "ill.fastq")
+    with open(p, "w") as f:
+        f.write("".join(recs))
+    conf = Configuration()
+    conf.set(FASTQ_BASE_QUALITY_ENCODING, "illumina")
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    ingest_fastq(p, got, conf=conf, level=4)
+    ingest_oracle(p, want, conf=conf, level=4)
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()
+    # The default sanger interpretation stores different qualities (no
+    # -31 shift), so the two encodings must not collide byte-for-byte.
+    sanger = str(tmp_path / "sanger.bam")
+    ingest_fastq(p, sanger, level=4)
+    with open(got, "rb") as f1, open(sanger, "rb") as f2:
+        assert f1.read() != f2.read()
+
+
+# ---------------------------------------------------------------------------
+# Serve front door
+
+
+@pytest.mark.serve
+def test_daemon_ingest_job_byte_identical(tmp_path):
+    """The daemon's ``ingest`` op runs through the same journaled job
+    plane as sort and lands byte-identical output."""
+    import threading
+
+    from hadoop_bam_tpu.serve.client import ServeClient
+    from hadoop_bam_tpu.serve.server import BamDaemon
+
+    p1, p2 = _pe_corpus(tmp_path, n=25, seed=8)
+    sock = str(tmp_path / "serve.sock")
+    d = BamDaemon(socket_path=sock, warmup=False)
+    ready = threading.Event()
+    t = threading.Thread(target=d.serve_forever, args=(ready,), daemon=True)
+    t.start()
+    assert ready.wait(20), "daemon did not come up"
+    client = ServeClient(socket_path=sock)
+    got, want = str(tmp_path / "got.bam"), str(tmp_path / "want.bam")
+    try:
+        jid = client.ingest(p1, got, r2=p2, level=4)
+        st = client.wait(jid, timeout=60)
+        assert st["status"] == "done"
+        assert st["stats"]["n_records"] == 50
+        assert st["stats"]["n_pairs"] == 25
+    finally:
+        client.shutdown()
+        t.join(timeout=30)
+    ingest_oracle(p1, want, r2=p2, level=4)
+    with open(got, "rb") as f1, open(want, "rb") as f2:
+        assert f1.read() == f2.read()

@@ -629,3 +629,39 @@ def test_cram_rans_metric_literals_present():
         "cram.stage.series",
     ):
         assert want in names, f"metric literal {want!r} missing"
+
+
+def test_ingest_metric_literals_present():
+    """The FASTQ ingest-plane namespaces exist as literals in the
+    package — tests/test_ingest.py and bench.py's ingest leg read these
+    exact names (member/tier accounting, scan tier hit rate, salvage
+    losses), so a rename that skips them fails here, next to the shape
+    lint."""
+    names = set()
+    for f in sorted((REPO / "hadoop_bam_tpu").rglob("*.py")):
+        for m in _NAME_CALL.finditer(f.read_text()):
+            names.add(m.group(2))
+    for want in (
+        "ingest.records",
+        "ingest.pairs",
+        "ingest.orphans",
+        "ingest.out_bytes",
+        "ingest.inflate.members",
+        "ingest.inflate.bytes",
+        "ingest.inflate.repacked",
+        "ingest.inflate.host_members",
+        "fastq.scan.chunks",
+        "fastq.scan.lanes",
+        "fastq.scan.host",
+        "fastq.scan.serial_fallback",
+        "fastq.scan.reconciled",
+        "salvage.ingest_members",
+        "salvage.ingest_frames",
+        "salvage.ingest_tail_records",
+        "ingest.stage.decode",
+        "ingest.stage.scan",
+        "ingest.stage.collate",
+        "ingest.stage.write",
+        "fleet.eager_refused",
+    ):
+        assert want in names, f"metric literal {want!r} missing"
