@@ -30,6 +30,23 @@ N_RECORDS = int(os.environ.get("HBAM_BENCH_RECORDS", "4000000"))
 SPLIT_SIZE = int(os.environ.get("HBAM_BENCH_SPLIT", str(2 << 20)))
 
 
+def _leg_enabled(name: str) -> bool:
+    """Secondary-leg selector: ``HBAM_BENCH_LEGS`` is ``all`` (default),
+    ``none``, or a comma list of leg names (``serve``, ``overload``,
+    ``multichip``, ``robustness``, ``cram``, ``fleet``, ``ingest``,
+    ``variants``).  The headline sort is never a leg — only the
+    diagnostics are skippable (CI's JSON-shape guard runs with ``none``
+    so a shape regression surfaces in seconds, not minutes; a skipped
+    leg updates no headline by construction since its keys are absent).
+    """
+    legs = os.environ.get("HBAM_BENCH_LEGS", "all").strip().lower()
+    if legs in ("", "all"):
+        return True
+    if legs == "none":
+        return False
+    return name in {part.strip() for part in legs.split(",")}
+
+
 def _reg2bin_np(beg: np.ndarray, end: np.ndarray) -> np.ndarray:
     """Vectorized UCSC binning (spec.bam.reg2bin semantics)."""
     e = end - 1
@@ -381,7 +398,8 @@ def _measure(platform: str) -> dict:
     # resident-server thesis (warm kernel/index caches + HBM arena) as
     # numbers per round.
     try:
-        out.update(_serve_bench(tmp))
+        if _leg_enabled("serve"):
+            out.update(_serve_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["serve_bench_error"] = str(e)[:120]
     # Overload resilience (both platforms): goodput and typed-refusal
@@ -390,7 +408,8 @@ def _measure(platform: str) -> dict:
     # an injected arena.oom storm — the PR 10 acceptance numbers, per
     # round rather than asserted once.
     try:
-        out.update(_overload_bench(tmp))
+        if _leg_enabled("overload"):
+            out.update(_overload_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["overload_bench_error"] = str(e)[:120]
     # Mesh observability probe (both platforms; the workers pin a
@@ -402,7 +421,8 @@ def _measure(platform: str) -> dict:
     # without one, or with any host degraded, never updates a headline —
     # BENCH_NOTES).
     try:
-        out.update(_multichip_bench(tmp))
+        if _leg_enabled("multichip"):
+            out.update(_multichip_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["multichip_bench_error"] = str(e)[:120]
     # Robustness diagnostics (both platforms): the salvage policy layer's
@@ -411,7 +431,8 @@ def _measure(platform: str) -> dict:
     # file with injected corrupt members completes under salvage — so
     # robustness regressions show up in the round JSON like perf ones.
     try:
-        out.update(_robustness_bench(tmp))
+        if _leg_enabled("robustness"):
+            out.update(_robustness_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["robustness_bench_error"] = str(e)[:120]
     # CRAM on the lanes (both platforms): the archive format's decode
@@ -422,7 +443,8 @@ def _measure(platform: str) -> dict:
     # lanes-tier hit rate when armed.  Same round provenance as every
     # other number: a degraded round never updates a headline.
     try:
-        out.update(_cram_bench(tmp, platform))
+        if _leg_enabled("cram"):
+            out.update(_cram_bench(tmp, platform))
     except Exception as e:  # never fail the headline for a diagnostic
         out["cram_bench_error"] = str(e)[:120]
     # Fleet service mode (both platforms): goodput vs 1/2/4 daemons
@@ -431,7 +453,8 @@ def _measure(platform: str) -> dict:
     # recovery drill — seconds from SIGKILL to the adopted job's
     # byte-identical completion, with zero lost jobs (PR 18).
     try:
-        out.update(_fleet_bench(tmp))
+        if _leg_enabled("fleet"):
+            out.update(_fleet_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["fleet_bench_error"] = str(e)[:120]
     # FASTQ ingest plane (both platforms): gzip-member decode on the
@@ -440,10 +463,134 @@ def _measure(platform: str) -> dict:
     # (byte-identity gated).  Same round provenance as every other
     # number: a degraded round never updates a headline.
     try:
-        out.update(_ingest_bench(tmp))
+        if _leg_enabled("ingest"):
+            out.update(_ingest_bench(tmp))
     except Exception as e:  # never fail the headline for a diagnostic
         out["ingest_bench_error"] = str(e)[:120]
+    # Variant plane (both platforms): warm region-query throughput
+    # through the serve endpoint, segmented pileup pace, the chain-walk
+    # tier hit rate when armed, and the served-BCF byte-identity gate
+    # against the exact spec-oracle re-encode.  Same round provenance as
+    # every other number: a degraded round never updates a headline.
+    try:
+        if _leg_enabled("variants"):
+            out.update(_variants_bench(tmp))
+    except Exception as e:  # never fail the headline for a diagnostic
+        out["variants_bench_error"] = str(e)[:120]
     return out
+
+
+def _variants_bench(tmp: str) -> dict:
+    """BCF region queries + pileup depth: warm queries/s through the
+    variants endpoint (arena-resident windows, per-request ragged join,
+    BCF re-encode), pileup Mbp/s over a realistic span census, the
+    fraction of chain walks the device tier claimed while armed, and a
+    byte-identity gate — the served blob must decode-and-re-encode equal
+    to the exact ``spec/bcf.py`` oracle's answer for the same region."""
+    from hadoop_bam_tpu.conf import BCF_CHAIN, Configuration
+    from hadoop_bam_tpu.io.bcf import BcfRecordWriter
+    from hadoop_bam_tpu.serve.endpoints import ServeContext, variants_blob
+    from hadoop_bam_tpu.spec import bcf as _bcf
+    from hadoop_bam_tpu.spec import bgzf as _bgzf
+    from hadoop_bam_tpu.spec.vcf import VcfHeader, parse_variant_line
+    from hadoop_bam_tpu.utils.tracing import delta, snapshot
+
+    n = max(5000, N_RECORDS // 200)
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=250000000>",
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+    ]
+    vcf = VcfHeader(lines)
+    variants = [
+        parse_variant_line(
+            f"chr1\t{100 + i * 40}\t.\t{'ACGT'[i % 4]}\tT\t30\tPASS\tDP={i}"
+        )
+        for i in range(n)
+    ]
+    hdr = _bcf.BcfHeader(vcf)
+    raw = _bcf.encode_header(vcf) + b"".join(
+        _bcf.encode_record(hdr, v) for v in variants
+    )
+    from hadoop_bam_tpu import native as _native
+
+    path = os.path.join(tmp, "bench.bcf")
+    with open(path, "wb") as f:
+        f.write(
+            bytes(
+                _native.deflate_blocks(
+                    np.frombuffer(raw, np.uint8), level=6
+                )
+            )
+            + _bgzf.TERMINATOR
+        )
+
+    conf = Configuration()
+    conf.set(BCF_CHAIN, "true")  # measure the armed plane's tier mix
+    ctx = ServeContext.from_conf(conf, with_batcher=False)
+    try:
+        span = (100 + n * 40) // 8
+        regions = [
+            f"chr1:{1 + k * span}-{(k + 1) * span}" for k in range(8)
+        ]
+        before = snapshot()
+        variants_blob(ctx, path, regions[0])  # cold: plan + decode
+        n_q = 32
+        t0 = time.time()
+        for k in range(n_q):
+            blob = variants_blob(ctx, path, regions[k % len(regions)])
+        t_q = time.time() - t0
+        d = delta(before)["counters"]
+        # Host oracle for the same region: exact per-record spec decode
+        # over the whole stream + interval filter + the same writer.
+        # Byte-identity gates the ratio — a wrong answer reports an
+        # error, never a pace.
+        lo, hi = 1 + 3 * span, 4 * span
+        t0 = time.time()
+        got = variants_blob(ctx, path, f"chr1:{lo}-{hi}")
+        t_serve = time.time() - t0
+        t0 = time.time()
+        payload = raw
+        p = len(_bcf.encode_header(vcf))
+        want_buf = io.BytesIO()
+        w = BcfRecordWriter(want_buf, vcf, append_terminator=True)
+        while p + 8 <= len(payload):
+            v, p = _bcf.decode_record(payload, p, hdr)
+            if v.pos <= hi and v.end >= lo:
+                w.write(v)
+        w.close()
+        t_oracle = time.time() - t0
+        if got != want_buf.getvalue():
+            return {"variants_bench_error": "byte-identity gate failed"}
+    finally:
+        ctx.close()
+    dev = d.get("bcf.chain.device_walks", 0)
+    walks = (
+        dev
+        + d.get("bcf.chain.host_walks", 0)
+        + d.get("bcf.chain.oracle_fallbacks", 0)
+    )
+
+    # Pileup pace: a read census over a 4 Mbp window, summarized.
+    from hadoop_bam_tpu.ops.pileup import depth_summary
+
+    rng = np.random.default_rng(13)
+    m = max(50_000, N_RECORDS // 40)
+    starts = np.sort(rng.integers(0, 4_000_000, m)).astype(np.int64)
+    ends = starts + rng.integers(50, 400, m)
+    depth_summary(starts, ends, 0, 1 << 16)  # warm the jit geometry
+    t0 = time.time()
+    depth_summary(starts, ends, 0, 4_000_000)
+    t_pile = time.time() - t0
+    return {
+        "variants_region_qps": round(n_q / max(t_q, 1e-9), 1),
+        "pileup_Mbp_per_sec": round(4.0 / max(t_pile, 1e-9), 1),
+        "bcf_walk_tier_hit_rate": round(dev / max(walks, 1), 4),
+        "variants_vs_host_oracle": round(
+            t_oracle / max(t_serve, 1e-9), 3
+        ),
+    }
 
 
 def _ingest_bench(tmp: str) -> dict:

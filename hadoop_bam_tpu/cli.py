@@ -487,6 +487,82 @@ def _cmd_flagstat(args) -> int:
     return 0
 
 
+def _cmd_variants(args) -> int:
+    """One-shot ranged variant query: the daemon's ``variants`` endpoint
+    without a daemon — same code path (serve.endpoints.variants_blob), so
+    the output BCF is byte-identical to a served response for the same
+    file and region."""
+    import json
+
+    from .conf import Configuration
+    from .serve.endpoints import ServeContext, variants_blob
+    from .utils.tracing import delta, snapshot
+
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    traced = _arm_trace(args, conf)
+    before = snapshot() if args.metrics else None
+    ctx = ServeContext.from_conf(conf, with_batcher=False)
+    try:
+        blob = variants_blob(ctx, args.bcf, args.region)
+    finally:
+        ctx.close()
+        _check_drained()
+        if traced:
+            _export_trace(args)
+    if args.output == "-":
+        sys.stdout.buffer.write(blob)
+    else:
+        with open(args.output, "wb") as f:
+            f.write(blob)
+        print(f"{args.output}: {len(blob)} bytes")
+    if args.metrics:
+        # The variant-plane tier story in one report: bcf.chain.* walk
+        # tiers, bcf.guess.* resync work, variants.join_* cut tiers,
+        # salvage.* quarantines — printed to stderr so `-o -` piping
+        # stays a clean BCF stream.
+        print(
+            json.dumps(delta(before), indent=2, sort_keys=True),
+            file=sys.stderr,
+        )
+    return 0
+
+
+def _cmd_depth(args) -> int:
+    """One-shot pileup depth summary (the daemon's ``depth`` endpoint)."""
+    import json
+
+    from .conf import Configuration
+    from .serve.endpoints import ServeContext, depth_stat
+    from .utils.tracing import delta, snapshot
+
+    conf = Configuration()
+    _apply_robustness_args(conf, args)
+    traced = _arm_trace(args, conf)
+    before = snapshot() if args.metrics else None
+    ctx = ServeContext.from_conf(conf, with_batcher=False)
+    try:
+        stat = depth_stat(
+            ctx,
+            args.bam,
+            args.region,
+            bin_size=args.bin_size,
+            per_base=args.per_base,
+        )
+    finally:
+        ctx.close()
+        _check_drained()
+        if traced:
+            _export_trace(args)
+    print(json.dumps(stat, indent=2, sort_keys=True))
+    if args.metrics:
+        print(
+            json.dumps(delta(before), indent=2, sort_keys=True),
+            file=sys.stderr,
+        )
+    return 0
+
+
 def _cmd_serve(args) -> int:
     """Run the resident daemon until a ``shutdown`` request (or SIGINT)."""
     from .conf import (
@@ -932,6 +1008,44 @@ def build_parser() -> argparse.ArgumentParser:
     _add_trace_arg(s)
     _add_robustness_args(s)
     s.set_defaults(func=_cmd_flagstat)
+
+    s = sub.add_parser(
+        "variants",
+        help="ranged BCF query: variant records overlapping a region as "
+             "a small BCF (same code path as the serve daemon's variants "
+             "endpoint; device record-chain walk under the "
+             "hadoopbam.bcf.chain gate)",
+    )
+    s.add_argument("bcf")
+    s.add_argument("region", help="contig | contig:pos | contig:start-end "
+                                  "(samtools thousands separators OK)")
+    s.add_argument("-o", "--output", default="-")
+    s.add_argument("--metrics", action="store_true",
+                   help="print the counter delta to stderr after the run "
+                        "(bcf.chain.*, bcf.guess.*, variants.*, "
+                        "salvage.* tier/fault accounting)")
+    _add_trace_arg(s)
+    _add_robustness_args(s)
+    s.set_defaults(func=_cmd_variants)
+
+    s = sub.add_parser(
+        "depth",
+        help="pileup depth summary over an alignment region (binned "
+             "vector + max/mean/coverage as JSON; same code path as the "
+             "daemon's depth endpoint)",
+    )
+    s.add_argument("bam")
+    s.add_argument("region", help="contig | contig:pos | contig:start-end")
+    s.add_argument("--bin-size", type=int, default=1 << 12)
+    s.add_argument("--per-base", action="store_true",
+                   help="include the exact per-base vector (span-capped "
+                        "server-side)")
+    s.add_argument("--metrics", action="store_true",
+                   help="print the counter delta to stderr after the run "
+                        "(pileup.* tier accounting)")
+    _add_trace_arg(s)
+    _add_robustness_args(s)
+    s.set_defaults(func=_cmd_depth)
 
     s = sub.add_parser(
         "serve",

@@ -86,6 +86,15 @@ WRITE_DEVICE = "hadoopbam.write.device"
 # that trip a size/VMEM/context/format gate tier down per-slice to the
 # NumPy host decoder and the Python oracle (spec/cram_codecs.py).
 CRAM_RANS_LANES = "hadoopbam.cram.rans-lanes"
+# Device BCF record-chain walk (ops/pallas/bcf_chain.py): the variant
+# plane's boundary walk + fixed-shared-column extraction on chip, BCF
+# being the fourth codec-family client of the DeviceStream (BGZF framing
+# rides the inflate lanes already).  Same semantics: "true"/"false"
+# force, unset defers to the local-latency auto rule
+# (ops.flate.bcf_chain_tier_enabled); windows that trip a framing or
+# domain gate tier down per-window — never per-launch — to the bit-exact
+# NumPy walk and then the spec/bcf.py per-record oracle.
+BCF_CHAIN = "hadoopbam.bcf.chain"
 # Split-read pipelining depth (pipeline._read_splits_pipelined /
 # DeviceStream.read_splits): how many splits are in flight at once in the
 # read-ahead pool — split k+1's file read + inflate (h2d upload + device

@@ -111,11 +111,13 @@ class StreamPolicy:
         auto_rtt_ms: float,
         effective_rtt_ms: float,
         use_rans_lanes: bool = False,
+        use_bcf_chain: bool = False,
     ) -> None:
         self.inflate_lanes = inflate_lanes
         self.deflate_lanes = deflate_lanes
         self.device_write = device_write
         self.use_rans_lanes = use_rans_lanes
+        self.use_bcf_chain = use_bcf_chain
         self.depth = depth
         self.auto_rtt_ms = auto_rtt_ms
         self.effective_rtt_ms = effective_rtt_ms
@@ -127,6 +129,7 @@ class StreamPolicy:
             or self.deflate_lanes
             or self.device_write
             or self.use_rans_lanes
+            or self.use_bcf_chain
         )
 
     @classmethod
@@ -146,6 +149,9 @@ class StreamPolicy:
             auto_rtt_ms=base,
             effective_rtt_ms=eff,
             use_rans_lanes=flate.rans_lanes_tier_enabled(
+                conf, max_rtt_ms=eff
+            ),
+            use_bcf_chain=flate.bcf_chain_tier_enabled(
                 conf, max_rtt_ms=eff
             ),
         )
@@ -348,6 +354,25 @@ class DeviceStream:
             conf=self.conf,
             use_lanes=self.policy.use_rans_lanes,
         )
+
+    def walk_bcf_records(self, payload, start: int, limit: int):
+        """Walk a BCF record chain through the stream's tier policy — the
+        fourth codec family's seam, behind ``io.bcf.read_split``.  An
+        armed stream runs the device chain kernel
+        (``ops.pallas.bcf_chain``) with per-window tier-down to the
+        bit-exact NumPy walk; a disarmed stream returns ``None`` and the
+        caller keeps the pre-existing host path byte-for-byte (the
+        disarmed contract: zero ``device_stream.*``/``bcf.*`` counters).
+
+        Returns ``(cols, count, ok, tier)`` from
+        :func:`~hadoop_bam_tpu.ops.pallas.bcf_chain.walk_chain`, or
+        ``None`` when the tier is off."""
+        if not self.policy.use_bcf_chain:
+            return None
+        from .ops.pallas.bcf_chain import walk_chain
+
+        self._count("bcf_walks")
+        return walk_chain(payload, start, limit)
 
     def deflate_stream(
         self, payload, level: int = 1, block_payload: Optional[int] = None

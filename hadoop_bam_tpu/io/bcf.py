@@ -22,10 +22,16 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from ..conf import Configuration, VCF_INTERVALS, VCFRECORDREADER_VALIDATION_STRINGENCY
+from ..conf import (
+    Configuration,
+    ERRORS_MODE,
+    VCF_INTERVALS,
+    VCFRECORDREADER_VALIDATION_STRINGENCY,
+)
 from ..spec import bcf, bgzf
 from ..spec.vcf import VcfHeader, variant_key
 from ..utils.intervals import Interval, parse_intervals
+from ..utils.tracing import METRICS
 from . import fs
 from .splits import FileVirtualSplit
 from .vcf import VariantBatch
@@ -110,10 +116,18 @@ class BcfSplitGuesser:
         """Virtual offset of the first verifiable record in the byte range
         ``[beg, end)``; None when none found.  Uncompressed files use the
         degenerate ``offset<<16`` voffset form so both kinds flow through the
-        same FileVirtualSplit machinery."""
-        if self.compressed:
-            return self._guess_bgzf(beg, end)
-        return self._guess_plain(beg, end)
+        same FileVirtualSplit machinery.  Guess cost is visible in
+        ``--metrics`` via the ``bcf.guess.*`` counters (windows scanned,
+        candidates sanity-passed, verified hits)."""
+        METRICS.count("bcf.guess.windows", 1)
+        g = (
+            self._guess_bgzf(beg, end)
+            if self.compressed
+            else self._guess_plain(beg, end)
+        )
+        if g is not None:
+            METRICS.count("bcf.guess.verified", 1)
+        return g
 
     def _guess_plain(self, beg: int, end: int) -> Optional[int]:
         window = self.data[
@@ -121,6 +135,7 @@ class BcfSplitGuesser:
         ]
         arr = np.frombuffer(window, dtype=np.uint8)
         in_range = self._candidate_offsets(arr)
+        METRICS.count("bcf.guess.candidates", len(in_range))
         for off in in_range:
             if off >= end - beg:
                 break
@@ -163,6 +178,7 @@ class BcfSplitGuesser:
                     cands = self._candidate_offsets(
                         np.frombuffer(payload[:first_len], dtype=np.uint8)
                     )
+                    METRICS.count("bcf.guess.candidates", len(cands))
                     for up in cands:
                         if self._decodes_from(
                             payload,
@@ -256,28 +272,59 @@ class BcfInputFormat:
         return out
 
     def read_split(
-        self, split: FileVirtualSplit, data: Optional[bytes] = None
+        self,
+        split: FileVirtualSplit,
+        data: Optional[bytes] = None,
+        stream=None,
+        inflate_fn=None,
+        errors: Optional[str] = None,
     ) -> VariantBatch:
+        """Decode one split.  ``stream`` (a ``DeviceStream``) arms the
+        device record-chain walk; ``inflate_fn`` routes the window's BGZF
+        member inflate through a caller-supplied batch codec (the serve
+        ``LaneBatcher``); ``errors`` (default ``hadoopbam.errors`` conf,
+        ``"strict"``) selects member-corruption policy — strict raises
+        through the CRC gate, salvage quarantines exactly the bad member
+        and re-syncs the record chain with the guesser, survivors decoded
+        by the exact ``spec/bcf.py`` oracle."""
         stringency = self._stringency()
         intervals = self._intervals()
+        if errors is None:
+            errors = self.conf.get(ERRORS_MODE, "strict") or "strict"
         if data is None:
             # Split-local: the header comes from a growing prefix read and
             # the record range from its own byte window — a split costs
             # O(header + split), not O(file).  Split ends are record-start
             # voffsets (the planner's contract), so no record spills past
             # the window's end-block margin.
-            hdr, payload, p, end = _read_bcf_split_local(split)
+            hdr, payload, p, end, breaks = _read_bcf_split_local(
+                split, errors=errors, inflate_fn=inflate_fn
+            )
         else:
             compressed = bgzf.is_bgzf(data)
             if compressed:
-                payload, p, end = _inflate_range(
-                    data, split.vstart, split.vend
+                payload, p, end, breaks = _inflate_range(
+                    data,
+                    split.vstart,
+                    split.vend,
+                    errors=errors,
+                    inflate_fn=inflate_fn,
                 )
             else:
                 payload = data
                 p = split.vstart >> 16
                 end = split.vend >> 16
+                breaks = []
             hdr, _ = read_bcf_header(data, compressed)
+        if breaks:
+            # A quarantined member tore the record chain: the salvage walk
+            # re-syncs with the guesser and decodes survivors with the
+            # exact oracle (no device/vectorized shortcut on a torn chain).
+            return _salvage_walk(payload, p, end, breaks, hdr, intervals)
+        if stream is not None:
+            dev = _read_device(payload, p, end, hdr, intervals, stream)
+            if dev is not None:
+                return dev
         fast = _read_vectorized(payload, p, end, hdr, intervals)
         if fast is not None:
             return fast
@@ -417,9 +464,171 @@ def _read_bcf_header_prefix(path: str):
             n *= 4
 
 
-def _read_bcf_split_local(split: FileVirtualSplit):
-    """(header, payload, start, record-start limit) reading only the
-    split's byte window + a growing header prefix."""
+def _read_device(payload, p: int, end: int, hdr: bcf.BcfHeader, intervals, stream):
+    """The armed variant-plane read: device record-chain walk + the ragged
+    interval join, columns bit-exact with ``_read_vectorized`` (same key /
+    pos / end math; ``end`` comes from ``rlen``, which our encoder writes
+    from ``VariantContext.end`` — INFO END included — so the columns agree
+    on round-tripped corpora; a foreign writer disagreeing on rlen is the
+    documented residue).  Returns None to fall through to the host tiers —
+    per *window*, never a sticky disable."""
+    res = stream.walk_bcf_records(payload, p, end)
+    if res is None:
+        return None
+    cols, n, ok, tier = res
+    if tier == "device":
+        METRICS.count("bcf.chain.device_walks", 1)
+    else:
+        METRICS.count("bcf.chain.host_walks", 1)
+        METRICS.count("bcf.chain.tierdowns", 1)
+    if not ok:
+        # Corrupt/truncated framing: the exact decoder owns the error
+        # semantics (STRICT raises and all) — fall through.
+        METRICS.count("bcf.chain.oracle_fallbacks", 1)
+        return None
+    METRICS.count("bcf.chain.records", int(n))
+    offs, chrom_i, pos0, rlen = cols[0], cols[1], cols[2], cols[3]
+    if n and (
+        int(chrom_i.min()) < 0 or int(chrom_i.max()) >= len(hdr.contigs)
+    ):
+        return None  # CHROM outside the dictionary: exact path's error
+    pos0 = pos0.astype(np.int64)
+    vmap = np.empty(max(len(hdr.contigs), 1), dtype=np.int64)
+    for ci, name in enumerate(hdr.contigs):
+        vmap[ci] = hdr.vcf.contig_index(name)
+    idx = vmap[chrom_i] if n else np.empty(0, np.int64)
+    keys = (idx << 32) | np.where(pos0 < 0, pos0, pos0 & 0xFFFFFFFF)
+    pos1 = pos0 + 1
+    endp = pos0 + rlen.astype(np.int64)
+
+    kept = np.asarray(offs, np.int64)
+    if intervals is not None:
+        from ..ops.pallas.overlap import ragged_overlap_mask
+
+        name_to_ci = {name: ci for ci, name in enumerate(hdr.contigs)}
+        q = [
+            (name_to_ci[iv.contig], iv.start - 1, iv.end)
+            for iv in intervals
+            if iv.contig in name_to_ci
+        ]
+        q_rid = np.asarray([r for r, _, _ in q], np.int64)
+        q_beg = np.asarray([b for _, b, _ in q], np.int64)
+        q_end = np.asarray([e for _, _, e in q], np.int64)
+        # The join's device form rides int32 lanes; a coordinate outside
+        # that domain tiers this window's join down to the NumPy twin.
+        use_dev = bool(
+            n == 0
+            or (int(endp.max()) < 2**31 and int(q_end.max(initial=0)) < 2**31)
+        )
+        METRICS.count(
+            "variants.join_device" if use_dev else "variants.join_host", 1
+        )
+        keep = ragged_overlap_mask(
+            chrom_i, pos0, endp, q_rid, q_beg, q_end, use_device=use_dev
+        )
+        kept, keys, pos1, endp = (
+            kept[keep], keys[keep], pos1[keep], endp[keep]
+        )
+
+    def materialize() -> List[bcf.BcfVariant]:
+        out: List[bcf.BcfVariant] = []
+        for o in kept:
+            v, _ = bcf.decode_record(payload, int(o), hdr)
+            out.append(v)
+        return out
+
+    return VariantBatch(
+        header=hdr.vcf,
+        keys=keys,
+        pos=pos1,
+        end=endp,
+        materializer=materialize,
+    )
+
+
+def _find_resync(payload, start: int, hdr: bcf.BcfHeader) -> Optional[int]:
+    """First verifiable record start at/after ``start`` — the guesser's
+    candidate+verify pass applied to an already-inflated stream (the
+    salvage re-sync after a quarantined member)."""
+    g = BcfSplitGuesser(b"", hdr, compressed=False)
+    window = payload[start : start + UNCOMPRESSED_BYTES_NEEDED_FOR_GUESS]
+    cands = g._candidate_offsets(np.frombuffer(window, dtype=np.uint8))
+    METRICS.count("bcf.guess.candidates", len(cands))
+    for off in cands:
+        if g._decodes_from(
+            payload, start + int(off), UNCOMPRESSED_BYTES_NEEDED_FOR_GUESS
+        ):
+            return start + int(off)
+    return None
+
+
+def _salvage_walk(
+    payload, p: int, end: int, breaks: List[int], hdr: bcf.BcfHeader, intervals
+) -> VariantBatch:
+    """Exact-decoder walk over a chain torn by quarantined members.
+
+    ``breaks`` are payload offsets where inflated bytes are missing: a
+    record extending across one is torn (dropped, counted
+    ``salvage.records_dropped``); the chain re-syncs at the next
+    guesser-verified record start, so every survivor decodes through the
+    same ``spec/bcf.py`` oracle as a clean read — oracle-exact."""
+    variants: List[bcf.BcfVariant] = []
+    bq = sorted(b for b in breaks if b is not None)
+    bi = 0
+    while bq and bi < len(bq) and bq[bi] <= p:
+        # Chain torn at/before the split start: re-sync immediately.
+        r = _find_resync(payload, bq[bi], hdr)
+        bi += 1
+        if r is None:
+            break
+        p = r
+    while p + 8 <= end:
+        b = bq[bi] if bi < len(bq) else None
+        if b is not None and p >= b:
+            bi += 1
+            r = _find_resync(payload, b, hdr)
+            if r is None:
+                break
+            p = r
+            continue
+        torn = False
+        if b is not None:
+            l_shared, l_indiv = struct.unpack_from("<II", payload, p)
+            torn = p + 8 + l_shared + l_indiv > b
+        if torn:
+            # The rest of this record was quarantined with its member.
+            METRICS.count("salvage.records_dropped", 1)
+            bi += 1
+            r = _find_resync(payload, b, hdr)
+            if r is None:
+                break
+            p = r
+            continue
+        try:
+            v, p = bcf.decode_record(payload, p, hdr)
+        except (bcf.BcfError, struct.error, IndexError, ValueError, KeyError):
+            METRICS.count("salvage.records_dropped", 1)
+            break
+        if intervals is not None and not any(
+            iv.overlaps(v.chrom, v.start, v.end) for iv in intervals
+        ):
+            continue
+        variants.append(v)
+    keys = np.array(
+        [variant_key(hdr.vcf, v) for v in variants], dtype=np.int64
+    )
+    pos = np.array([v.pos for v in variants], dtype=np.int64)
+    endp = np.array([v.end for v in variants], dtype=np.int64)
+    return VariantBatch(
+        header=hdr.vcf, variants=variants, keys=keys, pos=pos, end=endp
+    )
+
+
+def _read_bcf_split_local(
+    split: FileVirtualSplit, errors: str = "strict", inflate_fn=None
+):
+    """(header, payload, start, record-start limit, chain breaks) reading
+    only the split's byte window + a growing header prefix."""
     hdr, compressed = _read_bcf_header_prefix(split.path)
     f = fs.get_fs(split.path)
     if compressed:
@@ -428,43 +637,126 @@ def _read_bcf_split_local(split: FileVirtualSplit):
         # The end block's full extent (≤64KiB) plus slack.
         window = f.read_range(split.path, c0, (c1 - c0) + 0x20000)
         shift = c0 << 16
-        payload, p, end = _inflate_range(
-            window, split.vstart - shift, split.vend - shift
+        payload, p, end, breaks = _inflate_range(
+            window,
+            split.vstart - shift,
+            split.vend - shift,
+            errors=errors,
+            inflate_fn=inflate_fn,
         )
-        return hdr, payload, p, end
+        return hdr, payload, p, end, breaks
     p = split.vstart >> 16
     end = split.vend >> 16
     window = f.read_range(split.path, p, end - p)
-    return hdr, window, 0, end - p
+    return hdr, window, 0, end - p, []
 
 
-def _inflate_range(data: bytes, vstart: int, vend: int) -> Tuple[bytes, int, int]:
+def _inflate_range(
+    data: bytes,
+    vstart: int,
+    vend: int,
+    errors: str = "strict",
+    inflate_fn=None,
+) -> Tuple[bytes, int, int, List[int]]:
     """Inflate the BGZF blocks covering [vstart, vend) → (payload, start
-    offset, record-start limit).  Records *start* strictly before the limit;
-    the tail block at vend's coffset is included so a record straddling the
-    boundary completes (the BGZFLimitingStream role,
-    BCFRecordReader.java:176-236)."""
+    offset, record-start limit, chain-break offsets).  Records *start*
+    strictly before the limit; the tail block at vend's coffset is included
+    so a record straddling the boundary completes (the BGZFLimitingStream
+    role, BCFRecordReader.java:176-236).
+
+    Member corruption policy: ``errors="strict"`` raises the ``BgzfError``
+    through the CRC gate; ``"salvage"`` quarantines exactly the bad member
+    (``salvage.members_quarantined``/``salvage.bytes_quarantined``) and
+    records a chain break at the payload offset where its bytes are
+    missing — the record walk re-syncs there.
+
+    ``inflate_fn(data, coffsets, csizes, usizes) -> (out, offsets)``
+    (the ``DeviceStream.decode_members`` contract, e.g. the serve
+    ``LaneBatcher``) inflates the scanned member table as one coalesced
+    batch; any batch failure falls back to the per-member host loop so
+    the error policy above stays exact."""
     c0, u0 = bgzf.split_voffset(vstart)
     c1, u1 = bgzf.split_voffset(vend)
-    chunks: List[bytes] = []
+    # Pass 1: scan the member table (headers only).
+    members: List[Tuple[int, int, int]] = []  # (coffset, csize, usize)
+    bad_headers: List[int] = []  # index into the member order of breaks
     pos = c0
-    acc_before_end_block = None
+    end_block_index = None
     while pos < len(data) and pos <= c1:
         if pos == c1:
-            acc_before_end_block = sum(len(c) for c in chunks)
+            end_block_index = len(members)
         try:
-            payload, csize = bgzf.inflate_block(data, pos)
+            csize, usize = bgzf.read_block_at(data, pos)
         except bgzf.BgzfError:
-            break
-        chunks.append(payload)
+            if errors != "salvage":
+                raise
+            # Header unreadable: quarantine up to the next plausible
+            # member magic and mark a chain break here.
+            from .. import native
+
+            nxt = native.find_next_block(data, pos + 1, min(len(data), c1 + 1))
+            if nxt < 0:
+                nxt = len(data)
+            METRICS.count("salvage.members_quarantined", 1)
+            METRICS.count("salvage.bytes_quarantined", nxt - pos)
+            bad_headers.append(len(members))
+            pos = nxt
+            continue
+        members.append((pos, csize, usize))
         pos += csize
-    blob = b"".join(chunks)
+    chunks: List[Optional[bytes]] = [None] * len(members)
+    if inflate_fn is not None and members:
+        try:
+            out, offs = inflate_fn(
+                np.frombuffer(data, np.uint8),
+                np.asarray([m[0] for m in members], np.int64),
+                np.asarray([m[1] for m in members], np.int32),
+                np.asarray([m[2] for m in members], np.int32),
+            )
+            raw = out.tobytes()
+            for i in range(len(members)):
+                a = int(offs[i])
+                b = int(offs[i + 1]) if i + 1 < len(offs) else len(raw)
+                chunks[i] = raw[a:b]
+        except Exception:
+            chunks = [None] * len(members)  # per-member host loop below
+    for i, (mpos, csize, usize) in enumerate(members):
+        if chunks[i] is not None:
+            continue
+        try:
+            payload, _ = bgzf.inflate_block(data, mpos)
+            chunks[i] = payload
+        except bgzf.BgzfError:
+            if errors != "salvage":
+                raise
+            METRICS.count("salvage.members_quarantined", 1)
+            METRICS.count("salvage.bytes_quarantined", csize)
+            chunks[i] = b""
+            bad_headers.append(i)
+    # Pass 2: concatenate and translate member-order breaks to payload
+    # offsets (a break lands where the quarantined bytes would have been).
+    blob_parts: List[bytes] = []
+    acc = 0
+    acc_before_end_block = None
+    break_at: List[int] = []
+    bad = sorted(set(bad_headers))
+    bj = 0
+    for i in range(len(members) + 1):
+        while bj < len(bad) and bad[bj] == i:
+            break_at.append(acc)
+            bj += 1
+        if i == end_block_index:
+            acc_before_end_block = acc
+        if i < len(members) and chunks[i]:
+            blob_parts.append(chunks[i])
+            acc += len(chunks[i])
+    blob = b"".join(blob_parts)
     limit = (
         len(blob)
         if acc_before_end_block is None
         else min(acc_before_end_block + u1, len(blob))
     )
-    return blob, u0, limit
+    return blob, u0, limit, sorted(set(break_at))
 
 
 class BcfRecordWriter:

@@ -1223,6 +1223,36 @@ def rans_lanes_tier_enabled(
     )
 
 
+def bcf_chain_tier_enabled(
+    conf=None, max_rtt_ms: Optional[float] = None
+) -> bool:
+    """Should BCF record-chain walks route through the device kernel
+    (ops/pallas/bcf_chain.py)?
+
+    The variant plane's gate, same shape as :func:`lanes_tier_enabled`:
+    resolution order is the ``HBAM_BCF_CHAIN`` env var (0/1 force) → the
+    ``hadoopbam.bcf.chain`` conf key → the shared local-latency auto rule
+    (``utils.backend.local_tpu_ready`` under :func:`device_auto_rtt_ms`,
+    with the same pipelined-mode ``max_rtt_ms`` relaxation).  Windows the
+    device walk declines (framing errors, truncation, int32 domain) tier
+    down per-window — never per-launch — to the bit-exact NumPy walk and
+    then the ``spec/bcf.py`` per-record oracle.
+    """
+    env = os.environ.get("HBAM_BCF_CHAIN")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    if conf is not None:
+        from ..conf import BCF_CHAIN
+
+        if BCF_CHAIN in conf:
+            return conf.get_boolean(BCF_CHAIN)
+    from ..utils.backend import local_tpu_ready
+
+    return local_tpu_ready(
+        max_rtt_ms if max_rtt_ms is not None else device_auto_rtt_ms(conf)
+    )
+
+
 def device_write_enabled(
     conf=None, max_rtt_ms: Optional[float] = None
 ) -> bool:

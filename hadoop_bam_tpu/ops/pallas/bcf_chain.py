@@ -1,0 +1,345 @@
+"""Pallas TPU kernel: BCF record-chain walk over an inflated BGZF stream.
+
+The BAM boundary walk (``ops/pallas/chain.py``) applied to BCF's framing
+(spec/bcf.py): records are ``[u32 l_shared][u32 l_indiv][shared block]
+[indiv block]`` back to back, so the chain step is
+``pos += 8 + l_shared + l_indiv`` — and unlike BAM, the first 24 bytes of
+the shared block are fixed-width columns (CHROM/POS/rlen/QUAL/n_allele/
+n_fmt), so the same walk that finds boundaries also emits the query-plane
+columns in one pass.  Genotype (indiv) blocks are never touched — the
+reference's LazyBCFGenotypesContext stance, kept on device.
+
+Structure mirrors ``chain.py`` exactly:
+
+- fixed chunks, one ``pallas_call`` each, scalar cursor carried through
+  SMEM so a record spanning chunks resumes where the previous stopped;
+- inside a chunk the walk is a ``lax.while_loop`` of scalar VMEM loads
+  (u32 at an arbitrary byte offset = two aligned word loads recombined);
+- seven per-record output columns (start offset + the six fixed shared
+  words) accumulate in register-carried ``[1, 128]`` buffers flushed with
+  aligned full-row stores.
+
+Tier-down contract (per window, never per launch): an implausible
+``l_shared``/``l_indiv``, a record overrunning the payload, or a count
+overflow sets ``ok=False`` for the *window* and the caller re-walks that
+window on the host (:func:`walk_chain_host`, bit-exact by construction)
+or falls through to the ``spec/bcf.py`` per-record oracle.  Validity here
+is framing-only — CHROM range, dictionary and typed-value checks stay
+with the host decoders that own them (io/bcf.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: Bytes of stream walked per pallas_call (same budget story as chain.py).
+CHUNK = 4 << 20
+#: A record is ≥ 32 bytes (8-byte lengths + 24-byte fixed shared fields),
+#: so a chunk can start at most CHUNK//32 records — lane-aligned bound.
+MAX_REC_PER_CHUNK = -(-(CHUNK // 32 + 8) // 128) * 128
+#: Fixed shared prefix every record carries (spec/bcf.py decode_record).
+_MIN_SHARED = 24
+#: Guesser sanity bounds (BCFSplitGuesser.java:273-360, io/bcf.py).
+_MAX_SHARED = 1 << 24
+_MAX_INDIV = 1 << 28
+
+#: Column order of the walk's output tuple (after the start offsets).
+COLUMNS = ("chrom", "pos", "rlen", "qual_bits", "n_allele_info", "n_fmt_sample")
+
+
+def _bcf_chain_kernel(
+    cursor_in_ref,  # SMEM (1,) int32: absolute resume cursor
+    base_ref,  # SMEM (1,) int32: absolute byte offset of this chunk
+    limit_ref,  # SMEM (1,) int32: chunk-local end of record starts
+    hard_ref,  # SMEM (1,) int32: stream-wide record-start limit
+    nbytes_ref,  # SMEM (1,) int32: payload length (truncation gate)
+    words_ref,  # VMEM [rows, 128] int32: chunk bytes (+margin) as words
+    offs_ref,  # VMEM [MAX_REC_PER_CHUNK//128, 128] int32 out: starts (abs)
+    chrom_ref,  # VMEM out: CHROM contig index column
+    pos_ref,  # VMEM out: 0-based POS column
+    rlen_ref,  # VMEM out: rlen column
+    qual_ref,  # VMEM out: QUAL float32 bit pattern column
+    nai_ref,  # VMEM out: (n_allele<<16 | n_info) column
+    nfs_ref,  # VMEM out: (n_fmt<<24 | n_sample) column
+    count_ref,  # SMEM (1,) int32 out
+    cursor_out_ref,  # SMEM (1,) int32 out: resume cursor (abs)
+    err_ref,  # SMEM (1,) int32 out: 1 on implausible/overrunning record
+):
+    """Same VMEM moves as chain.py's kernel — dynamic row-pair loads with
+    masked lane extraction for the unaligned u32 reads, register-carried
+    [1, 128] buffers flushed with aligned full-row stores — with six more
+    reads per step for the fixed shared columns."""
+    base = base_ref[0]
+    limit = limit_ref[0]
+    hard = hard_ref[0]
+    n_payload = nbytes_ref[0]
+    lane2 = lax.broadcasted_iota(jnp.int32, (2, 128), 1)
+    row2 = lax.broadcasted_iota(jnp.int32, (2, 128), 0)
+    lane1 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+
+    def u32_at(abs_off):
+        off = abs_off - base
+        wi = off >> 2
+        r = wi >> 7
+        rows = words_ref[pl.ds(r, 2), :]  # [2, 128]
+
+        def word(widx):
+            rr = (widx >> 7) - r
+            ll = widx & 127
+            return jnp.sum(
+                jnp.where((row2 == rr) & (lane2 == ll), rows, 0)
+            )
+
+        w0 = word(wi).astype(jnp.uint32)
+        w1 = word(wi + 1).astype(jnp.uint32)
+        sh = ((off & 3) << 3).astype(jnp.uint32)
+        lo = w0 >> sh
+        hi = jnp.where(sh == 0, jnp.uint32(0), w1 << (32 - sh))
+        return (lo | hi).astype(jnp.int32)
+
+    def cond(state):
+        cur, n, err = state[0], state[1], state[2]
+        return (cur < limit) & (cur + 8 <= hard) & (err == 0) & (
+            n < MAX_REC_PER_CHUNK
+        )
+
+    def body(state):
+        cur, n, err, bufs = state
+        l_shared = u32_at(cur)
+        l_indiv = u32_at(cur + 4)
+        bad = (
+            (l_shared < _MIN_SHARED)
+            | (l_shared >= _MAX_SHARED)
+            | (l_indiv < 0)
+            | (l_indiv >= _MAX_INDIV)
+        )
+        # Truncation gate: guarded by `bad` so the sum cannot wrap int32
+        # (l_shared/l_indiv are bounded when it is evaluated for real).
+        bad = bad | (
+            jnp.where(bad, n_payload + 1, cur + 8 + l_shared + l_indiv)
+            > n_payload
+        )
+        body_off = cur + 8
+        vals = (
+            cur,
+            u32_at(body_off),  # CHROM
+            u32_at(body_off + 4),  # POS (0-based)
+            u32_at(body_off + 8),  # rlen
+            u32_at(body_off + 12),  # QUAL bits
+            u32_at(body_off + 16),  # n_allele<<16 | n_info
+            u32_at(body_off + 20),  # n_fmt<<24 | n_sample
+        )
+        refs = (offs_ref, chrom_ref, pos_ref, rlen_ref, qual_ref, nai_ref, nfs_ref)
+        is_lane = lane1 == (n & 127)
+        new_bufs = []
+        for ref, buf, v in zip(refs, bufs, vals):
+            buf = jnp.where(is_lane, v, buf)
+            ref[pl.ds(n >> 7, 1), :] = buf
+            new_bufs.append(buf)
+        nxt = jnp.where(bad, limit, cur + 8 + l_shared + l_indiv)
+        return (
+            nxt,
+            n + jnp.where(bad, 0, 1),
+            err | bad.astype(jnp.int32),
+            tuple(new_bufs),
+        )
+
+    cur0 = cursor_in_ref[0]
+    bufs0 = tuple(jnp.zeros((1, 128), jnp.int32) for _ in range(7))
+    cur, n, err, _ = lax.while_loop(
+        cond, body, (cur0, jnp.int32(0), jnp.int32(0), bufs0)
+    )
+    count_ref[0] = n
+    cursor_out_ref[0] = cur
+    err_ref[0] = err | jnp.int32(n >= MAX_REC_PER_CHUNK)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bcf_chain_chunk(
+    cursor, base, limit, hard, n_payload, words, interpret: bool = False
+):
+    col = jax.ShapeDtypeStruct((MAX_REC_PER_CHUNK // 128, 128), jnp.int32)
+    one = jax.ShapeDtypeStruct((1,), jnp.int32)
+    return pl.pallas_call(
+        _bcf_chain_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            tuple(pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(7))
+            + tuple(pl.BlockSpec(memory_space=pltpu.SMEM) for _ in range(3))
+        ),
+        out_shape=tuple([col] * 7 + [one] * 3),
+        interpret=interpret,
+    )(cursor, base, limit, hard, n_payload, words)
+
+
+@functools.partial(jax.jit, static_argnames=("n_chunks", "interpret"))
+def _bcf_chain_all(
+    stream_words, start, hard, n_payload, n_chunks: int, interpret: bool
+):
+    """Run the chunk kernel over the stream, carrying the cursor, then
+    compact the per-chunk column blocks with the same gather-form
+    searchsorted flatten as chain.py — applied to all seven columns with
+    one shared index computation."""
+    WPC = CHUNK // 4
+    cursor = jnp.reshape(start.astype(jnp.int32), (1,))
+    parts = [[] for _ in range(7)]
+    counts = []
+    err_any = jnp.int32(0)
+    for k in range(n_chunks):
+        base = jnp.full((1,), k * CHUNK, jnp.int32)
+        limit = jnp.minimum(jnp.int32((k + 1) * CHUNK), hard)
+        words = lax.dynamic_slice(
+            stream_words, (k * WPC,), (WPC + 256,)
+        ).reshape(-1, 128)
+        outs = _bcf_chain_chunk(
+            cursor,
+            base,
+            limit[None],
+            hard[None],
+            n_payload[None],
+            words,
+            interpret=interpret,
+        )
+        for i in range(7):
+            parts[i].append(outs[i].reshape(-1))
+        count, cursor, err = outs[7], outs[8], outs[9]
+        counts.append(count[0])
+        err_any = err_any | err[0]
+    counts = jnp.stack(counts)
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    t = jnp.arange(n_chunks * MAX_REC_PER_CHUNK, dtype=jnp.int32)
+    k_of_t = jnp.searchsorted(cum, t, side="right").astype(jnp.int32)
+    k_c = jnp.clip(k_of_t, 0, n_chunks - 1)
+    local = t - jnp.where(k_c > 0, cum[k_c - 1], 0)
+    li = jnp.clip(local, 0, MAX_REC_PER_CHUNK - 1)
+    flats = []
+    for i in range(7):
+        stacked = jnp.stack(parts[i])  # [K, MAXR]
+        flat = stacked[k_c, li]
+        flats.append(jnp.where(t < total, flat, 0))
+    # Clean completion: the walk stops when no further record can start
+    # (cursor + 8 > hard) — same stance as the host `while p + 8 <= end`.
+    ok = (err_any == 0) & (cursor[0] + 8 > hard)
+    return tuple(flats) + (total, ok)
+
+
+def walk_chain_device(payload, start: int, limit: int, interpret=None):
+    """BCF record starts + fixed shared columns, computed on device.
+
+    ``payload``: uint8 array (device or host) holding the inflated BCF
+    stream; records start at ``start`` and keep starting while
+    ``pos + 8 <= limit`` (the straddling tail record completes from bytes
+    past ``limit``, exactly like the host loop in io/bcf.py).  Returns
+    ``(offs, chrom, pos, rlen, qual_bits, n_allele_info, n_fmt_sample,
+    count, ok)`` int32 device arrays — columns are valid in
+    ``[:count]``; ``ok`` is False on a truncated/implausible chain and
+    the caller re-walks this window on the host (never disables the
+    tier for later windows)."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        payload = np.frombuffer(payload, dtype=np.uint8)
+    a = jnp.asarray(payload, dtype=jnp.uint8)
+    n = int(a.shape[0])
+    if n > 2**31 - (1 << 29):
+        # Offsets and the truncation sum `cur + 8 + l_shared + l_indiv`
+        # ride int32 lanes; the margin keeps the sum (l_indiv < 2^28)
+        # inside int32 for any in-bounds cursor.  Split windows are MB
+        # class, so callers never get near this.
+        raise ValueError(
+            f"walk_chain_device: payload of {n} bytes exceeds the int32 "
+            "offset domain; window the stream before calling"
+        )
+    n_chunks = max(1, -(-n // CHUNK))
+    nbytes_pad = n_chunks * CHUNK + 256 * 4
+    pad = nbytes_pad - a.shape[0]
+    if pad > 0:
+        a = jnp.pad(a, (0, pad))
+    words = lax.bitcast_convert_type(
+        a[:nbytes_pad].reshape(-1, 4), jnp.int32
+    ).reshape(-1)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _bcf_chain_all(
+        words,
+        jnp.int32(start),
+        jnp.int32(limit),
+        jnp.int32(n),
+        n_chunks,
+        bool(interpret),
+    )
+
+
+def walk_chain_host(payload, start: int, limit: int):
+    """Bit-exact NumPy twin of the device walk — the mid tier.
+
+    Same framing-only validity rules, same straddling-tail semantics.
+    Returns the same 9-tuple with host int32 arrays; ``ok=False`` leaves
+    the caller to the ``spec/bcf.py`` per-record oracle, whose error
+    semantics (STRICT raises and all) are the contract."""
+    buf = bytes(payload) if isinstance(payload, (bytearray, memoryview)) else payload
+    if isinstance(buf, np.ndarray):
+        buf = buf.tobytes()
+    n_payload = len(buf)
+    rows = []
+    p = int(start)
+    lim = int(limit)
+    ok = True
+    while p + 8 <= lim:
+        l_shared, l_indiv = struct.unpack_from("<II", buf, p)
+        if (
+            l_shared < _MIN_SHARED
+            or l_shared >= _MAX_SHARED
+            or l_indiv >= _MAX_INDIV
+            or p + 8 + l_shared + l_indiv > n_payload
+        ):
+            ok = False
+            break
+        rows.append((p,) + struct.unpack_from("<iiiIII", buf, p + 8))
+        p += 8 + l_shared + l_indiv
+    cols = np.asarray(rows, dtype=np.int64).reshape(-1, 7)
+    out = tuple(
+        cols[:, i].astype(np.uint32).astype(np.int32) for i in range(7)
+    )
+    return out + (np.int32(len(rows)), bool(ok))
+
+
+def walk_chain(payload, start: int, limit: int, interpret=None):
+    """Tiered walk: device kernel, then the bit-exact host twin — the
+    tier decision is per *window* (this call), never sticky.
+
+    Returns ``(cols, count, ok, tier)`` where ``cols`` is the 7-tuple of
+    host int32 numpy columns (offs + :data:`COLUMNS`) truncated to
+    ``count`` and ``tier`` is ``"device"`` or ``"host"`` — whichever
+    produced the answer.  ``ok=False`` (both tiers declined: corrupt or
+    truncated framing) returns the host tier's verdict so the caller
+    falls through to the exact ``spec/bcf.py`` decoder."""
+    try:
+        res = walk_chain_device(payload, start, limit, interpret=interpret)
+        ok = bool(res[8])
+        if ok:
+            count = int(res[7])
+            cols = tuple(
+                np.asarray(res[i])[:count].astype(np.int32) for i in range(7)
+            )
+            return cols, count, True, "device"
+    except Exception:
+        pass
+    res = walk_chain_host(payload, start, limit)
+    count = int(res[7])
+    return tuple(res[:7]), count, bool(res[8]), "host"

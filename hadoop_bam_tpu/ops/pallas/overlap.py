@@ -88,6 +88,165 @@ def overlap_mask_auto(intervals, refid, start, end) -> jax.Array:
     return overlap_mask(intervals, refid, start, end, interpret=not on_tpu)
 
 
+# -- ragged interval join (PR 20) -------------------------------------------
+#
+# The K-fixed-interval kernel above unrolls over SMEM scalars, which stops
+# scaling the moment the query side is ragged (many windows, many records,
+# both sorted): the generalization is the searchsorted-cover pattern from
+# gather_stream.py — two sorted axes joined by binary search, no unroll.
+#
+#   mask form   (records × windows → per-record any-overlap):
+#     with windows sorted by begin and P[j] = max(q_end[0..j]) (prefix max),
+#     record [s, e) overlaps some window  ⟺  j_hi > 0 and P[j_hi-1] > s,
+#     where j_hi = searchsorted(q_beg, e, 'left').
+#     (j < j_hi ⟺ q_beg[j] < e; the prefix max witnesses ∃j: q_end[j] > s.)
+#   counts form (windows → per-window record count):
+#     with record starts and ends each sorted ascending,
+#     count_j = #(start < q_end_j) − #(end ≤ q_beg_j)
+#             = searchsorted(starts, q_end_j, 'left')
+#               − searchsorted(ends, q_beg_j, 'right').
+#
+# Both forms are pure searchsorted+gather, so the device build is plain
+# jitted XLA (the gather_stream idiom) — no Pallas needed — and the NumPy
+# twins below are bit-identical by construction (same primitives, same
+# side rules).  Coordinates ride int32 on device (JAX x64 is off); the
+# multi-contig entry loops per contig, which also keeps every searchsorted
+# on one coordinate axis.
+
+_PAD_BEG = (1 << 31) - 1  # window sentinel: begins after any coordinate
+_PAD_END = -(1 << 31)  # window sentinel: ends before any coordinate
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@jax.jit
+def _join_mask_call(starts, ends, qb_sorted, qe_cummax):
+    j_hi = jnp.searchsorted(qb_sorted, ends, side="left").astype(jnp.int32)
+    cover = qe_cummax[jnp.maximum(j_hi - 1, 0)]
+    return (j_hi > 0) & (cover > starts)
+
+
+@jax.jit
+def _join_counts_call(starts_sorted, ends_sorted, q_beg, q_end):
+    hi = jnp.searchsorted(starts_sorted, q_end, side="left")
+    lo = jnp.searchsorted(ends_sorted, q_beg, side="right")
+    return (hi - lo).astype(jnp.int32)
+
+
+def join_mask_np(starts, ends, q_beg, q_end) -> np.ndarray:
+    """NumPy twin of the device mask form (the tier-down oracle).
+    Windows need not arrive sorted; records are arbitrary order."""
+    starts = np.asarray(starts)
+    ends = np.asarray(ends)
+    q_beg = np.asarray(q_beg)
+    q_end = np.asarray(q_end)
+    if len(q_beg) == 0:
+        return np.zeros(len(starts), dtype=bool)
+    order = np.argsort(q_beg, kind="stable")
+    qb = q_beg[order]
+    qe_cummax = np.maximum.accumulate(q_end[order])
+    j_hi = np.searchsorted(qb, ends, side="left")
+    cover = qe_cummax[np.maximum(j_hi - 1, 0)]
+    return (j_hi > 0) & (cover > starts)
+
+
+def join_counts_np(starts, ends, q_beg, q_end) -> np.ndarray:
+    """NumPy twin of the device counts form: per-window overlap counts
+    over one record set (starts/ends sorted internally)."""
+    starts = np.sort(np.asarray(starts), kind="stable")
+    ends = np.sort(np.asarray(ends), kind="stable")
+    hi = np.searchsorted(starts, np.asarray(q_end), side="left")
+    lo = np.searchsorted(ends, np.asarray(q_beg), side="right")
+    return (hi - lo).astype(np.int32)
+
+
+def join_mask_device(starts, ends, q_beg, q_end) -> np.ndarray:
+    """Device mask form: one coordinate axis, int32 coordinates.
+
+    Sorts/pads on the host (pow2 shapes so only a few variants compile),
+    runs the two searchsorted gathers as jitted XLA, returns a host bool
+    mask.  Sentinel windows begin past every coordinate, so they never
+    win a search; sentinel records end at INT32_MIN, so their j_hi is 0."""
+    starts = np.asarray(starts, np.int32)
+    ends = np.asarray(ends, np.int32)
+    q_beg = np.asarray(q_beg, np.int32)
+    q_end = np.asarray(q_end, np.int32)
+    n, m = len(starts), len(q_beg)
+    if n == 0 or m == 0:
+        return np.zeros(n, dtype=bool)
+    order = np.argsort(q_beg, kind="stable")
+    qb = q_beg[order]
+    qe_cummax = np.maximum.accumulate(q_end[order])
+    mp = _pow2(m)
+    qb = np.pad(qb, (0, mp - m), constant_values=_PAD_BEG)
+    qe_cummax = np.pad(qe_cummax, (0, mp - m), constant_values=_PAD_END)
+    np_ = _pow2(n)
+    s = np.pad(starts, (0, np_ - n), constant_values=_PAD_BEG)
+    e = np.pad(ends, (0, np_ - n), constant_values=_PAD_END)
+    out = _join_mask_call(s, e, qb, qe_cummax)
+    return np.asarray(out)[:n]
+
+
+def join_counts_device(starts, ends, q_beg, q_end) -> np.ndarray:
+    """Device counts form: per-window record counts, int32 axis."""
+    starts = np.sort(np.asarray(starts, np.int32), kind="stable")
+    ends = np.sort(np.asarray(ends, np.int32), kind="stable")
+    q_beg = np.asarray(q_beg, np.int32)
+    q_end = np.asarray(q_end, np.int32)
+    n, m = len(starts), len(q_beg)
+    if m == 0:
+        return np.zeros(0, np.int32)
+    if n == 0:
+        return np.zeros(m, np.int32)
+    np_ = _pow2(n)
+    # Record sentinels start past every window end (never counted by hi)
+    # and end past every window begin (never subtracted by lo).
+    s = np.pad(starts, (0, np_ - n), constant_values=_PAD_BEG)
+    e = np.pad(ends, (0, np_ - n), constant_values=_PAD_BEG)
+    mp = _pow2(m)
+    qb = np.pad(q_beg, (0, mp - m))
+    qe = np.pad(q_end, (0, mp - m))
+    out = _join_counts_call(s, e, qb, qe)
+    return np.asarray(out)[:m]
+
+
+def ragged_overlap_mask(
+    refid,  # int[N] per-record contig index
+    starts,  # int[N] 0-based inclusive start
+    ends,  # int[N] 0-based exclusive end
+    q_refid,  # int[M] per-window contig index
+    q_beg,  # int[M] 0-based inclusive begin
+    q_end,  # int[M] 0-based exclusive end
+    use_device: bool = False,
+) -> np.ndarray:
+    """bool[N]: record i overlaps any query window — the shared entry for
+    ``variants region``, multi-region scans and the depth windows.  Loops
+    per query contig (few per request) so each join stays on one int32
+    coordinate axis; ``use_device=False`` is the bit-identical host twin."""
+    refid = np.asarray(refid)
+    starts = np.asarray(starts)
+    ends = np.asarray(ends)
+    q_refid = np.asarray(q_refid)
+    q_beg = np.asarray(q_beg)
+    q_end = np.asarray(q_end)
+    mask = np.zeros(len(refid), dtype=bool)
+    for rid in np.unique(q_refid):
+        qsel = q_refid == rid
+        rows = np.nonzero(refid == rid)[0]
+        if len(rows) == 0:
+            continue
+        join = join_mask_device if use_device else join_mask_np
+        mask[rows] = join(
+            starts[rows], ends[rows], q_beg[qsel], q_end[qsel]
+        )
+    return mask
+
+
 def intervals_to_array(header_ref_index, intervals) -> np.ndarray:
     """[K, 3] device layout from parsed Interval objects; unknown contigs
     are dropped (VCFRecordReader's murmur-for-unknown only affects keys,

@@ -1,0 +1,168 @@
+"""Pileup/depth: segmented count over the sorter's coordinate columns.
+
+Per-base depth over a region is a segmented count the coordinate keys the
+sorter already builds (``ops/keys.py``) answer directly: with the
+per-record reference spans as two *independently sorted* axes,
+
+    depth[x] = #(start <= x) - #(end <= x)
+             = searchsorted(starts, x, 'right') - searchsorted(ends, x, 'right')
+
+— the same searchsorted-cover idiom as the ragged interval join
+(``ops/pallas/overlap.py``), vectorized over the base axis.  The device
+build is jitted XLA over fixed-size base chunks (one compiled shape); the
+NumPy twin is bit-identical by construction (same primitives, same side
+rules; the cast to int32 is exact — depth is bounded by the record
+count).  Windowed summaries (binned mean/max, covered bases) reduce the
+profile chunk by chunk, so a contig-scale region never materializes a
+contig-scale array on the host.
+
+Tier policy: ``use_device`` is per *call*; a device failure tiers that
+call down to the host twin (counted ``pileup.tierdowns``) — never a
+sticky disable.  Disarmed calls move zero ``pileup.*`` counters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .keys import split_keys_np
+from ..utils.tracing import METRICS
+
+#: Bases of profile computed per device launch / host vector op.
+CHUNK_BASES = 1 << 20
+_PAD = (1 << 31) - 1  # span sentinel: past every base coordinate
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _profile_host(starts_sorted, ends_sorted, c0: int, c1: int) -> np.ndarray:
+    xs = np.arange(c0, c1, dtype=np.int64)
+    return (
+        np.searchsorted(starts_sorted, xs, side="right")
+        - np.searchsorted(ends_sorted, xs, side="right")
+    ).astype(np.int32)
+
+
+def _profile_device(starts_sorted, ends_sorted, c0: int, c1: int) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def call(s, e, base):
+        xs = base + jnp.arange(CHUNK_BASES, dtype=jnp.int32)
+        return (
+            jnp.searchsorted(s, xs, side="right")
+            - jnp.searchsorted(e, xs, side="right")
+        ).astype(jnp.int32)
+
+    n = len(starts_sorted)
+    npad = _pow2(max(n, 1))
+    s = np.pad(starts_sorted.astype(np.int32), (0, npad - n), constant_values=_PAD)
+    e = np.pad(ends_sorted.astype(np.int32), (0, npad - n), constant_values=_PAD)
+    out = call(s, e, np.int32(c0))
+    return np.asarray(out)[: c1 - c0]
+
+
+def depth_profile(
+    starts, ends, beg: int, end: int, use_device: bool = False
+) -> np.ndarray:
+    """int32[end-beg] per-base depth over [beg, end), 0-based half-open.
+    ``starts``/``ends`` are the per-record reference spans, any order."""
+    starts = np.sort(np.asarray(starts, np.int64), kind="stable")
+    ends = np.sort(np.asarray(ends, np.int64), kind="stable")
+    parts = []
+    for c0 in range(int(beg), int(end), CHUNK_BASES):
+        c1 = min(int(end), c0 + CHUNK_BASES)
+        if use_device:
+            try:
+                parts.append(_profile_device(starts, ends, c0, c1))
+                METRICS.count("pileup.device_chunks", 1)
+                continue
+            except Exception:
+                METRICS.count("pileup.tierdowns", 1)
+        parts.append(_profile_host(starts, ends, c0, c1))
+    if not parts:
+        return np.zeros(0, np.int32)
+    return np.concatenate(parts)
+
+
+def depth_summary(
+    starts,
+    ends,
+    beg: int,
+    end: int,
+    bin_size: int = 1 << 12,
+    use_device: bool = False,
+) -> Dict:
+    """Windowed depth summary over [beg, end): per-bin mean depth, plus
+    region max/mean/covered — reduced chunk by chunk so the full profile
+    never lives at once.  JSON-ready (plain ints/floats/lists)."""
+    beg, end = int(beg), int(end)
+    bin_size = max(1, int(bin_size))
+    span = max(0, end - beg)
+    n_bins = -(-span // bin_size) if span else 0
+    sums = np.zeros(n_bins, np.int64)
+    maxs = np.zeros(n_bins, np.int64)
+    covered = 0
+    starts = np.sort(np.asarray(starts, np.int64), kind="stable")
+    ends_s = np.sort(np.asarray(ends, np.int64), kind="stable")
+    # Chunks aligned to bin boundaries so each bin reduces whole.
+    chunk = bin_size * max(1, CHUNK_BASES // bin_size)
+    for c0 in range(beg, end, chunk):
+        c1 = min(end, c0 + chunk)
+        if use_device:
+            try:
+                prof = _profile_device(starts, ends_s, c0, c1)
+                METRICS.count("pileup.device_chunks", 1)
+            except Exception:
+                METRICS.count("pileup.tierdowns", 1)
+                prof = _profile_host(starts, ends_s, c0, c1)
+        else:
+            prof = _profile_host(starts, ends_s, c0, c1)
+        covered += int((prof > 0).sum())
+        k = -(-len(prof) // bin_size)
+        padded = np.zeros(k * bin_size, np.int64)
+        padded[: len(prof)] = prof
+        b0 = (c0 - beg) // bin_size
+        sums[b0 : b0 + k] += padded.reshape(k, bin_size).sum(axis=1)
+        maxs[b0 : b0 + k] = np.maximum(
+            maxs[b0 : b0 + k], padded.reshape(k, bin_size).max(axis=1)
+        )
+    widths = np.minimum(
+        bin_size, span - np.arange(n_bins, dtype=np.int64) * bin_size
+    )
+    bin_mean = (sums / np.maximum(widths, 1)).round(4)
+    total = int(sums.sum())
+    return {
+        "bin_size": bin_size,
+        "bins": [float(x) for x in bin_mean],
+        "max_depth": int(maxs.max()) if n_bins else 0,
+        "mean_depth": round(total / span, 4) if span else 0.0,
+        "covered_bases": covered,
+        "total_bases": span,
+    }
+
+
+def spans_from_keys(
+    keys, lengths, rid: int, beg: Optional[int] = None, end: Optional[int] = None
+):
+    """(starts, ends) reference spans on contig ``rid`` from the sorter's
+    packed coordinate keys (``ops.keys.pack_keys_np`` layout) and the
+    per-record reference lengths — clipped to [beg, end) when given."""
+    hi, lo = split_keys_np(np.asarray(keys, np.int64))
+    sel = hi == rid
+    starts = lo[sel].astype(np.int64)
+    ends = starts + np.asarray(lengths, np.int64)[sel]
+    if beg is not None or end is not None:
+        b = 0 if beg is None else int(beg)
+        e = (1 << 62) if end is None else int(end)
+        keep = (starts < e) & (ends > b)
+        starts, ends = starts[keep], ends[keep]
+    return starts, ends

@@ -64,7 +64,8 @@ ERROR_CODES = (SHED, RETRY_AFTER, DEADLINE_EXCEEDED, JOB_LOST)
 #: bypass admission entirely (the daemon must answer ping/stats/drain
 #: even — especially — while shedding everything else).
 DEFAULT_COSTS: Dict[str, int] = {
-    "view": 1, "flagstat": 2, "sort": 4, "ingest": 4,
+    "view": 1, "flagstat": 2, "variants": 1, "depth": 2,
+    "sort": 4, "ingest": 4,
 }
 
 DEFAULT_TOKENS = 8

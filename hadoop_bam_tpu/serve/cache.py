@@ -283,5 +283,28 @@ class ResourceCache:
             + 64,
         )
 
+    def bcf_plan(self, path: str):
+        """(BcfHeader, record-start splits) for a BCF path — the variant
+        plane's index analogue.  Split planning walks the file once with
+        the guesser (the cold cost the reference's BCFSplitGuesser pays
+        too), so caching the plan under the file identity makes warm
+        region queries plan-free; a rewritten file re-plans via the
+        (path, size, mtime_ns) key like every other cached resource."""
+        from ..conf import Configuration
+        from ..io.bcf import BcfInputFormat, _read_bcf_header_prefix
+
+        def load(p: str):
+            hdr, _ = _read_bcf_header_prefix(p)
+            splits = BcfInputFormat(Configuration()).get_splits([p])
+            return hdr, splits
+
+        def size(v) -> int:
+            hdr, splits = v
+            return 4096 + sum(len(c) + 16 for c in hdr.contigs) + 80 * len(
+                splits
+            )
+
+        return self.lru.get_or_load("bcf-plan", path, load, size)
+
     def stats(self) -> dict:
         return self.lru.stats()

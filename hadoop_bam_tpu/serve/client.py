@@ -270,6 +270,45 @@ class ServeClient:
             deadline=self._deadline(deadline_ms),
         )["counts"]
 
+    def variants(
+        self,
+        path: str,
+        region: str,
+        deadline_ms: Optional[float] = None,
+    ) -> bytes:
+        """The region's variant records as a complete small BCF (bytes),
+        same reply contract as :meth:`view` for the variant plane."""
+        r = self._request(
+            {"op": "variants", "path": path, "region": region},
+            idempotent=True,
+            deadline=self._deadline(deadline_ms),
+        )
+        return base64.b64decode(r["data_b64"])
+
+    def depth(
+        self,
+        path: str,
+        region: str,
+        bin_size: int = 1 << 12,
+        per_base: bool = False,
+        deadline_ms: Optional[float] = None,
+    ) -> dict:
+        """Pileup depth summary for an alignment region (dict: binned
+        depth vector, max/mean, covered bases; ``per_base`` adds the
+        exact vector under the server's span cap)."""
+        r = self._request(
+            {
+                "op": "depth",
+                "path": path,
+                "region": region,
+                "bin_size": bin_size,
+                "per_base": per_base,
+            },
+            idempotent=True,
+            deadline=self._deadline(deadline_ms),
+        )
+        return r["depth"]
+
     def sort(
         self, bam, output: str, deadline_ms: Optional[float] = None, **kwargs
     ) -> str:

@@ -512,3 +512,269 @@ def flagstat(
         "serve.flagstat.ms", (_time.perf_counter() - t0) * 1e3
     )
     return counts
+
+
+# -- variant plane (PR 20) --------------------------------------------------
+
+
+def _variant_batch_nbytes(batch) -> int:
+    """Arena budget charge for a VariantBatch: the int64 SoA columns plus
+    a flat per-record charge standing in for the materializer's closure
+    over the inflated payload (a VariantBatch has no ``.data``/``.soa``
+    for the generic ``_batch_nbytes`` to walk)."""
+    n = batch.n_records
+    return (
+        getattr(batch.keys, "nbytes", 8 * n)
+        + getattr(batch.pos, "nbytes", 8 * n)
+        + getattr(batch.end, "nbytes", 8 * n)
+        + 64 * n
+        + 4096
+    )
+
+
+def _variant_rows(
+    batch, rid: int, beg0: int, end0: int, use_device: bool
+) -> np.ndarray:
+    """Row indices of variant records overlapping [beg0, end0) on contig
+    index ``rid`` — the ragged interval join over the batch's key/pos/end
+    columns (record span is 0-based half-open [pos-1, end)).  The device
+    form runs only inside the int32 coordinate domain; outside it (or on
+    any device failure) the bit-identical NumPy twin answers."""
+    n = batch.n_records
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    from ..ops.pallas.overlap import ragged_overlap_mask
+
+    refid = np.asarray(batch.keys, dtype=np.int64) >> 32
+    starts = np.asarray(batch.pos, dtype=np.int64) - 1
+    ends = np.asarray(batch.end, dtype=np.int64)
+    use_dev = use_device and bool(
+        starts.size
+        and int(starts.min()) >= -(2**31)
+        and int(ends.max()) < 2**31 - 8
+        and end0 < 2**31 - 8
+    )
+    try:
+        mask = ragged_overlap_mask(
+            refid,
+            starts,
+            ends,
+            np.asarray([rid], dtype=np.int64),
+            np.asarray([beg0], dtype=np.int64),
+            np.asarray([end0], dtype=np.int64),
+            use_device=use_dev,
+        )
+        METRICS.count(
+            "variants.join_device" if use_dev else "variants.join_host", 1
+        )
+    except Exception:
+        endc = np.maximum(ends, starts + 1)
+        mask = (refid == rid) & (starts < end0) & (endc > beg0)
+        METRICS.count("variants.join_host", 1)
+    return np.nonzero(mask)[0].astype(np.int64)
+
+
+def variants_records(
+    ctx: ServeContext, path: str, region: str,
+    deadline: Optional[Deadline] = None,
+) -> Tuple[object, List[Tuple[object, np.ndarray]]]:
+    """Resolve a ranged BCF query to (BcfHeader, [(batch, row indices)]).
+
+    The split plan comes from the resource cache (``bcf_plan`` — BCF has
+    no CSI companion here, so the plan is the index analogue and every
+    split scans, like the CRAM view path); decoded windows live in the
+    residency arena unfiltered, so one warm file answers any region; the
+    per-request cut is the ragged interval join over the batch's columns.
+    """
+    iv = parse_interval(region)
+    rctx = current_request()
+    t_plan = time.perf_counter()
+    hdr, splits = ctx.cache.bcf_plan(path)
+    if iv.contig not in hdr.contigs:
+        raise FormatError(
+            f"unknown contig {iv.contig!r} in {path!r}"
+        ) from None
+    rid = hdr.vcf.contig_index(iv.contig)
+    beg0 = iv.start - 1  # 1-based inclusive → 0-based half-open
+    end0 = min(iv.end, MAX_END)
+    if rctx is not None:
+        # Header + split-plan resolution: ~0 warm, the dominant cold hop
+        # (the guesser walks the file once) — attributed like view.index.
+        rctx.annotate(
+            "variants.plan", ms=(time.perf_counter() - t_plan) * 1e3
+        )
+    ident = ctx.cache.identity(path)
+    ctx.arena.evict_stale(path, ident)  # PR 18: revalidate on hit
+    from ..io.bcf import BcfInputFormat
+
+    fmt = BcfInputFormat(ctx.conf)
+    use_dev = bool(
+        ctx.stream is not None and ctx.stream.policy.use_bcf_chain
+    )
+    picks: List[Tuple[object, np.ndarray]] = []
+    t_join = 0.0
+    for s in splits:
+        if deadline is not None:
+            deadline.check("endpoint")
+        key = ("variants", ident, s.vstart, s.vend)
+        batch = ctx.arena.get(key)
+        if batch is None:
+            t_read = time.perf_counter()
+            with span("serve.variants.read"):
+                batch = fmt.read_split(
+                    s,
+                    stream=ctx.stream,
+                    inflate_fn=ctx._inflate_fn(),
+                )
+            ctx.arena.hold(
+                key, batch, nbytes=_variant_batch_nbytes(batch)
+            )
+            if rctx is not None:
+                rctx.annotate(
+                    "window.read",
+                    ms=(time.perf_counter() - t_read) * 1e3,
+                )
+        t_ov = time.perf_counter()
+        rows = _variant_rows(batch, rid, beg0, end0, use_dev)
+        t_join += time.perf_counter() - t_ov
+        if len(rows):
+            picks.append((batch, rows))
+    if rctx is not None and splits:
+        rctx.annotate(
+            "variants.join", ms=t_join * 1e3, windows=len(splits)
+        )
+    return hdr, picks
+
+
+def variants_blob(
+    ctx: ServeContext, path: str, region: str,
+    deadline: Optional[Deadline] = None,
+) -> bytes:
+    """A complete small BCF (header + overlapping records + terminator)
+    for the requested region — records in file order, like bcftools view.
+    """
+    import io as _io
+    import time as _time
+
+    from ..io.bcf import BcfRecordWriter
+
+    t0 = _time.perf_counter()
+    with span("serve.variants"):
+        hdr, picks = variants_records(ctx, path, region, deadline=deadline)
+        t_enc = _time.perf_counter()
+        n_records = sum(len(rows) for _, rows in picks)
+        buf = _io.BytesIO()
+        w = BcfRecordWriter(buf, hdr.vcf, append_terminator=True)
+        for batch, rows in picks:
+            # Materialization is per batch and cached on it (the arena
+            # warmth carries the VariantContext rows too) — row picks
+            # index into the shared list in file order.
+            vs = batch.variants
+            for i in rows:
+                w.write(vs[int(i)])
+        w.close()
+        blob = buf.getvalue()
+        rctx = current_request()
+        if rctx is not None:
+            # The reply-assembly hop (materialize + BCF encode + BGZF).
+            rctx.annotate(
+                "variants.encode",
+                ms=(_time.perf_counter() - t_enc) * 1e3,
+                records=n_records,
+            )
+    METRICS.count("serve.variants.requests", 1)
+    METRICS.count("serve.variants.records", n_records)
+    METRICS.observe("serve.variants.ms", (_time.perf_counter() - t0) * 1e3)
+    return blob
+
+
+#: Hard cap on a per-base depth reply: one int per base, so an unbounded
+#: region would turn a stats endpoint into a bulk-transfer one.
+DEPTH_PER_BASE_MAX = 1 << 20
+
+
+def depth_stat(
+    ctx: ServeContext, path: str, region: str, bin_size: int = 1 << 12,
+    per_base: bool = False, deadline: Optional[Deadline] = None,
+) -> dict:
+    """Pileup depth summary over an alignment region (the depth endpoint).
+
+    Reuses the view scan verbatim for window residency and the overlap
+    cut, then turns the picked records' reference spans into a segmented
+    depth profile (``ops/pileup``) — binned summaries always, the exact
+    per-base vector only under the ``DEPTH_PER_BASE_MAX`` cap.
+    """
+    import time as _time
+
+    from ..ops.cigar import reference_lengths_np
+    from ..ops.pileup import depth_profile, depth_summary
+
+    t0 = _time.perf_counter()
+    with span("serve.depth"):
+        iv = parse_interval(region)
+        hdr, picks = view_records(ctx, path, region, deadline=deadline)
+        rid = hdr.ref_index(iv.contig)  # validated inside view_records
+        beg0 = iv.start - 1
+        end0 = min(iv.end, MAX_END)
+        ref_len = hdr.refs[rid][1]
+        if ref_len > 0:
+            # Clip to the declared contig length: depth past the contig
+            # end is identically zero and only bloats the bin vector.
+            end0 = min(end0, ref_len)
+        if end0 <= beg0:
+            raise FormatError(
+                f"empty depth window {region!r} (contig length {ref_len})"
+            )
+        starts_l: List[np.ndarray] = []
+        ends_l: List[np.ndarray] = []
+        for batch, rows in picks:
+            pos = np.asarray(batch.soa["pos"], dtype=np.int64)[rows]
+            rl = reference_lengths_np(batch.data, batch.soa).astype(
+                np.int64
+            )[rows]
+            starts_l.append(pos)
+            ends_l.append(pos + np.maximum(rl, 1))
+        starts = (
+            np.concatenate(starts_l) if starts_l else np.empty(0, np.int64)
+        )
+        ends = (
+            np.concatenate(ends_l) if ends_l else np.empty(0, np.int64)
+        )
+        use_dev = bool(
+            ctx.stream is not None and ctx.stream.policy.use_bcf_chain
+        )
+        t_pile = _time.perf_counter()
+        out = {
+            "contig": iv.contig,
+            "beg": beg0 + 1,
+            "end": end0,
+            "n_records": int(len(starts)),
+        }
+        out.update(
+            depth_summary(
+                starts, ends, beg0, end0,
+                bin_size=bin_size, use_device=use_dev,
+            )
+        )
+        if per_base:
+            if end0 - beg0 > DEPTH_PER_BASE_MAX:
+                raise FormatError(
+                    f"per-base depth span {end0 - beg0} exceeds cap "
+                    f"{DEPTH_PER_BASE_MAX}; use binned summaries"
+                )
+            prof = depth_profile(
+                starts, ends, beg0, end0, use_device=use_dev
+            )
+            out["per_base"] = [int(x) for x in prof]
+        rctx = current_request()
+        if rctx is not None:
+            # The kernel hop: the segmented pileup (device chunks or the
+            # bit-identical NumPy twin), one annotation per request.
+            rctx.annotate(
+                "depth.pileup",
+                ms=(_time.perf_counter() - t_pile) * 1e3,
+                records=int(len(starts)),
+            )
+    METRICS.count("serve.depth.requests", 1)
+    METRICS.observe("serve.depth.ms", (_time.perf_counter() - t0) * 1e3)
+    return out

